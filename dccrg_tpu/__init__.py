@@ -1,0 +1,40 @@
+"""dccrg_tpu — a TPU-native distributed cartesian cell-refinable grid.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of the
+reference dccrg library (header-only C++/MPI/Zoltan; see SURVEY.md):
+
+- global 64-bit cell addressing under adaptive 2:1-balanced octree
+  refinement (``Mapping``),
+- per-cell user data as SoA JAX arrays sharded over a TPU device mesh,
+- neighbor resolution for arbitrary rectangular neighborhoods,
+- halo exchange lowered to XLA collectives (``lax.ppermute`` /
+  ``lax.all_to_all``) under ``shard_map``,
+- adaptive mesh refinement and load balancing as host-side replanning
+  events,
+- parallel checkpoint/restart.
+
+Reference: /root/reference (dccrg.hpp and friends). This package is a
+re-design for TPU, not a translation: structure (cell lists, neighbor
+tables, partition) is replicated host state rebuilt at structure-change
+events; data (cell payloads) lives in HBM and only moves through
+compiled collectives.
+"""
+
+from .types import ERROR_CELL, ERROR_INDEX
+from .length import GridLength
+from .topology import GridTopology
+from .mapping import Mapping
+from .geometry import NoGeometry, CartesianGeometry, StretchedCartesianGeometry
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ERROR_CELL",
+    "ERROR_INDEX",
+    "GridLength",
+    "GridTopology",
+    "Mapping",
+    "NoGeometry",
+    "CartesianGeometry",
+    "StretchedCartesianGeometry",
+]

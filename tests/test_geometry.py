@@ -1,0 +1,145 @@
+"""Geometry unit tests (mirrors reference tests/geometry semantics)."""
+
+import numpy as np
+import pytest
+
+from dccrg_tpu import (
+    CartesianGeometry,
+    GridTopology,
+    Mapping,
+    NoGeometry,
+    StretchedCartesianGeometry,
+)
+from dccrg_tpu.geometry import geometry_from_bytes
+
+
+def make(length=(4, 3, 2), max_lvl=0, periodic=(False, False, False)):
+    return Mapping(length, max_lvl), GridTopology(periodic)
+
+
+def test_no_geometry_unit_cells():
+    m, t = make((4, 3, 2))
+    g = NoGeometry(m, t)
+    np.testing.assert_array_equal(g.get_start(), [0, 0, 0])
+    np.testing.assert_array_equal(g.get_end(), [4, 3, 2])
+    c = np.uint64(1)
+    np.testing.assert_allclose(g.get_min(c), [0, 0, 0])
+    np.testing.assert_allclose(g.get_max(c), [1, 1, 1])
+    np.testing.assert_allclose(g.get_center(c), [0.5, 0.5, 0.5])
+
+
+def test_cartesian_basic():
+    m, t = make((4, 4, 4))
+    g = CartesianGeometry(m, t, start=(-1.0, 0.0, 2.0), level_0_cell_length=(0.5, 1.0, 2.0))
+    np.testing.assert_allclose(g.get_start(), [-1, 0, 2])
+    np.testing.assert_allclose(g.get_end(), [1, 4, 10])
+    # cell (0,0,0) is id 1
+    np.testing.assert_allclose(g.get_min(np.uint64(1)), [-1, 0, 2])
+    np.testing.assert_allclose(g.get_length(np.uint64(1)), [0.5, 1, 2])
+    np.testing.assert_allclose(g.get_center(np.uint64(1)), [-0.75, 0.5, 3.0])
+
+
+def test_cartesian_refined_lengths():
+    m, t = make((2, 2, 2), max_lvl=2)
+    g = CartesianGeometry(m, t, level_0_cell_length=(4.0, 4.0, 4.0))
+    kids = m.get_all_children(np.uint64(1))
+    np.testing.assert_allclose(g.get_length(kids[0]), [2, 2, 2])
+    grandkids = m.get_all_children(kids[0])
+    np.testing.assert_allclose(g.get_length(grandkids[0]), [1, 1, 1])
+    # child 0 shares parent's min corner
+    np.testing.assert_allclose(g.get_min(kids[0]), g.get_min(np.uint64(1)))
+    # child 7 touches parent's max corner
+    np.testing.assert_allclose(g.get_max(kids[7]), g.get_max(np.uint64(1)))
+
+
+def test_get_cell_from_coordinate():
+    m, t = make((4, 4, 4))
+    g = CartesianGeometry(m, t, start=(0, 0, 0), level_0_cell_length=(1, 1, 1))
+    assert g.get_cell(0, (0.5, 0.5, 0.5)) == 1
+    assert g.get_cell(0, (3.5, 3.5, 3.5)) == 64
+    assert g.get_cell(0, (1.5, 0.5, 0.5)) == 2
+    # outside, non-periodic -> error cell
+    assert g.get_cell(0, (-0.5, 0.5, 0.5)) == 0
+
+
+def test_periodic_wrap():
+    m, t = make((4, 4, 4), periodic=(True, False, False))
+    g = CartesianGeometry(m, t)
+    rc = g.get_real_coordinate((-0.5, 1.0, 1.0))
+    np.testing.assert_allclose(rc, [3.5, 1.0, 1.0])
+    assert g.get_cell(0, (-0.5, 0.5, 0.5)) == 4  # wraps to x index 3
+    rc2 = g.get_real_coordinate((0.5, -1.0, 0.5))
+    assert np.isnan(rc2[1])
+
+
+def test_stretched_geometry():
+    m, t = make((3, 2, 1))
+    coords = [
+        np.array([0.0, 1.0, 3.0, 7.0]),
+        np.array([-2.0, 0.0, 5.0]),
+        np.array([10.0, 20.0]),
+    ]
+    g = StretchedCartesianGeometry(m, t, coords)
+    np.testing.assert_allclose(g.get_start(), [0, -2, 10])
+    np.testing.assert_allclose(g.get_end(), [7, 5, 20])
+    # cell 2 = level-0 index (1,0,0): x span [1,3]
+    np.testing.assert_allclose(g.get_min(np.uint64(2)), [1, -2, 10])
+    np.testing.assert_allclose(g.get_length(np.uint64(2)), [2, 2, 10])
+    # coordinate lookup in nonuniform spans
+    assert g.get_cell(0, (5.0, -1.0, 15.0)) == 3
+    assert g.get_cell(0, (0.5, 3.0, 11.0)) == 4
+
+
+def test_stretched_refined_subdivision():
+    m = Mapping((2, 1, 1), maximum_refinement_level=1)
+    t = GridTopology()
+    coords = [np.array([0.0, 2.0, 6.0]), np.array([0.0, 1.0]), np.array([0.0, 1.0])]
+    g = StretchedCartesianGeometry(m, t, coords)
+    # children of cell 2 (x span [2,6]) subdivide uniformly: [2,4],[4,6]
+    kids = m.get_all_children(np.uint64(2))
+    np.testing.assert_allclose(g.get_min(kids[0])[0], 2.0)
+    np.testing.assert_allclose(g.get_length(kids[0])[0], 2.0)
+    np.testing.assert_allclose(g.get_min(kids[1])[0], 4.0)
+
+
+def test_stretched_validation():
+    m, t = make((2, 1, 1))
+    with pytest.raises(ValueError):
+        StretchedCartesianGeometry(m, t, [np.array([0.0, 1.0]), np.array([0.0, 1.0]), np.array([0.0, 1.0])])
+    with pytest.raises(ValueError):
+        StretchedCartesianGeometry(
+            m, t, [np.array([0.0, 2.0, 1.0]), np.array([0.0, 1.0]), np.array([0.0, 1.0])]
+        )
+
+
+def test_from_cartesian_clone():
+    m, t = make((3, 3, 3))
+    cart = CartesianGeometry(m, t, start=(1, 2, 3), level_0_cell_length=(0.5, 0.5, 0.5))
+    s = StretchedCartesianGeometry.from_cartesian(cart)
+    cells = np.arange(1, 28, dtype=np.uint64)
+    np.testing.assert_allclose(s.get_center(cells), cart.get_center(cells))
+    np.testing.assert_allclose(s.get_length(cells), cart.get_length(cells))
+
+
+def test_geometry_file_roundtrip():
+    m, t = make((3, 2, 1))
+    for g in (
+        NoGeometry(m, t),
+        CartesianGeometry(m, t, start=(1, 2, 3), level_0_cell_length=(4, 5, 6)),
+        StretchedCartesianGeometry(
+            m, t, [np.array([0.0, 1.0, 3.0, 7.0]), np.array([-2.0, 0.0, 5.0]), np.array([10.0, 20.0])]
+        ),
+    ):
+        g2 = geometry_from_bytes(g.to_bytes(), m, t)
+        assert type(g2) is type(g)
+        cells = np.arange(1, 7, dtype=np.uint64)
+        np.testing.assert_allclose(g2.get_center(cells), g.get_center(cells))
+
+
+def test_vectorized_centers_match_scalar():
+    m, t = make((4, 4, 4), max_lvl=1)
+    g = CartesianGeometry(m, t, start=(-2, -2, -2), level_0_cell_length=(1, 1, 1))
+    cells = np.arange(1, int(m.get_last_cell()) + 1, dtype=np.uint64)
+    centers = g.get_center(cells)
+    for i in (0, 5, 63, 64, 100, len(cells) - 1):
+        np.testing.assert_allclose(g.get_center(np.uint64(cells[i])), centers[i])

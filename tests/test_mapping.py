@@ -1,0 +1,180 @@
+"""Mapping unit tests.
+
+Mirrors the reference's addressing semantics (dccrg_mapping.hpp) and its
+tests (tests/get_neighbors_, tests/mapping usage in dccrg tests): cell
+ids are 1-based and level-major, indices are in smallest-cell units,
+children enumerate in z-order with x fastest.
+"""
+
+import numpy as np
+import pytest
+
+from dccrg_tpu import ERROR_CELL, ERROR_INDEX, Mapping
+
+
+def test_level0_ids_x_fastest():
+    m = Mapping((4, 3, 2))
+    # 1-based, x fastest: cell at (i,j,k) = 1 + i + j*4 + k*12
+    assert m.get_cell_from_indices((0, 0, 0), 0) == 1
+    assert m.get_cell_from_indices((1, 0, 0), 0) == 2
+    assert m.get_cell_from_indices((0, 1, 0), 0) == 5
+    assert m.get_cell_from_indices((0, 0, 1), 0) == 13
+    assert m.get_cell_from_indices((3, 2, 1), 0) == 24
+    assert m.get_last_cell() == 24
+
+
+def test_roundtrip_level0():
+    m = Mapping((5, 7, 3))
+    cells = np.arange(1, 5 * 7 * 3 + 1, dtype=np.uint64)
+    idx = m.get_indices(cells)
+    back = m.get_cell_from_indices(idx, np.zeros(len(cells), dtype=np.int64))
+    np.testing.assert_array_equal(back, cells)
+
+
+def test_refined_id_ranges():
+    m = Mapping((2, 2, 2), maximum_refinement_level=2)
+    # level 0: ids 1..8; level 1: 9..72 (8*8); level 2: 73..584 (8*64)
+    assert m.get_refinement_level(1) == 0
+    assert m.get_refinement_level(8) == 0
+    assert m.get_refinement_level(9) == 1
+    assert m.get_refinement_level(72) == 1
+    assert m.get_refinement_level(73) == 2
+    assert m.get_last_cell() == 8 + 64 + 512
+    assert m.get_refinement_level(int(m.get_last_cell())) == 2
+    assert m.get_refinement_level(int(m.get_last_cell()) + 1) == -1
+    assert m.get_refinement_level(0) == -1
+
+
+def test_indices_scaling_with_refinement():
+    m = Mapping((2, 1, 1), maximum_refinement_level=1)
+    # level-0 cell 2 is at level-0 index (1,0,0) -> smallest-unit (2,0,0)
+    np.testing.assert_array_equal(m.get_indices(np.uint64(2)), [2, 0, 0])
+    assert m.get_cell_length_in_indices(np.uint64(1)) == 2
+    # first level-1 cell is id 3, at indices (0,0,0), length 1
+    assert m.get_refinement_level(3) == 1
+    np.testing.assert_array_equal(m.get_indices(np.uint64(3)), [0, 0, 0])
+    assert m.get_cell_length_in_indices(np.uint64(3)) == 1
+
+
+def test_children_z_order():
+    m = Mapping((2, 1, 1), maximum_refinement_level=1)
+    kids = m.get_all_children(np.uint64(1))
+    # children of cell 1: level-1 cells in z-order, x fastest
+    # level-1 grid is 4x2x2; first child at (0,0,0) -> id 3
+    assert kids[0] == 3
+    assert kids[1] == 4  # +x
+    assert kids[2] == 7  # +y (level-1 x-extent 4)
+    assert kids[3] == 8
+    assert kids[4] == 11  # +z (4*2 = 8 per z-layer)
+    assert kids[5] == 12
+    assert kids[7] == 16
+    # all children's parent is cell 1
+    np.testing.assert_array_equal(m.get_parent(kids), np.full(8, 1, dtype=np.uint64))
+
+
+def test_parent_child_identity_cases():
+    m = Mapping((2, 2, 2), maximum_refinement_level=1)
+    # level-0 cell: parent is itself
+    assert m.get_parent(np.uint64(5)) == 5
+    # max-level cell: child is itself
+    last = m.get_last_cell()
+    assert m.get_child(last) == last
+    # invalid
+    assert m.get_parent(np.uint64(0)) == ERROR_CELL
+    assert m.get_child(np.uint64(0)) == ERROR_CELL
+
+
+def test_siblings():
+    m = Mapping((2, 1, 1), maximum_refinement_level=1)
+    kids = m.get_all_children(np.uint64(2))
+    sibs = m.get_siblings(kids[3])
+    np.testing.assert_array_equal(np.sort(sibs), np.sort(kids))
+    # level-0 cell: itself + 7 error cells
+    s0 = m.get_siblings(np.uint64(1))
+    assert s0[0] == 1
+    assert np.all(s0[1:] == ERROR_CELL)
+
+
+def test_level_0_parent():
+    m = Mapping((2, 2, 1), maximum_refinement_level=2)
+    c = m.get_all_children(np.uint64(3))[5]
+    g = m.get_all_children(c)[2]
+    assert m.get_level_0_parent(g) == 3
+    assert m.get_level_0_parent(np.uint64(3)) == 3
+
+
+def test_out_of_range_indices():
+    m = Mapping((4, 4, 4), maximum_refinement_level=1)
+    assert m.get_cell_from_indices((8, 0, 0), 0) == ERROR_CELL
+    assert m.get_cell_from_indices((0, 0, 0), 2) == ERROR_CELL
+    assert m.get_cell_from_indices((0, 0, 0), -1) == ERROR_CELL
+    np.testing.assert_array_equal(m.get_indices(np.uint64(0)), [ERROR_INDEX] * 3)
+
+
+def test_max_possible_refinement_level():
+    m = Mapping((1, 1, 1))
+    # sum_{i=0..21} 8^i <= 2^64-1 < sum_{i=0..22} 8^i
+    assert m.get_maximum_possible_refinement_level() == 21
+    assert m.set_maximum_refinement_level(21)
+    assert not m.set_maximum_refinement_level(22)
+    big = Mapping((1000, 1000, 1000))
+    # 1e9 * (8^L sum) must fit
+    lvl = big.get_maximum_possible_refinement_level()
+    total = sum(10**9 * 8**i for i in range(lvl + 1))
+    assert total <= 2**64 - 1
+    assert sum(10**9 * 8**i for i in range(lvl + 2)) > 2**64 - 1
+
+
+def test_file_roundtrip():
+    m = Mapping((6, 5, 4), maximum_refinement_level=3)
+    m2 = Mapping.from_bytes(m.to_bytes())
+    assert m == m2
+    assert m2.get_last_cell() == m.get_last_cell()
+
+
+def test_vectorized_matches_scalar():
+    m = Mapping((3, 4, 5), maximum_refinement_level=2)
+    rng = np.random.default_rng(0)
+    cells = rng.integers(1, int(m.get_last_cell()) + 1, size=200, dtype=np.uint64)
+    idx = m.get_indices(cells)
+    lvl = m.get_refinement_level(cells)
+    for i in range(0, 200, 17):
+        c = np.uint64(cells[i])
+        np.testing.assert_array_equal(m.get_indices(c), idx[i])
+        assert m.get_refinement_level(c) == lvl[i]
+        assert m.get_cell_from_indices(idx[i], int(lvl[i])) == c
+
+
+def test_set_length_rejects_incompatible_max_level():
+    m = Mapping((1, 1, 1), maximum_refinement_level=21)
+    assert not m.set_length((1000, 1000, 1000))
+    # unchanged on failure
+    np.testing.assert_array_equal(m.length.get(), [1, 1, 1])
+    assert m.get_refinement_level(1) == 0
+    m2 = Mapping((1, 1, 1))
+    assert m2.set_length((1000, 1000, 1000))
+
+
+def test_huge_grid_construction():
+    m = Mapping((2**32 - 1, 2**16, 2**16))
+    assert m.get_last_cell() == (2**32 - 1) * 2**32
+    assert m.get_refinement_level(int(m.get_last_cell())) == 0
+
+
+def test_negative_ids_are_error_values():
+    m = Mapping((4, 4, 4))
+    assert m.get_refinement_level(-1) == -1
+    assert m.get_parent(-5) == ERROR_CELL
+    lvls = m.get_refinement_level(np.array([-1, 1, 2**70], dtype=object))
+    np.testing.assert_array_equal(lvls, [-1, 0, -1])
+
+
+def test_scalar_out_convention():
+    m = Mapping((2, 2, 2), maximum_refinement_level=1)
+    assert np.isscalar(m.get_refinement_level(1)) or np.ndim(m.get_refinement_level(1)) == 0
+    assert np.ndim(m.get_parent(np.uint64(9))) == 0
+    assert np.ndim(m.get_child(np.uint64(1))) == 0
+    assert np.ndim(m.get_cell_length_in_indices(np.uint64(1))) == 0
+    assert m.get_all_children(np.uint64(1)).shape == (8,)
+    assert m.get_siblings(np.uint64(9)).shape == (8,)
+    assert m.get_parent(np.array([9, 10], dtype=np.uint64)).shape == (2,)
