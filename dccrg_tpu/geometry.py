@@ -30,9 +30,6 @@ from .mapping import Mapping
 from .topology import GridTopology
 from .types import ERROR_INDEX, as_cell_array
 
-_NAN3 = np.array([np.nan, np.nan, np.nan])
-
-
 class _GeometryBase:
     """Shared implementation: everything derives from per-dimension
     level-0 cell boundary coordinates + uniform subdivision within a
@@ -74,8 +71,8 @@ class _GeometryBase:
         extent = 1.0 / (1 << lvl_safe).astype(np.float64)  # cell edge / level-0 edge
         return lvl, bad, l0, frac, extent
 
-    def _min_and_length(self, cells):
-        """(min corner, edge lengths) in one structure pass."""
+    def _min_and_length_flat(self, cells):
+        """(min corner, edge lengths) in one structure pass (1-d input)."""
         lvl, bad, l0, frac, extent = self._cell_level_and_l0(cells)
         mins = np.empty(l0.shape, dtype=np.float64)
         lens = np.empty(l0.shape, dtype=np.float64)
@@ -89,27 +86,32 @@ class _GeometryBase:
         lens[bad] = np.nan
         return mins, lens
 
+    def _min_and_length(self, cells):
+        """N-d aware wrapper: results have shape cells.shape + (3,)."""
+        arr = np.asarray(cells)
+        scalar = np.isscalar(cells) or arr.ndim == 0
+        flat = arr.reshape(-1)
+        mins, lens = self._min_and_length_flat(flat)
+        shape = ((1,) if scalar else arr.shape) + (3,)
+        return mins.reshape(shape), lens.reshape(shape), scalar
+
     def get_min(self, cells) -> np.ndarray:
         """Min corner coordinate of each cell; NaN rows for invalid ids."""
-        scalar = np.isscalar(cells) or np.asarray(cells).ndim == 0
-        out = self._min_and_length(cells)[0]
-        return out[0] if scalar else out
+        mins, _, scalar = self._min_and_length(cells)
+        return mins[0] if scalar else mins
 
     def get_length(self, cells) -> np.ndarray:
         """Edge lengths of each cell; NaN rows for invalid ids."""
-        scalar = np.isscalar(cells) or np.asarray(cells).ndim == 0
-        out = self._min_and_length(cells)[1]
-        return out[0] if scalar else out
+        _, lens, scalar = self._min_and_length(cells)
+        return lens[0] if scalar else lens
 
     def get_max(self, cells) -> np.ndarray:
-        scalar = np.isscalar(cells) or np.asarray(cells).ndim == 0
-        mins, lens = self._min_and_length(cells)
+        mins, lens, scalar = self._min_and_length(cells)
         out = mins + lens
         return out[0] if scalar else out
 
     def get_center(self, cells) -> np.ndarray:
-        scalar = np.isscalar(cells) or np.asarray(cells).ndim == 0
-        mins, lens = self._min_and_length(cells)
+        mins, lens, scalar = self._min_and_length(cells)
         out = mins + 0.5 * lens
         return out[0] if scalar else out
 
@@ -213,8 +215,8 @@ class CartesianGeometry(_GeometryBase):
             raise ValueError("start and level_0_cell_length must be 3-vectors")
         if np.any(l0len <= 0):
             raise ValueError(f"level_0_cell_length must be > 0, got {l0len}")
-        self.start = start
-        self.level_0_cell_length = l0len
+        self.start = start.copy()
+        self.level_0_cell_length = l0len.copy()
 
     def get_level_0_cell_length(self) -> np.ndarray:
         return self.level_0_cell_length.copy()
@@ -227,7 +229,7 @@ class CartesianGeometry(_GeometryBase):
 
     # Faster closed-form override (no searchsorted / boundary arrays).
 
-    def _min_and_length(self, cells):
+    def _min_and_length_flat(self, cells):
         cells_arr = as_cell_array(cells)
         lvl = np.atleast_1d(np.asarray(self.mapping.get_refinement_level(cells_arr), np.int64))
         bad = lvl < 0
@@ -262,7 +264,8 @@ class StretchedCartesianGeometry(_GeometryBase):
         self.set(coordinates)
 
     def set(self, coordinates) -> None:
-        coords = [np.asarray(c, dtype=np.float64) for c in coordinates]
+        # copy: external mutation must not bypass monotonicity validation
+        coords = [np.array(c, dtype=np.float64) for c in coordinates]
         if len(coords) != 3:
             raise ValueError("need one coordinate array per dimension")
         for d in range(3):

@@ -22,9 +22,17 @@ class GridLength:
         self.set(length)
 
     def set(self, length) -> None:
-        arr = np.asarray(length, dtype=np.uint64)
-        if arr.shape != (3,):
-            raise ValueError(f"grid length must be 3 values, got {arr!r}")
+        raw = np.asarray(length)
+        if raw.shape != (3,):
+            raise ValueError(f"grid length must be 3 values, got {raw!r}")
+        if np.any(np.asarray(raw, dtype=object) < 0):
+            raise ValueError(f"grid length must be > 0 in every dimension, got {raw}")
+        try:
+            arr = raw.astype(np.uint64)
+        except OverflowError as e:
+            raise ValueError(str(e))
+        if raw.dtype == object and np.any(raw != arr):
+            raise ValueError(f"grid length does not fit uint64: {raw}")
         if np.any(arr == 0):
             raise ValueError(f"grid length must be > 0 in every dimension, got {arr}")
         # Total level-0 cell count must fit uint64 (the per-level id

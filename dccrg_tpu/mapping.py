@@ -53,7 +53,7 @@ class Mapping:
         old = tuple(int(v) for v in self.length.get())
         try:
             self.length.set(length)
-        except ValueError:
+        except (ValueError, OverflowError):
             return False
         # the current max refinement level must remain representable
         if self.max_refinement_level > self.get_maximum_possible_refinement_level():

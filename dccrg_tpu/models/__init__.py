@@ -1,0 +1,2 @@
+"""Application layer: the reference's examples/tests solvers as JAX
+programs on top of the grid (SURVEY.md section L6)."""

@@ -1,0 +1,292 @@
+"""Host-side neighbor resolution under AMR.
+
+Re-implements the semantics of the reference's neighbor engine
+(dccrg.hpp:4236-4897: ``indices_from_neighborhood``,
+``find_neighbors_of``, ``find_neighbors_to``) with a fundamentally
+different algorithm: instead of walking a per-cell 6-link graph, we
+binary-search candidate ids in the sorted replicated cell list,
+vectorized over (cells x neighborhood items) with numpy. The *results*
+match the reference:
+
+- A neighborhood is a list of integer offset triples in units of the
+  cell's own edge length; offset (hx,hy,hz) denotes the axis-aligned
+  window of the cell's own size at that displacement.
+- Per window the neighbor is: the same-level cell occupying the window,
+  or the coarser (level-1) cell containing it, or the 8 finer (level+1)
+  cells inside it enumerated in z-order (x fastest) — dccrg's
+  "expand to all siblings" rule (dccrg.hpp:4680-4713).
+- Each neighbor is recorded once per neighborhood item it satisfies
+  (duplicates across items are kept, dccrg.hpp:4497-4501).
+- Recorded offsets are the displacement of the neighbor's min corner
+  from the cell's min corner in smallest-cell index units, *logical*
+  (not wrapped) across periodic boundaries — what the reference's
+  offset bookkeeping accumulates and what stencil kernels consume
+  (e.g. advection face detection, tests/advection/solve.hpp:76-120).
+- ``neighbors_to`` (cells that consider a given cell their neighbor) is
+  obtained by exact inversion of the full neighbors_of relation, which
+  by construction satisfies the consistency the reference's DEBUG
+  verifier checks (dccrg.hpp:12516-12750).
+
+Validity requirement (enforced by the AMR commit, not here): the cell
+set exactly tiles the grid and refinement levels differ by at most 1
+within any cell's neighborhood.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .mapping import Mapping
+from .topology import GridTopology
+
+# Maximum addressable index extent for the vectorized engine: signed
+# 63-bit arithmetic is used for offset windows.
+_MAX_INDEX = 2**62
+
+
+def make_neighborhood(length: int) -> np.ndarray:
+    """Default neighborhood offsets (dccrg.hpp:8017-8076): the 6 face
+    offsets for length 0 (-z, -y, -x, +x, +y, +z order), else the full
+    cube of radius ``length`` without (0,0,0), z-major x-fastest."""
+    if length < 0:
+        raise ValueError(f"neighborhood length must be >= 0, got {length}")
+    if length == 0:
+        return np.array(
+            [[0, 0, -1], [0, -1, 0], [-1, 0, 0], [1, 0, 0], [0, 1, 0], [0, 0, 1]],
+            dtype=np.int64,
+        )
+    r = np.arange(-length, length + 1, dtype=np.int64)
+    z, y, x = np.meshgrid(r, r, r, indexing="ij")
+    items = np.stack([x.ravel(), y.ravel(), z.ravel()], axis=1)
+    return items[np.any(items != 0, axis=1)]
+
+
+def validate_neighborhood(offsets: np.ndarray, default_length: int) -> np.ndarray:
+    """User-neighborhood validation (dccrg.hpp:6573-6606): offsets must
+    be unique, nonzero, and within the default neighborhood radius."""
+    offsets = np.asarray(offsets, dtype=np.int64).reshape(-1, 3)
+    if len(offsets) == 0:
+        raise ValueError("neighborhood must contain at least one offset")
+    if np.any(np.all(offsets == 0, axis=1)):
+        raise ValueError("neighborhood must not contain the (0,0,0) offset")
+    limit = max(default_length, 1)
+    if np.any(np.abs(offsets) > limit):
+        raise ValueError(
+            f"neighborhood offsets must be within the default neighborhood "
+            f"(max |offset| {limit}), got {offsets[np.any(np.abs(offsets) > limit, axis=1)][0]}"
+        )
+    if len(np.unique(offsets, axis=0)) != len(offsets):
+        raise ValueError("neighborhood offsets must be unique")
+    return offsets
+
+
+@dataclass
+class NeighborLists:
+    """Flat ragged neighbors_of / neighbors_to for a cell set.
+
+    ``of_*`` arrays: one entry per (cell, neighborhood item, neighbor).
+    ``of_source`` indexes the queried cell array; ``of_neighbor`` holds
+    neighbor cell ids; ``of_offset`` the [n,3] int64 logical offsets;
+    ``of_item`` which neighborhood item produced the entry.
+    ``to_*`` arrays: the inverted relation (see module docstring).
+    """
+
+    of_source: np.ndarray
+    of_neighbor: np.ndarray
+    of_offset: np.ndarray
+    of_item: np.ndarray
+    to_source: np.ndarray
+    to_neighbor: np.ndarray
+    to_offset: np.ndarray
+
+
+class StructureError(RuntimeError):
+    """The cell set violates grid invariants (gap, overlap, or a
+    refinement-level jump > 1 inside a neighborhood)."""
+
+
+def find_neighbors_of(
+    mapping: Mapping,
+    topology: GridTopology,
+    all_cells_sorted: np.ndarray,
+    query_cells: np.ndarray,
+    neighborhood: np.ndarray,
+):
+    """neighbors_of for ``query_cells`` against the complete cell set.
+
+    Returns flat arrays (source_index, neighbor_id, offset[ n,3 ],
+    item_index) sorted by (source, item, z-order sibling rank).
+
+    ``all_cells_sorted`` must be the complete sorted leaf-cell set of
+    the grid (replicated structure).
+    """
+    query_cells = np.asarray(query_cells, dtype=np.uint64)
+    neighborhood = np.asarray(neighborhood, dtype=np.int64).reshape(-1, 3)
+    n, k = len(query_cells), len(neighborhood)
+    if n == 0 or k == 0:
+        empty = np.empty(0, dtype=np.int64)
+        return empty, np.empty(0, dtype=np.uint64), np.empty((0, 3), dtype=np.int64), empty
+
+    index_length = mapping.get_index_length().astype(np.int64)
+    if np.any(index_length >= _MAX_INDEX):
+        raise StructureError("grid index space too large for the vectorized engine")
+
+    lvl = mapping.get_refinement_level(query_cells)  # [n]
+    if np.any(lvl < 0):
+        raise ValueError("invalid cell id in query")
+    size = (1 << (mapping.max_refinement_level - lvl)).astype(np.int64)  # [n]
+    base = mapping.get_indices(query_cells).astype(np.int64)  # [n,3]
+
+    periodic = np.array([topology.is_periodic(d) for d in range(3)])
+
+    # window min corners, logical: [n, k, 3]
+    win = base[:, None, :] + neighborhood[None, :, :] * size[:, None, None]
+    # wrap / validity
+    inside = np.ones((n, k), dtype=bool)
+    wrapped = win.copy()
+    for d in range(3):
+        if periodic[d]:
+            wrapped[:, :, d] = np.mod(win[:, :, d], index_length[d])
+        else:
+            inside &= (win[:, :, d] >= 0) & (win[:, :, d] < index_length[d])
+    wrapped = np.where(inside[:, :, None], wrapped, 0)
+
+    exists = lambda ids: all_cells_sorted[
+        np.minimum(np.searchsorted(all_cells_sorted, ids), len(all_cells_sorted) - 1)
+    ] == ids if len(all_cells_sorted) else np.zeros(ids.shape, bool)
+
+    lvl_b = np.broadcast_to(lvl[:, None], (n, k))
+    # same-level slot cell at the window min corner
+    slot = mapping.get_cell_from_indices(
+        wrapped.reshape(-1, 3).astype(np.uint64), lvl_b.reshape(-1)
+    ).reshape(n, k)
+    have_same = exists(slot) & inside
+
+    # coarser (level-1) cell containing the window
+    lvl_up = np.maximum(lvl_b - 1, 0)
+    coarse = mapping.get_cell_from_indices(
+        wrapped.reshape(-1, 3).astype(np.uint64), lvl_up.reshape(-1)
+    ).reshape(n, k)
+    have_coarse = exists(coarse) & inside & ~have_same & (lvl_b > 0)
+
+    # finer: the 8 children of the slot cell
+    need_fine = inside & ~have_same & ~have_coarse
+    if np.any(need_fine & (lvl_b >= mapping.max_refinement_level)):
+        bad = np.argwhere(need_fine & (lvl_b >= mapping.max_refinement_level))[0]
+        raise StructureError(
+            f"no neighbor found for cell {query_cells[bad[0]]} at offset "
+            f"{neighborhood[bad[1]]}: grid does not tile the domain"
+        )
+
+    src_i, item_i = np.nonzero(have_same)
+    out_src = [src_i]
+    out_nbr = [slot[have_same]]
+    out_off = [(neighborhood[item_i] * size[src_i, None])]
+    out_item = [item_i]
+
+    if np.any(have_coarse):
+        src_i, item_i = np.nonzero(have_coarse)
+        csize = 2 * size[src_i]
+        # coarse cell min corner (aligned down), relative to window min
+        cmin = (wrapped[src_i, item_i] // csize[:, None]) * csize[:, None]
+        rel = cmin - wrapped[src_i, item_i]  # components in {-s, 0}
+        out_src.append(src_i)
+        out_nbr.append(coarse[have_coarse])
+        out_off.append(neighborhood[item_i] * size[src_i, None] + rel)
+        out_item.append(item_i)
+
+    if np.any(need_fine):
+        src_i, item_i = np.nonzero(need_fine)
+        half = size[src_i] // 2  # child edge length
+        kk = np.arange(8, dtype=np.int64)
+        dx = (kk & 1)[None, :] * half[:, None]
+        dy = ((kk >> 1) & 1)[None, :] * half[:, None]
+        dz = ((kk >> 2) & 1)[None, :] * half[:, None]
+        child_rel = np.stack([dx, dy, dz], axis=-1)  # [m, 8, 3]
+        child_idx = wrapped[src_i, item_i][:, None, :] + child_rel
+        children = mapping.get_cell_from_indices(
+            child_idx.reshape(-1, 3).astype(np.uint64),
+            np.repeat(lvl[src_i] + 1, 8),
+        ).reshape(-1, 8)
+        ok = exists(children)
+        if not np.all(ok):
+            bad = np.argwhere(~ok)[0]
+            raise StructureError(
+                f"cell {query_cells[src_i[bad[0]]]} offset {neighborhood[item_i[bad[0]]]}: "
+                f"window neither tiled by level {lvl[src_i[bad[0]]] + 1} cells nor coarser "
+                f"(2:1 balance violated or grid has gaps)"
+            )
+        out_src.append(np.repeat(src_i, 8))
+        out_nbr.append(children.reshape(-1))
+        base_off = neighborhood[item_i] * size[src_i, None]
+        out_off.append((base_off[:, None, :] + child_rel).reshape(-1, 3))
+        out_item.append(np.repeat(item_i, 8))
+
+    src = np.concatenate(out_src)
+    nbr = np.concatenate(out_nbr)
+    off = np.concatenate(out_off)
+    item = np.concatenate(out_item)
+
+    # order: by (source, neighborhood item, z-order within item)
+    order = np.lexsort((np.arange(len(src)), item, src))
+    return src[order], nbr[order], off[order], item[order]
+
+
+def build_neighbor_lists(
+    mapping: Mapping,
+    topology: GridTopology,
+    all_cells_sorted: np.ndarray,
+    neighborhood: np.ndarray,
+) -> NeighborLists:
+    """neighbors_of for every cell in the grid, plus the inverted
+    neighbors_to relation."""
+    src, nbr, off, item = find_neighbors_of(
+        mapping, topology, all_cells_sorted, all_cells_sorted, neighborhood
+    )
+    # invert: v in neighbors_of(c) with offset o  =>  c in neighbors_to(v)
+    # with offset -o (displacement of c's min corner from v's).
+    nbr_row = np.searchsorted(all_cells_sorted, nbr)
+    to_src = nbr_row
+    to_nbr = all_cells_sorted[src]
+    to_off = -off
+    order = np.lexsort((np.arange(len(to_src)), to_src))
+    return NeighborLists(
+        of_source=src,
+        of_neighbor=nbr,
+        of_offset=off,
+        of_item=item,
+        to_source=to_src[order],
+        to_neighbor=to_nbr[order],
+        to_offset=to_off[order],
+    )
+
+
+def verify_tiling(mapping: Mapping, all_cells_sorted: np.ndarray) -> None:
+    """DEBUG-style invariant check (cf. dccrg.hpp:12516-12750): the cell
+    set exactly tiles the index space — total volume matches and no two
+    cells overlap (sufficient together with uniqueness)."""
+    cells = np.asarray(all_cells_sorted, dtype=np.uint64)
+    if len(np.unique(cells)) != len(cells):
+        raise StructureError("duplicate cell ids")
+    lvl = mapping.get_refinement_level(cells)
+    if np.any(lvl < 0):
+        raise StructureError("invalid cell id in cell set")
+    size = (1 << (mapping.max_refinement_level - lvl)).astype(object)
+    total = int(np.sum(size**3))
+    expect = int(np.prod(mapping.get_index_length().astype(object)))
+    if total != expect:
+        raise StructureError(f"cells cover volume {total}, grid volume is {expect}")
+    # overlap check: no cell's ancestor may also be present
+    for up in range(1, mapping.max_refinement_level + 1):
+        sub = cells[lvl >= up]
+        if len(sub) == 0:
+            continue
+        anc = sub
+        for _ in range(up):
+            anc = mapping.get_parent(anc)
+        pos = np.searchsorted(cells, anc)
+        pos = np.minimum(pos, len(cells) - 1)
+        if np.any(cells[pos] == anc):
+            raise StructureError("overlapping cells: an ancestor of a cell is also present")

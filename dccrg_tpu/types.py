@@ -35,9 +35,13 @@ def as_cell_array(cells) -> np.ndarray:
         return np.atleast_1d(arr)
     if np.issubdtype(arr.dtype, np.unsignedinteger):
         return np.atleast_1d(arr.astype(np.uint64))
-    if np.issubdtype(arr.dtype, np.signedinteger) or np.issubdtype(arr.dtype, np.floating):
+    if np.issubdtype(arr.dtype, np.signedinteger):
         a = np.atleast_1d(arr)
         return np.where(a < 0, 0, a).astype(np.uint64)
+    if np.issubdtype(arr.dtype, np.floating):
+        a = np.atleast_1d(arr)
+        bad = ~np.isfinite(a) | (a < 0) | (a >= 2.0**64)
+        return np.where(bad, 0.0, a).astype(np.uint64)
     # object dtype: python ints possibly outside int64/uint64 range
     a = np.atleast_1d(arr)
     out = np.zeros(a.shape, dtype=np.uint64)

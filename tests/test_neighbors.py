@@ -1,0 +1,293 @@
+"""Neighbor engine tests.
+
+Cross-checks the vectorized engine against a brute-force geometric
+overlap computation on uniform and randomly refined 2:1-balanced grids
+(the reference's DEBUG verify_neighbors strategy, dccrg.hpp:12516-12750,
+done as an independent reimplementation instead of a recomputation).
+"""
+
+import numpy as np
+import pytest
+
+from dccrg_tpu import GridTopology, Mapping
+from dccrg_tpu.neighbors import (
+    NeighborLists,
+    StructureError,
+    build_neighbor_lists,
+    find_neighbors_of,
+    make_neighborhood,
+    validate_neighborhood,
+    verify_tiling,
+)
+
+
+# ---------------------------------------------------------------------
+# helpers
+
+def refine_to_valid(mapping, topology, cells, to_refine, hood_len=1):
+    """Refine `to_refine` plus whatever induced refinement is needed to
+    keep every neighborhood within 1 level (naive fixpoint)."""
+    cells = set(int(c) for c in cells)
+    queue = list(int(c) for c in to_refine)
+    while queue:
+        c = queue.pop()
+        if c not in cells:
+            continue
+        lvl = mapping.get_refinement_level(c)
+        if lvl >= mapping.max_refinement_level:
+            continue
+        # refining c: every cell overlapping c's radius-hood window must
+        # be at least at c's level
+        cells.remove(c)
+        kids = mapping.get_all_children(np.uint64(c))
+        cells.update(int(k) for k in kids)
+        for v in list(cells):
+            vl = mapping.get_refinement_level(v)
+            if vl < lvl and cells_touch(mapping, topology, c, v, hood_len):
+                queue.append(v)
+    return np.sort(np.array(sorted(cells), dtype=np.uint64))
+
+
+def cells_touch(mapping, topology, a, b, hood_len):
+    """True if b overlaps any neighborhood window of a."""
+    il = mapping.get_index_length().astype(np.int64)
+    la = mapping.get_refinement_level(a)
+    sa = 1 << (mapping.max_refinement_level - la)
+    ia = mapping.get_indices(np.uint64(a)).astype(np.int64)
+    lb = mapping.get_refinement_level(b)
+    sb = 1 << (mapping.max_refinement_level - lb)
+    ib = mapping.get_indices(np.uint64(b)).astype(np.int64)
+    lo = ia - hood_len * sa
+    hi = ia + (hood_len + 1) * sa  # exclusive
+    for d in range(3):
+        if topology.is_periodic(d):
+            # does [ib, ib+sb) intersect [lo, hi) modulo il?
+            if not _periodic_overlap(lo[d], hi[d], ib[d], ib[d] + sb, il[d]):
+                return False
+        else:
+            if ib[d] + sb <= lo[d] or ib[d] >= hi[d]:
+                return False
+    return True
+
+
+def _periodic_overlap(lo, hi, blo, bhi, period):
+    for shift in (-period, 0, period):
+        if blo + shift < hi and bhi + shift > lo:
+            return True
+    return False
+
+
+def brute_force_neighbors_of(mapping, topology, cells, cell, hood):
+    """All (neighbor, offset) pairs per hood item by direct overlap."""
+    il = mapping.get_index_length().astype(np.int64)
+    lvl = mapping.get_refinement_level(np.uint64(cell))
+    s = 1 << (mapping.max_refinement_level - lvl)
+    base = mapping.get_indices(np.uint64(cell)).astype(np.int64)
+    out = []
+    lv_all = mapping.get_refinement_level(cells)
+    sz_all = 1 << (mapping.max_refinement_level - lv_all)
+    ix_all = mapping.get_indices(cells).astype(np.int64)
+    for it, h in enumerate(hood):
+        win = base + np.asarray(h, np.int64) * s
+        wrapped = win.copy()
+        ok = True
+        for d in range(3):
+            if topology.is_periodic(d):
+                wrapped[d] = wrapped[d] % il[d]
+            elif not (0 <= win[d] < il[d]):
+                ok = False
+        if not ok:
+            continue
+        for v, vl, vs, vi in zip(cells, lv_all, sz_all, ix_all):
+            if all(vi[d] < wrapped[d] + s and vi[d] + vs > wrapped[d] for d in range(3)):
+                # logical offset: window offset + position within window
+                rel = vi - wrapped
+                out.append((it, int(v), tuple(h * s + rel)))
+    return out
+
+
+def engine_neighbors_of(mapping, topology, cells, cell, hood):
+    q = np.array([cell], dtype=np.uint64)
+    src, nbr, off, item = find_neighbors_of(mapping, topology, cells, q, hood)
+    return [(int(i), int(v), tuple(o)) for i, v, o in zip(item, nbr, off)]
+
+
+# ---------------------------------------------------------------------
+# neighborhood construction
+
+def test_make_neighborhood_faces():
+    h = make_neighborhood(0)
+    assert h.shape == (6, 3)
+    np.testing.assert_array_equal(h[0], [0, 0, -1])
+    np.testing.assert_array_equal(h[5], [0, 0, 1])
+
+
+def test_make_neighborhood_cube():
+    h = make_neighborhood(1)
+    assert h.shape == (26, 3)
+    assert not np.any(np.all(h == 0, axis=1))
+    h2 = make_neighborhood(2)
+    assert h2.shape == (124, 3)
+
+
+def test_validate_neighborhood():
+    validate_neighborhood([[1, 0, 0], [0, -1, 0]], 1)
+    with pytest.raises(ValueError):
+        validate_neighborhood([[0, 0, 0]], 1)
+    with pytest.raises(ValueError):
+        validate_neighborhood([[2, 0, 0]], 1)
+    with pytest.raises(ValueError):
+        validate_neighborhood([[1, 0, 0], [1, 0, 0]], 1)
+
+
+# ---------------------------------------------------------------------
+# uniform grids
+
+def test_uniform_face_neighbors():
+    m = Mapping((4, 4, 4))
+    t = GridTopology()
+    cells = np.arange(1, 65, dtype=np.uint64)
+    hood = make_neighborhood(0)
+    # interior cell (1,1,1) -> id 1 + 1 + 4 + 16 = 22
+    got = engine_neighbors_of(m, t, cells, 22, hood)
+    ids = [v for _, v, _ in got]
+    assert ids == [22 - 16, 22 - 4, 22 - 1, 22 + 1, 22 + 4, 22 + 16]
+    offs = [o for _, _, o in got]
+    assert offs == [(0, 0, -1), (0, -1, 0), (-1, 0, 0), (1, 0, 0), (0, 1, 0), (0, 0, 1)]
+    # corner cell 1: only 3 neighbors (+x +y +z)
+    got = engine_neighbors_of(m, t, cells, 1, hood)
+    assert [v for _, v, _ in got] == [2, 5, 17]
+
+
+def test_uniform_periodic_wraps():
+    m = Mapping((4, 1, 1))
+    t = GridTopology((True, False, False))
+    cells = np.arange(1, 5, dtype=np.uint64)
+    hood = np.array([[-1, 0, 0], [1, 0, 0], [2, 0, 0]])
+    got = engine_neighbors_of(m, t, cells, 4, hood)
+    # -x: 3; +x wraps to 1; +2x wraps to 2; offsets stay logical
+    assert got == [(0, 3, (-1, 0, 0)), (1, 1, (1, 0, 0)), (2, 2, (2, 0, 0))]
+
+
+def test_one_cell_periodic_grid_sees_itself_26_times():
+    m = Mapping((1, 1, 1))
+    t = GridTopology((True, True, True))
+    cells = np.array([1], dtype=np.uint64)
+    got = engine_neighbors_of(m, t, cells, 1, make_neighborhood(1))
+    assert len(got) == 26
+    assert all(v == 1 for _, v, _ in got)
+    assert len(set(o for _, _, o in got)) == 26
+
+
+def test_uniform_matches_brute_force():
+    m = Mapping((4, 3, 2))
+    t = GridTopology((True, False, True))
+    cells = np.arange(1, 25, dtype=np.uint64)
+    hood = make_neighborhood(1)
+    for c in [1, 7, 13, 24]:
+        got = engine_neighbors_of(m, t, cells, c, hood)
+        want = brute_force_neighbors_of(m, t, cells, c, hood)
+        assert sorted(got) == sorted(want), f"cell {c}"
+
+
+# ---------------------------------------------------------------------
+# refined grids
+
+def refined_grid(length, max_lvl, refine_ids, periodic=(False, False, False), hood_len=1):
+    m = Mapping(length, maximum_refinement_level=max_lvl)
+    t = GridTopology(periodic)
+    n0 = int(np.prod(np.asarray(length)))
+    cells = np.arange(1, n0 + 1, dtype=np.uint64)
+    cells = refine_to_valid(m, t, cells, refine_ids, hood_len)
+    verify_tiling(m, cells)
+    return m, t, cells
+
+
+def test_refined_corner_matches_brute_force():
+    m, t, cells = refined_grid((2, 2, 2), 1, [1])
+    hood = make_neighborhood(1)
+    for c in cells:
+        got = engine_neighbors_of(m, t, cells, int(c), hood)
+        want = brute_force_neighbors_of(m, t, cells, int(c), hood)
+        assert sorted(got) == sorted(want), f"cell {c}"
+
+
+def test_finer_neighbors_expand_to_8_in_z_order():
+    m, t, cells = refined_grid((2, 1, 1), 1, [2])
+    hood = make_neighborhood(0)
+    got = engine_neighbors_of(m, t, cells, 1, hood)
+    # +x face of cell 1 is refined cell 2 -> all 8 children in z-order
+    plus_x = [(v, o) for it, v, o in got if it == 3]
+    assert len(plus_x) == 8
+    kids = m.get_all_children(np.uint64(2))
+    np.testing.assert_array_equal([v for v, _ in plus_x], kids)
+    # offsets: window at +2 (cell edge 2 in smallest units), children at
+    # relative 0/1 in each dim, z-order x fastest
+    assert [o for _, o in plus_x] == [
+        (2, 0, 0), (3, 0, 0), (2, 1, 0), (3, 1, 0),
+        (2, 0, 1), (3, 0, 1), (2, 1, 1), (3, 1, 1),
+    ]
+
+
+def test_coarser_neighbor_offset():
+    m, t, cells = refined_grid((2, 1, 1), 1, [1])
+    # children of cell 1; the +x-most children see coarse cell 2
+    kids = m.get_all_children(np.uint64(1))
+    hood = make_neighborhood(0)
+    # child 1 at indices (1,0,0), +x window at (2,0,0): coarse cell 2
+    got = engine_neighbors_of(m, t, cells, int(kids[1]), hood)
+    plus_x = [(v, o) for it, v, o in got if it == 3]
+    assert plus_x == [(2, (1, 0, 0))]
+    # child 3 at (1,1,0): +x window (2,1,0), coarse min (2,0,0) -> rel y -1
+    got = engine_neighbors_of(m, t, cells, int(kids[3]), hood)
+    plus_x = [(v, o) for it, v, o in got if it == 3]
+    assert plus_x == [(2, (1, -1, 0))]
+
+
+def test_random_refined_grids_match_brute_force(rng):
+    for trial in range(3):
+        length = tuple(rng.integers(1, 4, size=3))
+        n0 = int(np.prod(length))
+        picks = rng.choice(np.arange(1, n0 + 1), size=min(2, n0), replace=False)
+        m, t, cells = refined_grid(length, 2, picks, periodic=(True, trial % 2 == 0, False))
+        hood = make_neighborhood(1)
+        sample = rng.choice(cells, size=min(12, len(cells)), replace=False)
+        for c in sample:
+            got = engine_neighbors_of(m, t, cells, int(c), hood)
+            want = brute_force_neighbors_of(m, t, cells, int(c), hood)
+            assert sorted(got) == sorted(want), f"len {length} picks {picks} cell {c}"
+
+
+def test_neighbors_to_inversion():
+    m, t, cells = refined_grid((2, 2, 1), 1, [3])
+    nl = build_neighbor_lists(m, t, cells, make_neighborhood(1))
+    # to-relation is the exact inverse of the of-relation
+    of_pairs = set(zip(cells[nl.of_source].tolist(), nl.of_neighbor.tolist()))
+    to_pairs = set(zip(nl.to_neighbor.tolist(), cells[nl.to_source].tolist()))
+    assert of_pairs == to_pairs
+    # offsets negate
+    of_map = {}
+    for s, v, o in zip(cells[nl.of_source], nl.of_neighbor, nl.of_offset):
+        of_map.setdefault((int(s), int(v)), set()).add(tuple(o))
+    for v_row, c, o in zip(nl.to_source, nl.to_neighbor, nl.to_offset):
+        v = int(cells[v_row])
+        assert tuple(-np.asarray(o)) in of_map[(int(c), v)]
+
+
+def test_verify_tiling_catches_errors():
+    m = Mapping((2, 2, 2), maximum_refinement_level=1)
+    cells = np.arange(1, 9, dtype=np.uint64)
+    verify_tiling(m, cells)
+    with pytest.raises(StructureError):
+        verify_tiling(m, cells[:-1])  # gap
+    kids = m.get_all_children(np.uint64(1))
+    with pytest.raises(StructureError):
+        verify_tiling(m, np.sort(np.concatenate([cells, kids])))  # overlap
+
+
+def test_structure_error_on_gap():
+    m = Mapping((2, 1, 1))
+    t = GridTopology()
+    cells = np.array([1], dtype=np.uint64)  # cell 2 missing
+    with pytest.raises(StructureError):
+        find_neighbors_of(m, t, cells, cells, make_neighborhood(0))

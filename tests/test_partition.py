@@ -1,0 +1,64 @@
+"""Partitioner tests (the Zoltan replacement, dccrg.hpp:8482-8720)."""
+
+import numpy as np
+import pytest
+
+from dccrg_tpu import Mapping
+from dccrg_tpu.partition import hilbert_key, morton_key, partition_cells
+
+
+def test_morton_keys_unique_and_local():
+    m = Mapping((4, 4, 4))
+    cells = np.arange(1, 65, dtype=np.uint64)
+    keys = morton_key(m, cells)
+    assert len(np.unique(keys)) == 64
+    # morton of (0,0,0) is 0; of (1,0,0) is 1; of (0,1,0) is 2
+    assert keys[0] == 0 and keys[1] == 1 and keys[4] == 2
+
+
+def test_hilbert_keys_are_a_permutation_with_unit_steps():
+    m = Mapping((4, 4, 4))
+    cells = np.arange(1, 65, dtype=np.uint64)
+    keys = hilbert_key(m, cells)
+    assert len(np.unique(keys)) == 64
+    assert keys.min() == 0 and keys.max() == 63
+    # the defining Hilbert property: consecutive keys are adjacent cells
+    order = np.argsort(keys)
+    idx = m.get_indices(cells[order]).astype(np.int64)
+    steps = np.abs(np.diff(idx, axis=0)).sum(axis=1)
+    np.testing.assert_array_equal(steps, np.ones(63))
+
+
+def test_block_partition_contiguous_and_balanced():
+    m = Mapping((8, 1, 1))
+    cells = np.arange(1, 9, dtype=np.uint64)
+    owner = partition_cells(m, cells, 4, "block")
+    np.testing.assert_array_equal(owner, [0, 0, 1, 1, 2, 2, 3, 3])
+
+
+def test_weighted_partition():
+    m = Mapping((4, 1, 1))
+    cells = np.arange(1, 5, dtype=np.uint64)
+    # one heavy cell gets its own device
+    owner = partition_cells(m, cells, 2, "block", weights=np.array([3.0, 1.0, 1.0, 1.0]))
+    assert owner[0] == 0
+    assert np.all(owner[1:] == 1)
+
+
+def test_pins_override():
+    m = Mapping((8, 1, 1))
+    cells = np.arange(1, 9, dtype=np.uint64)
+    owner = partition_cells(m, cells, 4, "block", pins={1: 3, 8: 0})
+    assert owner[0] == 3 and owner[7] == 0
+    with pytest.raises(ValueError):
+        partition_cells(m, cells, 4, "block", pins={1: 9})
+
+
+def test_partition_balance_on_refined_levels():
+    m = Mapping((2, 2, 2), maximum_refinement_level=1)
+    kids = m.get_all_children(np.uint64(1))
+    cells = np.sort(np.concatenate([np.arange(2, 9, dtype=np.uint64), kids]))
+    for method in ("block", "morton", "hilbert"):
+        owner = partition_cells(m, cells, 5, method)
+        counts = np.bincount(owner, minlength=5)
+        assert counts.max() - counts.min() <= 1, method
