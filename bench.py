@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Benchmark driver: advection 3-D cell-updates/sec on TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Workload: the reference's north-star configuration (BASELINE.json) —
+tests/advection 3-D 512^3 uniform grid (max_refinement_level 0),
+first-order upwind solid-body rotation — on the real TPU chip via the
+dense fast path (dccrg_tpu/models/advection.py).
+
+Baseline: the reference repo publishes no advection numbers and cannot
+be built here (no MPI/Zoltan/boost toolchain), so the baseline is
+measured on this host: the identical math as a -O3 C++ loop
+(bench/baseline_advection.cpp), single core, scaled by a nominal
+32-core HPC node with perfect MPI scaling — a deliberately generous
+stand-in for "single-node MPI cell-updates/sec". Cached in
+bench/baseline_measured.json.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent
+NODE_CORES = 32  # nominal single-node core count for the MPI baseline
+N = int(os.environ.get("BENCH_N", "512"))
+NZ = int(os.environ.get("BENCH_NZ", str(N)))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+
+
+def measure_baseline() -> float:
+    cache = ROOT / "bench" / "baseline_measured.json"
+    if cache.exists():
+        return json.loads(cache.read_text())["single_node_cell_updates_per_sec"]
+    exe = ROOT / "bench" / "baseline_advection"
+    src = ROOT / "bench" / "baseline_advection.cpp"
+    subprocess.run(
+        ["g++", "-O3", "-march=native", "-o", str(exe), str(src)],
+        check=True, capture_output=True,
+    )
+    # modest size to keep runtime sane on one core
+    out = subprocess.run(
+        [str(exe), "256", "64", "3"], check=True, capture_output=True, text=True
+    )
+    per_core = float(out.stdout.strip())
+    result = {
+        "single_core_cell_updates_per_sec": per_core,
+        "single_node_cell_updates_per_sec": per_core * NODE_CORES,
+        "node_cores_assumed": NODE_CORES,
+    }
+    cache.write_text(json.dumps(result, indent=1))
+    return result["single_node_cell_updates_per_sec"]
+
+
+def main() -> None:
+    baseline = measure_baseline()
+
+    import jax
+    from dccrg_tpu.models.advection import PallasRotationAdvection
+
+    solver = PallasRotationAdvection(n=N, nz=NZ)
+    dt = 0.5 * solver.max_time_step()
+
+    # warmup / compile
+    solver.step(dt)
+    jax.block_until_ready(solver.rho)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        solver.step(dt)
+    jax.block_until_ready(solver.rho)
+    elapsed = time.perf_counter() - t0
+
+    n_cells = N * N * NZ
+    updates_per_sec = n_cells * STEPS * solver.steps_per_pass / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": f"advection 3D {N}^2x{NZ} cell-updates/sec/chip",
+                "value": updates_per_sec,
+                "unit": "cell-updates/s",
+                "vs_baseline": updates_per_sec / baseline,
+            }
+        )
+    )
+    # diagnostics on stderr only
+    print(
+        f"elapsed {elapsed:.3f}s for {STEPS} steps; baseline {baseline:.3g}/s "
+        f"(single-core x {NODE_CORES}); devices {jax.devices()}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+// Native CPU baseline for the advection benchmark: the same math as
+// the reference's tests/advection hot loop (solve.hpp:44-279) on a
+// uniform grid — first-order upwind fluxes with face-averaged
+// velocities — written as a plain C++ triple loop at -O3. Measures
+// single-core cell-updates/sec; bench.py scales it by a nominal node
+// core count to estimate the reference's single-node MPI throughput.
+//
+// Usage: baseline_advection N NZ STEPS
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+int main(int argc, char** argv) {
+    const int n = argc > 1 ? std::atoi(argv[1]) : 128;
+    const int nz = argc > 2 ? std::atoi(argv[2]) : 16;
+    const int steps = argc > 3 ? std::atoi(argv[3]) : 5;
+    const double dx = 1.0 / n;
+    const size_t total = (size_t)n * n * nz;
+
+    std::vector<float> rho(total), vx(total), vy(total), out(total);
+    auto idx = [&](int i, int j, int k) { return ((size_t)k * n + j) * n + i; };
+    for (int k = 0; k < nz; k++)
+        for (int j = 0; j < n; j++)
+            for (int i = 0; i < n; i++) {
+                const double x = (i + 0.5) * dx, y = (j + 0.5) * dx;
+                const double r0 = std::sqrt((x - 0.25) * (x - 0.25) + (y - 0.5) * (y - 0.5));
+                const double r = std::min(r0, 0.15) / 0.15;
+                rho[idx(i, j, k)] = 0.25f * (1.0f + std::cos(M_PI * r));
+                vx[idx(i, j, k)] = 0.5f - y;
+                vy[idx(i, j, k)] = x - 0.5f;
+            }
+
+    const float dt = 0.5f * dx / 0.71f;  // CFL vs max |v| ~ sqrt(2)/2
+    const auto t0 = std::chrono::steady_clock::now();
+    for (int s = 0; s < steps; s++) {
+        for (int k = 0; k < nz; k++)
+            for (int j = 0; j < n; j++)
+                for (int i = 0; i < n; i++) {
+                    const size_t c = idx(i, j, k);
+                    float d = rho[c];
+                    // x faces (periodic)
+                    const int im = i == 0 ? n - 1 : i - 1, ip = i == n - 1 ? 0 : i + 1;
+                    const int jm = j == 0 ? n - 1 : j - 1, jp = j == n - 1 ? 0 : j + 1;
+                    const size_t cxm = idx(im, j, k), cxp = idx(ip, j, k);
+                    const size_t cym = idx(i, jm, k), cyp = idx(i, jp, k);
+                    float vf_hi = 0.5f * (vx[c] + vx[cxp]);
+                    float vf_lo = 0.5f * (vx[cxm] + vx[c]);
+                    float fx_hi = vf_hi * (vf_hi >= 0 ? rho[c] : rho[cxp]);
+                    float fx_lo = vf_lo * (vf_lo >= 0 ? rho[cxm] : rho[c]);
+                    d += (fx_lo - fx_hi) * dt / dx;
+                    vf_hi = 0.5f * (vy[c] + vy[cyp]);
+                    vf_lo = 0.5f * (vy[cym] + vy[c]);
+                    float fy_hi = vf_hi * (vf_hi >= 0 ? rho[c] : rho[cyp]);
+                    float fy_lo = vf_lo * (vf_lo >= 0 ? rho[cym] : rho[c]);
+                    d += (fy_lo - fy_hi) * dt / dx;
+                    out[c] = d;
+                }
+        std::swap(rho, out);
+    }
+    const auto t1 = std::chrono::steady_clock::now();
+    const double secs = std::chrono::duration<double>(t1 - t0).count();
+    std::printf("%.6g\n", (double)total * steps / secs);
+    // keep the result live
+    volatile float sink = rho[total / 2];
+    (void)sink;
+    return 0;
+}

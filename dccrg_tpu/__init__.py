@@ -25,6 +25,8 @@ from .length import GridLength
 from .topology import GridTopology
 from .mapping import Mapping
 from .geometry import NoGeometry, CartesianGeometry, StretchedCartesianGeometry
+from .grid import DEFAULT_NEIGHBORHOOD_ID, Grid, default_mesh
+from .dense import DenseGrid, dense_mesh
 
 __version__ = "0.1.0"
 
@@ -37,4 +39,9 @@ __all__ = [
     "NoGeometry",
     "CartesianGeometry",
     "StretchedCartesianGeometry",
+    "Grid",
+    "DenseGrid",
+    "DEFAULT_NEIGHBORHOOD_ID",
+    "default_mesh",
+    "dense_mesh",
 ]

@@ -3,24 +3,43 @@
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on 8 virtual CPU devices (the same XLA partitioner runs on
 both backends). Must set flags before jax is imported anywhere.
+
+DCCRG_TEST_TPU=1 instead targets the real chip and runs ONLY
+tests/test_pallas_kernel.py (the rest skip).
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+# DCCRG_TEST_TPU=1 runs the suite against the real TPU chip instead of
+# the virtual CPU mesh (used for tests/test_pallas_kernel.py).
+_USE_TPU = os.environ.get("DCCRG_TEST_TPU", "") == "1"
+
+if not _USE_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+    os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax  # noqa: E402
 
-# The image's axon site hook pre-sets JAX_PLATFORMS=axon; the config
-# update overrides it reliably even if jax was touched earlier.
-jax.config.update("jax_platforms", "cpu")
+if not _USE_TPU:
+    # The image's axon site hook pre-sets JAX_PLATFORMS=axon; the config
+    # update overrides it reliably even if jax was touched earlier.
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _tpu_mode_scope(request):
+    """DCCRG_TEST_TPU=1 exists to run the Pallas kernel tests on the
+    real (single) chip; everything else is written for the 8-device
+    virtual CPU mesh and skips rather than failing on mesh setup."""
+    if _USE_TPU and "test_pallas_kernel" not in request.node.nodeid:
+        pytest.skip("CPU-mesh test; run without DCCRG_TEST_TPU")
+    yield
 
 
 @pytest.fixture(scope="session")
