@@ -677,16 +677,23 @@ class Grid:
         """
         fields_in = tuple(fields_in)
         fields_out = tuple(fields_out)
-        key = (self.plan.epoch, neighborhood_id, fields_in, fields_out, include_to, kernel)
+        key = (
+            self.plan.epoch, neighborhood_id, fields_in, fields_out, include_to,
+            kernel, len(extra_args),
+        )
         fn = self._stencil_cache.get(key)
         if fn is None:
-            fn = self._make_stencil(kernel, fields_in, fields_out, neighborhood_id, include_to)
+            fn = self._make_stencil(
+                kernel, fields_in, fields_out, neighborhood_id, include_to,
+                n_extra=len(extra_args),
+            )
             self._stencil_cache[key] = fn
         out = fn(*(self.data[n] for n in fields_in), *(self.data[n] for n in fields_out), *extra_args)
         for n, arr in zip(fields_out, out):
             self.data[n] = arr
 
-    def _make_stencil(self, kernel, fields_in, fields_out, neighborhood_id, include_to):
+    def _make_stencil(self, kernel, fields_in, fields_out, neighborhood_id, include_to,
+                      n_extra=0):
         hood = self.plan.hoods[neighborhood_id]
         L, R = self.plan.L, self.plan.R
         sh = self._sharding()
@@ -724,12 +731,12 @@ class Grid:
                 outs.append(fl[None])
             return tuple(outs)
 
-        extra_specs = (P(axis), P(axis), P(axis)) if include_to else ()
+        to_specs = (P(axis), P(axis), P(axis)) if include_to else ()
         mapped = _shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis)) + extra_specs
-            + (P(axis),) * (n_in + n_out),
+            in_specs=(P(axis), P(axis), P(axis)) + to_specs
+            + (P(axis),) * (n_in + n_out) + (P(),) * n_extra,
             out_specs=(P(axis),) * n_out,
             check_vma=False,
         )
@@ -741,6 +748,306 @@ class Grid:
             return mapped(nbr_rows, nbr_offs, nbr_mask, *args)
 
         return run
+
+    # -- load balancing (dccrg.hpp:1046-1064, 3770-4182, 8482-8720) ----
+
+    def balance_load(self, use_zoltan: bool = True) -> None:
+        """Repartition cells over devices and move their data: the
+        reference's balance_load (dccrg.hpp:1046). ``use_zoltan=False``
+        keeps the partition from pin requests only (parity with the
+        reference's flag)."""
+        self.initialize_balance_load(use_zoltan)
+        self.continue_balance_load()
+        self.finish_balance_load()
+
+    def initialize_balance_load(self, use_zoltan: bool = True) -> None:
+        """Stage 1: compute the new partition (dccrg.hpp:3770-3909).
+        SFC partitioning with weights replaces Zoltan_LB_Balance;
+        pin requests are merged in afterwards, as the reference merges
+        pins with Zoltan output (dccrg.hpp:8552-8576)."""
+        if getattr(self, "_pending_owner", None) is not None:
+            raise RuntimeError("balance_load already initialized")
+        cells = self.plan.cells
+        if use_zoltan:
+            weights = None
+            if self._weights:
+                weights = np.ones(len(cells), dtype=np.float64)
+                for cid, w in self._weights.items():
+                    pos = np.searchsorted(cells, np.uint64(cid))
+                    if pos < len(cells) and cells[pos] == np.uint64(cid):
+                        weights[pos] = w
+            new_owner = partition_cells(
+                self.mapping, cells, self.n_dev, self._lb_method,
+                weights=weights, pins=self._pins or None,
+            )
+        else:
+            new_owner = self.plan.owner.copy()
+            for cid, dest in self._pins.items():
+                pos = np.searchsorted(cells, np.uint64(cid))
+                if pos < len(cells) and cells[pos] == np.uint64(cid):
+                    new_owner[pos] = dest
+        self._pending_owner = new_owner
+
+    def continue_balance_load(self) -> None:
+        """Stage 2: transfer cell data (dccrg.hpp:3932-3964). Callable
+        repeatedly, as the reference allows for multi-stage transfers
+        of ragged payloads; data movement is folded into the final
+        restructure, so this stage is a checkpointable no-op."""
+        if getattr(self, "_pending_owner", None) is None:
+            raise RuntimeError("initialize_balance_load not called")
+
+    def finish_balance_load(self) -> None:
+        """Stage 3: install the new partition and rebuild all derived
+        structure (dccrg.hpp:3980-4182)."""
+        new_owner = getattr(self, "_pending_owner", None)
+        if new_owner is None:
+            raise RuntimeError("initialize_balance_load not called")
+        self._pending_owner = None
+        self._restructure(self.plan.cells.copy(), new_owner)
+
+    # pinning (dccrg.hpp:5913-6139)
+
+    def pin(self, cell, process: int) -> bool:
+        """Force a cell onto a device across future balance_loads."""
+        if not self.is_local(cell) or not 0 <= int(process) < self.n_dev:
+            return False
+        self._pins[int(cell)] = int(process)
+        return True
+
+    def unpin(self, cell) -> bool:
+        return self._pins.pop(int(cell), None) is not None
+
+    def unpin_local_cells(self, device: int | None = None) -> None:
+        """Remove pins of cells owned by the given device (all, when
+        None — host code sees every device)."""
+        for cid in list(self._pins):
+            if not self.is_local(cid):  # stale pin (cell gone): prune
+                del self._pins[cid]
+            elif device is None or self.get_process(cid) == device:
+                del self._pins[cid]
+
+    def unpin_all_cells(self) -> None:
+        self._pins.clear()
+
+    # cell weights (dccrg.hpp:6318-6380)
+
+    def set_cell_weight(self, cell, weight: float) -> bool:
+        if not self.is_local(cell):
+            return False
+        if weight < 0:
+            return False
+        self._weights[int(cell)] = float(weight)
+        return True
+
+    def get_cell_weight(self, cell) -> float:
+        return self._weights.get(int(cell), 1.0)
+
+    # partitioning options (dccrg.hpp:5590-5880). The SFC partitioner
+    # has no Zoltan parameter space; options are recorded for parity
+    # and 'method'/'LB_METHOD' selects the curve.
+
+    def set_partitioning_option(self, name: str, value) -> None:
+        if name.upper() in ("LB_METHOD", "METHOD"):
+            self.set_load_balancing_method(str(value))
+        self._partitioning_options[name] = value
+
+    def get_partitioning_options(self) -> dict:
+        return dict(self._partitioning_options)
+
+    # -- adaptive mesh refinement (dccrg.hpp:2456-3507, 9730-10693) ----
+
+    def refine_completely(self, cell) -> bool:
+        """Request refinement of a cell into its 8 children
+        (dccrg.hpp:2456). Committed by stop_refining()."""
+        if not self.is_local(cell):
+            return False
+        if self.mapping.get_refinement_level(np.uint64(cell)) >= self.mapping.max_refinement_level:
+            return False
+        self._refines.add(int(cell))
+        # a refine overrides pending unrefines of the sibling groups it
+        # touches (dccrg.hpp:2517-2551); resolved again at commit
+        self._unrefines.discard(int(cell))
+        return True
+
+    def unrefine_completely(self, cell) -> bool:
+        """Request removal of the cell's sibling group, replaced by the
+        parent (dccrg.hpp:2582)."""
+        if not self.is_local(cell):
+            return False
+        if self.mapping.get_refinement_level(np.uint64(cell)) == 0:
+            return False
+        if int(cell) in self._refines:
+            return False
+        self._unrefines.add(int(cell))
+        return True
+
+    def dont_refine(self, cell) -> bool:
+        """Forbid refinement (incl. induced) of the cell (dccrg.hpp:2766)."""
+        if not self.is_local(cell):
+            return False
+        self._dont_refines.add(int(cell))
+        return True
+
+    def dont_unrefine(self, cell) -> bool:
+        """Forbid unrefinement of the cell's sibling group (dccrg.hpp:2701)."""
+        if not self.is_local(cell):
+            return False
+        self._dont_unrefines.add(int(cell))
+        return True
+
+    def refine_completely_at(self, coordinate) -> bool:
+        """Coordinate variant (dccrg.hpp:3401-3470)."""
+        c = self.get_existing_cell(coordinate)
+        return bool(c != ERROR_CELL) and self.refine_completely(c)
+
+    def unrefine_completely_at(self, coordinate) -> bool:
+        c = self.get_existing_cell(coordinate)
+        return bool(c != ERROR_CELL) and self.unrefine_completely(c)
+
+    def dont_refine_at(self, coordinate) -> bool:
+        c = self.get_existing_cell(coordinate)
+        return bool(c != ERROR_CELL) and self.dont_refine(c)
+
+    def dont_unrefine_at(self, coordinate) -> bool:
+        c = self.get_existing_cell(coordinate)
+        return bool(c != ERROR_CELL) and self.dont_unrefine(c)
+
+    def stop_refining(self) -> np.ndarray:
+        """Commit all refinement requests; returns the created cells
+        (dccrg.hpp:3483-3507). Data of refined parents and removed
+        cells stays readable through get_old_data() until
+        clear_refined_unrefined_data()."""
+        from .amr import resolve_adaptation
+
+        res = resolve_adaptation(
+            self.mapping,
+            self.plan.cells,
+            self.plan.owner,
+            self.plan.hoods[DEFAULT_NEIGHBORHOOD_ID].lists,
+            self._refines,
+            self._unrefines,
+            self._dont_refines,
+            self._dont_unrefines,
+            pins=self._pins,
+            weights=self._weights,
+        )
+        self._refines.clear()
+        self._unrefines.clear()
+        self._dont_refines.clear()
+        self._dont_unrefines.clear()
+
+        # preserve data of disappearing cells for the app's projection
+        old_ids = np.concatenate([res.refined_parents, res.removed_cells])
+        self._removed_data = {}
+        if len(old_ids):
+            for name in self.fields:
+                self._removed_data[name] = (old_ids, self.get(name, old_ids))
+        else:
+            self._removed_data = {name: (old_ids, None) for name in self.fields}
+        self._removed_cells = res.removed_cells
+        self._new_cells = res.new_cells
+        self._unrefined_parents = res.unrefined_parents
+
+        self._restructure(res.cells, res.owner)
+        return res.new_cells.copy()
+
+    def _restructure(self, new_cells, new_owner):
+        """Rebuild the plan for a new cell set, carrying over the data
+        of surviving cells (the reference's rebuild at
+        dccrg.hpp:10642-10690, with data movement folded in)."""
+        old_plan = self.plan
+        host = {name: np.asarray(arr) for name, arr in self.data.items()}
+        # old (dev,row) per surviving cell
+        surviving = new_cells[np.isin(new_cells, old_plan.cells)]
+        old_dev, old_rows = self._host_rows(surviving)
+
+        self._build_plan(new_cells, new_owner)
+        new_dev, new_rows = self._host_rows(surviving)
+
+        for name, (shape, dtype) in self.fields.items():
+            arr = np.zeros((self.n_dev, self.plan.R) + shape, dtype=dtype)
+            arr[new_dev, new_rows] = host[name][old_dev, old_rows]
+            self.data[name] = jnp.asarray(arr, device=self._sharding())
+
+    def get_removed_cells(self) -> np.ndarray:
+        """Cells removed by the last stop_refining (dccrg.hpp:3519)."""
+        return self._removed_cells.copy()
+
+    def get_old_data(self, field, ids):
+        """Data of cells that disappeared in the last stop_refining
+        (refined parents and removed children) — the reference keeps
+        these reachable via grid[cell] until clear (dccrg.hpp:10355)."""
+        ids = np.atleast_1d(np.asarray(ids, dtype=np.uint64))
+        stored_ids, values = self._removed_data[field]
+        order = np.argsort(stored_ids, kind="stable")
+        sorted_ids = stored_ids[order]
+        pos = np.searchsorted(sorted_ids, ids)
+        if np.any(pos >= len(sorted_ids)) or np.any(sorted_ids[np.minimum(pos, len(sorted_ids) - 1)] != ids):
+            raise KeyError("cell not among refined/removed cells")
+        return values[order][pos]
+
+    def clear_refined_unrefined_data(self) -> None:
+        """Drop the preserved old data (dccrg.hpp:5550)."""
+        self._removed_data = {}
+        self._removed_cells = np.empty(0, np.uint64)
+        self._new_cells = np.empty(0, np.uint64)
+
+    # vectorized projection helpers (the idiomatic TPU versions of the
+    # per-cell loops in tests/advection/adapter.hpp:229-301)
+
+    def assign_children_from_parents(self, fields=None) -> None:
+        """Copy each new child's value from its refined parent."""
+        if len(self._new_cells) == 0:
+            return
+        parents = self.mapping.get_parent(self._new_cells)
+        for name in fields if fields is not None else self.fields:
+            self.set(name, self._new_cells, self.get_old_data(name, parents))
+
+    def average_parents_from_children(self, fields=None) -> None:
+        """Set each unrefined parent to the mean of its removed children."""
+        if len(self._removed_cells) == 0:
+            return
+        parents = self._unrefined_parents
+        kids = self.mapping.get_all_children(parents)  # [n, 8]
+        for name in fields if fields is not None else self.fields:
+            vals = self.get_old_data(name, kids.reshape(-1))
+            fshape = vals.shape[1:]
+            vals = vals.reshape((len(parents), 8) + fshape).mean(axis=1)
+            self.set(name, parents, vals)
+
+    def load_cells(self, cells) -> None:
+        """Replace the grid structure with an arbitrary valid cell set
+        (the reference's load_cells, dccrg.hpp:3669-3738); data of all
+        cells is reset."""
+        from .neighbors import verify_tiling
+        from .partition import partition_cells
+
+        cells = np.sort(np.asarray(cells, dtype=np.uint64))
+        verify_tiling(self.mapping, cells)
+        owner = partition_cells(
+            self.mapping, cells, self.n_dev, self._lb_method, pins=self._pins or None
+        )
+        self._build_plan(cells, owner)
+        self._allocate_fields()
+
+    # -- VTK output (dccrg.hpp:3320-3392) ------------------------------
+
+    def write_vtk_file(self, filename: str, fields=None) -> None:
+        from .utils.vtk import write_vtk_file
+
+        write_vtk_file(self, filename, fields=fields)
+
+    # -- checkpoint / restart (dccrg.hpp:1109-2426) --------------------
+
+    def save_grid_data(self, filename: str, header: bytes = b"") -> None:
+        from .checkpoint import save_grid_data
+
+        save_grid_data(self, filename, header)
+
+    def load_grid_data(self, filename: str, header_size: int = 0) -> bytes:
+        from .checkpoint import load_grid_data
+
+        return load_grid_data(self, filename, header_size)
 
     # -- misc parity ---------------------------------------------------
 

@@ -1,0 +1,6 @@
+"""Auxiliary subsystems: observability, VTK dumps, profiling."""
+
+from .profiling import PhaseTimer
+from .vtk import write_vtk_file
+
+__all__ = ["PhaseTimer", "write_vtk_file"]

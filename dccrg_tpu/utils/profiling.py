@@ -1,0 +1,75 @@
+"""Per-phase timing and transfer accounting.
+
+The reference has no profiling subsystem; apps time phases with
+MPI_Wtime and reduce min/avg/max over ranks
+(tests/advection/2d.cpp:330-340, 453-503) and compute halo bandwidth
+from the grid's transfer counters (2d.cpp:345-350). This module gives
+the same measurements a home: ``PhaseTimer`` accumulates named phase
+durations (synchronizing the device so numbers mean something), and
+``halo_bytes_per_update`` mirrors the B/s accounting.
+
+For deep kernel analysis use ``jax.profiler`` traces; this is the
+lightweight always-on layer.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+import numpy as np
+
+
+class PhaseTimer:
+    def __init__(self, sync=None):
+        """``sync``: optional callable blocking until device work
+        finishes (e.g. ``lambda: jax.block_until_ready(arr)``)."""
+        self._sync = sync
+        self.totals = defaultdict(float)
+        self.counts = defaultdict(int)
+
+    @contextmanager
+    def phase(self, name: str):
+        if self._sync:
+            self._sync()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if self._sync:
+                self._sync()
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def report(self) -> dict:
+        """{phase: {total, count, mean}} — the avg the reference prints
+        per rank; min/max over ranks is meaningless on one host."""
+        return {
+            k: {"total": self.totals[k], "count": self.counts[k],
+                "mean": self.totals[k] / max(self.counts[k], 1)}
+            for k in self.totals
+        }
+
+    def __repr__(self):
+        rows = [
+            f"{k}: {v['total']:.4f}s / {v['count']} = {v['mean'] * 1e3:.2f}ms"
+            for k, v in sorted(self.report().items())
+        ]
+        return "PhaseTimer(" + "; ".join(rows) + ")"
+
+
+def halo_bytes_per_update(grid, neighborhood_id=None, fields=None) -> int:
+    """Bytes moved by one update_copies_of_remote_neighbors call (the
+    reference's get_number_of_update_send_cells x payload size,
+    tests/advection/2d.cpp:345-350)."""
+    from ..grid import DEFAULT_NEIGHBORHOOD_ID
+
+    hood_id = neighborhood_id if neighborhood_id is not None else DEFAULT_NEIGHBORHOOD_ID
+    n_cells = grid.get_number_of_update_send_cells(hood_id)
+    names = fields if fields is not None else list(grid.fields)
+    per_cell = 0
+    for name in names:
+        shape, dtype = grid.fields[name]
+        per_cell += int(np.prod(shape, dtype=np.int64) if shape else 1) * dtype.itemsize
+    return n_cells * per_cell

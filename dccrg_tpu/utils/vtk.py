@@ -1,0 +1,56 @@
+"""VTK output for visual inspection.
+
+Equivalent of the reference's ``write_vtk_file`` (dccrg.hpp:3320-3392)
+and the dc2vtk converters: an ASCII unstructured-grid dump of the leaf
+cells, one hexahedron (VTK_VOXEL) per cell, with optional per-cell
+scalar fields appended as CELL_DATA.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def write_vtk_file(grid, filename: str, fields=None, title: str = "dccrg_tpu") -> None:
+    """Write all cells (the reference writes each rank's local cells to
+    its own file; host code here sees the whole grid)."""
+    cells = grid.get_cells()
+    mins = grid.geometry.get_min(cells)
+    maxs = grid.geometry.get_max(cells)
+    n = len(cells)
+
+    # 8 corners per cell in VTK_VOXEL order (x fastest, then y, then z)
+    corners = np.empty((n, 8, 3))
+    k = np.arange(8)
+    cx = (k & 1).astype(bool)
+    cy = ((k >> 1) & 1).astype(bool)
+    cz = ((k >> 2) & 1).astype(bool)
+    for d, flags in enumerate((cx, cy, cz)):
+        corners[:, :, d] = np.where(flags[None, :], maxs[:, d : d + 1], mins[:, d : d + 1])
+
+    with open(filename, "w") as f:
+        f.write("# vtk DataFile Version 2.0\n")
+        f.write(f"{title}\n")
+        f.write("ASCII\nDATASET UNSTRUCTURED_GRID\n")
+        f.write(f"POINTS {8 * n} float\n")
+        np.savetxt(f, corners.reshape(-1, 3), fmt="%.9g")
+        f.write(f"CELLS {n} {9 * n}\n")
+        conn = np.column_stack(
+            [np.full(n, 8, dtype=np.int64), np.arange(8 * n).reshape(n, 8)]
+        )
+        np.savetxt(f, conn, fmt="%d")
+        f.write(f"CELL_TYPES {n}\n")
+        np.savetxt(f, np.full(n, 11, dtype=np.int64), fmt="%d")  # VTK_VOXEL
+
+        names = list(fields) if fields else []
+        if names:
+            f.write(f"CELL_DATA {n}\n")
+            # cell ids first, like the reference's dc2vtk output
+            f.write("SCALARS cell_id double 1\nLOOKUP_TABLE default\n")
+            np.savetxt(f, cells.astype(np.float64), fmt="%.9g")
+            for name in names:
+                vals = np.asarray(grid.get(name, cells), dtype=np.float64).reshape(n, -1)
+                if vals.shape[1] != 1:
+                    continue  # only scalar fields in v1
+                f.write(f"SCALARS {name} double 1\nLOOKUP_TABLE default\n")
+                np.savetxt(f, vals[:, 0], fmt="%.9g")

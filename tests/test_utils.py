@@ -1,0 +1,56 @@
+"""VTK output and profiling utility tests."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dccrg_tpu.grid import Grid
+from dccrg_tpu.utils import PhaseTimer
+from dccrg_tpu.utils.profiling import halo_bytes_per_update
+
+
+def make_grid(length=(2, 2, 1), n_dev=2, max_lvl=0):
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("dev",))
+    return (
+        Grid(cell_data={"v": jnp.float32})
+        .set_initial_length(length)
+        .set_maximum_refinement_level(max_lvl)
+        .initialize(mesh)
+    )
+
+
+def test_vtk_output(tmp_path):
+    g = make_grid((2, 2, 1), max_lvl=1)
+    g.refine_completely(1)
+    g.stop_refining()
+    cells = g.get_cells()
+    g.set("v", cells, np.arange(len(cells), dtype=np.float32))
+    fn = str(tmp_path / "out.vtk")
+    g.write_vtk_file(fn, fields=["v"])
+    text = open(fn).read()
+    assert "UNSTRUCTURED_GRID" in text
+    assert f"CELLS {len(cells)}" in text
+    assert "SCALARS v double 1" in text
+    # refined cell 1 is gone; its 8 children present as voxels
+    assert f"POINTS {8 * len(cells)} float" in text
+
+
+def test_phase_timer():
+    t = PhaseTimer()
+    with t.phase("solve"):
+        sum(range(1000))
+    with t.phase("solve"):
+        pass
+    rep = t.report()
+    assert rep["solve"]["count"] == 2
+    assert rep["solve"]["total"] >= 0
+
+
+def test_halo_bytes_accounting():
+    g = make_grid((8, 1, 1), n_dev=4)
+    n = g.get_number_of_update_send_cells()
+    assert halo_bytes_per_update(g) == n * 4  # one f32 field
+    assert halo_bytes_per_update(g, fields=[]) == 0
