@@ -130,6 +130,11 @@ def resolve_adaptation(
             dont_unref[i] = True
 
     # --- override_unrefines (dccrg.hpp:9935-10124) ---------------------
+    # one pass over the pair arrays: per cell, the maximum
+    # post-refinement level found anywhere in its neighborhood
+    max_nbr_final = np.full(n, -1, dtype=np.int64)
+    if len(unref_parent):
+        np.maximum.at(max_nbr_final, pair_src, final_lvl[pair_nbr])
     accepted_parents = []
     for parent in sorted(unref_parent):
         kids = mapping.get_all_children(np.uint64(parent))
@@ -150,8 +155,7 @@ def resolve_adaptation(
         # its children's neighborhoods: no neighbor with final level
         # > child level may exist
         child_lvl = lvl[kid_idx[0]]
-        sel = np.isin(pair_src, kid_idx)
-        if np.any(final_lvl[pair_nbr[sel]] > child_lvl):
+        if max_nbr_final[kid_idx].max() > child_lvl:
             continue
         accepted_parents.append(parent)
 

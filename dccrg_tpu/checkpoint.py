@@ -130,6 +130,11 @@ def load_grid_data(grid, filename: str, header_size: int = 0) -> bytes:
         )
     if geometry.geometry_id != grid.geometry.geometry_id:
         raise ValueError("file geometry kind does not match the grid")
+    if geometry.to_bytes() != grid.geometry.to_bytes():
+        raise ValueError(
+            "file geometry parameters do not match the grid (same kind, "
+            "different start/cell lengths or coordinate arrays)"
+        )
 
     pairs = np.frombuffer(data, dtype=np.uint64, count=2 * n_cells, offset=pos).reshape(-1, 2)
     cells = pairs[:, 0].copy()

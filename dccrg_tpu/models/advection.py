@@ -50,8 +50,8 @@ class PallasRotationAdvection:
     dense path (cross-checked in tests), at HBM-bandwidth-limited
     throughput."""
 
-    def __init__(self, n=512, nz=None, dtype=jnp.float32, cfl=0.5, steps_per_pass=4,
-                 tile=(8, 128)):
+    def __init__(self, n=512, nz=None, dtype=jnp.float32, cfl=0.5, steps_per_pass=7,
+                 tile=(32, 128)):
         from ..ops.advection_kernel import make_rotation_step
 
         nz = nz if nz is not None else n

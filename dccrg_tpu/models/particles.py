@@ -140,16 +140,27 @@ class ParticleModel:
         # cell (adjacency is symmetric for radius-1 windows), and each
         # exactly once on uniform grids — including neighbors_to as
         # well would double-collect under a symmetric neighborhood.
+        #
+        # Capacity overflow is the resize() moment of the reference's
+        # two-phase transfer: snapshot the buffers first, and if any
+        # cell overflows, roll back, grow capacity to what the counts
+        # demanded, and redo the collect — no particle is ever dropped.
+        snap_pos, snap_cnt = g.data["pos"], g.data["count"]
         g.update_copies_of_remote_neighbors(fields=["pos", "count"])
         g.apply_stencil(
             self._collect_kernel,
             ["pos", "count", "cell_min", "cell_max"],
             ["pos", "count", "overflow"],
         )
-        if int(jnp.max(g.data["overflow"])) > 0:
-            raise RuntimeError(
-                "particle capacity exceeded; call ensure_capacity() with a "
-                "larger bound (host replanning event)"
+        max_over = int(jnp.max(g.data["overflow"]))
+        if max_over > 0:
+            g.data["pos"], g.data["count"] = snap_pos, snap_cnt
+            self.ensure_capacity(self.capacity + max_over)
+            g.update_copies_of_remote_neighbors(fields=["pos", "count"])
+            g.apply_stencil(
+                self._collect_kernel,
+                ["pos", "count", "cell_min", "cell_max"],
+                ["pos", "count", "overflow"],
             )
 
     def _collect_kernel(self, cell, nbr, offs, mask):

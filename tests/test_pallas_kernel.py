@@ -43,7 +43,7 @@ def on_tpu():
 
 
 @pytest.mark.skipif(not on_tpu(), reason="pallas TPU kernel needs a TPU device")
-@pytest.mark.parametrize("steps_per_pass", [1, 2, 4])
+@pytest.mark.parametrize("steps_per_pass", [1, 2, 4, 7])
 def test_pallas_matches_reference_math(steps_per_pass):
     from dccrg_tpu.ops.advection_kernel import make_rotation_step
 

@@ -53,7 +53,7 @@ def test_particles_leave_grid():
     assert len(m.particles()) == 0  # advected out of the non-periodic grid
 
 
-def test_capacity_overflow_detected():
+def test_capacity_overflow_grows_and_preserves_particles():
     def converge(pos):
         # everything is pulled toward x = 2.25, landing inside cell 3
         v = jnp.zeros_like(pos)
@@ -61,9 +61,15 @@ def test_capacity_overflow_detected():
 
     m = ParticleModel(converge, length=(4, 1, 1), capacity=2, mesh=mesh1(1))
     m.add_particles([[0.7, 0.5, 0.5], [1.2, 0.3, 0.5], [2.7, 0.5, 0.5], [3.2, 0.6, 0.5]])
-    with pytest.raises(RuntimeError, match="capacity"):
-        for _ in range(8):
-            m.step(0.4)
+    for _ in range(8):
+        m.step(0.4)
+    # particles converge on x=2.25, overflowing the capacity-2 buffer:
+    # the buffer must have grown (a rolled-back replanning event) and
+    # no particle may be lost
+    assert m.capacity > 2
+    got = m.particles()
+    assert len(got) == 4
+    assert np.all(np.abs(got[:, 0] - 2.25) < 0.6)
 
 
 def test_ensure_capacity_grows_buffers():
