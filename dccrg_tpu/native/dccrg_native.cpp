@@ -1,0 +1,377 @@
+// Native host runtime for dccrg_tpu.
+//
+// C++ equivalents of the host-side structure code that the reference
+// implements in C++ (dccrg is a header-only C++ library): the AMR cell
+// addressing scheme (dccrg_mapping.hpp), the neighbor-table builder
+// (dccrg.hpp:4236-4897 find_neighbors_of / find_neighbors_to), and the
+// space-filling-curve keys used for partitioning (dccrg.hpp:8147-8220,
+// sfc++ replacement).  These run at structure-change events (init,
+// refine, balance) on the host; results are identical to the NumPy
+// reference implementations in ../neighbors.py and ../partition.py,
+// which remain as fallback and as the cross-check used by the tests.
+//
+// Exposed as a plain C ABI for ctypes (the image has no pybind11).
+// All output buffers are caller-allocated; functions that emit ragged
+// output take a capacity and return the required entry count so the
+// caller can retry with a larger buffer (entries beyond capacity are
+// counted, not written).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Mapping: 1-based, level-major cell ids (parity with dccrg_mapping.hpp).
+
+struct DnMapping {
+  uint64_t length[3];       // level-0 extents
+  int32_t max_lvl;          // maximum refinement level
+  uint64_t level_first[32]; // first cell id of each level (1-based)
+  uint64_t last_cell;
+  uint64_t index_length[3]; // extents in smallest-cell index units
+};
+
+static void dn_mapping_init(DnMapping *m, const uint64_t length[3],
+                            int32_t max_lvl) {
+  m->length[0] = length[0];
+  m->length[1] = length[1];
+  m->length[2] = length[2];
+  m->max_lvl = max_lvl;
+  const uint64_t gl = length[0] * length[1] * length[2];
+  uint64_t acc = 1, per = gl;
+  for (int l = 0; l <= max_lvl; ++l) {
+    m->level_first[l] = acc;
+    acc += per;
+    per *= 8;
+  }
+  m->last_cell = acc - 1;
+  for (int d = 0; d < 3; ++d)
+    m->index_length[d] = length[d] << (uint64_t)max_lvl;
+}
+
+static inline int32_t dn_level(const DnMapping *m, uint64_t cell) {
+  if (cell == 0 || cell > m->last_cell)
+    return -1;
+  for (int l = m->max_lvl; l >= 0; --l)
+    if (cell >= m->level_first[l])
+      return l;
+  return -1;
+}
+
+// indices (smallest-cell units) of a cell known to be valid at level lvl
+static inline void dn_indices(const DnMapping *m, uint64_t cell, int32_t lvl,
+                              uint64_t out[3]) {
+  const uint64_t within = cell - m->level_first[lvl];
+  const uint64_t lx = m->length[0] << (uint64_t)lvl;
+  const uint64_t ly = m->length[1] << (uint64_t)lvl;
+  const uint64_t shift = (uint64_t)(m->max_lvl - lvl);
+  out[0] = (within % lx) << shift;
+  out[1] = ((within / lx) % ly) << shift;
+  out[2] = (within / (lx * ly)) << shift;
+}
+
+// cell id at given smallest-cell indices and refinement level
+// (indices must be inside the grid, lvl in [0, max_lvl])
+static inline uint64_t dn_cell_from_indices(const DnMapping *m,
+                                            const uint64_t idx[3],
+                                            int32_t lvl) {
+  const uint64_t shift = (uint64_t)(m->max_lvl - lvl);
+  const uint64_t ox = idx[0] >> shift, oy = idx[1] >> shift,
+                 oz = idx[2] >> shift;
+  const uint64_t lx = m->length[0] << (uint64_t)lvl;
+  const uint64_t ly = m->length[1] << (uint64_t)lvl;
+  return m->level_first[lvl] + ox + oy * lx + oz * lx * ly;
+}
+
+// ---------------------------------------------------------------------------
+// Neighbor-table builder (semantics of dccrg.hpp:4375-4716; algorithm of
+// ../neighbors.py::find_neighbors_of: binary search in the sorted
+// replicated leaf-cell set instead of walking per-cell links).
+
+static inline bool dn_exists(const uint64_t *cells, int64_t n, uint64_t id) {
+  const uint64_t *p = std::lower_bound(cells, cells + n, id);
+  return p != cells + n && *p == id;
+}
+
+// Per-(cell, neighborhood-item) resolution. Writes up to 8 entries into
+// nbr/off (off is the neighbor's min-corner displacement in
+// smallest-cell units, logical i.e. unwrapped across periodic faces).
+// Returns entry count, or a negative error code:
+//   -1 window not covered at max level (grid does not tile)
+//   -2 window neither same-level, coarser, nor tiled by children
+static inline int dn_resolve_window(
+    const DnMapping *m, const uint8_t periodic[3], const uint64_t *cells,
+    int64_t n_cells, const int64_t base[3], int64_t size, int32_t lvl,
+    const int64_t hood[3], uint64_t nbr[8], int64_t off[8][3]) {
+  int64_t win[3];
+  uint64_t wrapped[3];
+  for (int d = 0; d < 3; ++d) {
+    win[d] = base[d] + hood[d] * size;
+    const int64_t il = (int64_t)m->index_length[d];
+    if (periodic[d]) {
+      int64_t w = win[d] % il;
+      if (w < 0)
+        w += il;
+      wrapped[d] = (uint64_t)w;
+    } else {
+      if (win[d] < 0 || win[d] >= il)
+        return 0; // outside a non-periodic boundary: no neighbor
+      wrapped[d] = (uint64_t)win[d];
+    }
+  }
+
+  // same-level cell occupying the window
+  const uint64_t slot = dn_cell_from_indices(m, wrapped, lvl);
+  if (dn_exists(cells, n_cells, slot)) {
+    nbr[0] = slot;
+    for (int d = 0; d < 3; ++d)
+      off[0][d] = hood[d] * size;
+    return 1;
+  }
+
+  // coarser (level-1) cell containing the window
+  if (lvl > 0) {
+    const uint64_t coarse = dn_cell_from_indices(m, wrapped, lvl - 1);
+    if (dn_exists(cells, n_cells, coarse)) {
+      const uint64_t csize = 2 * (uint64_t)size;
+      nbr[0] = coarse;
+      for (int d = 0; d < 3; ++d) {
+        const int64_t cmin = (int64_t)((wrapped[d] / csize) * csize);
+        off[0][d] = hood[d] * size + (cmin - (int64_t)wrapped[d]);
+      }
+      return 1;
+    }
+  }
+
+  // finer: the window's 8 child cells in z-order (x fastest)
+  if (lvl >= m->max_lvl)
+    return -1;
+  const int64_t half = size / 2;
+  for (int k = 0; k < 8; ++k) {
+    const int64_t rel[3] = {(k & 1) * half, ((k >> 1) & 1) * half,
+                            ((k >> 2) & 1) * half};
+    uint64_t cidx[3];
+    for (int d = 0; d < 3; ++d)
+      cidx[d] = wrapped[d] + (uint64_t)rel[d];
+    const uint64_t child = dn_cell_from_indices(m, cidx, lvl + 1);
+    if (!dn_exists(cells, n_cells, child))
+      return -2;
+    nbr[k] = child;
+    for (int d = 0; d < 3; ++d)
+      off[k][d] = hood[d] * size + rel[d];
+  }
+  return 8;
+}
+
+// neighbors_of for query_cells against the complete sorted leaf-cell
+// set.  Output entries are ordered (query position, neighborhood item,
+// z-order child rank) — identical to the NumPy engine's lexsort order.
+// Returns the total entry count (may exceed capacity; entries past
+// capacity are not written), or negative on error with the offending
+// (cell, item) in err_cell/err_item:
+//   -1 tiling gap at max refinement level
+//   -2 2:1 balance violation or gap
+//   -3 invalid cell id in query
+int64_t dn_find_neighbors_of(
+    const uint64_t grid_length[3], int32_t max_lvl, const uint8_t periodic[3],
+    const uint64_t *cells_sorted, int64_t n_cells, const uint64_t *query,
+    int64_t n_query, const int64_t *hood, int64_t n_hood, int64_t *out_src,
+    uint64_t *out_nbr, int64_t *out_off, int64_t *out_item, int64_t capacity,
+    uint64_t *err_cell, int64_t *err_item) {
+  DnMapping m;
+  dn_mapping_init(&m, grid_length, max_lvl);
+
+  // pass 1: per-query entry counts (parallel)
+  std::vector<int64_t> counts((size_t)n_query, 0);
+  int64_t err_flag = 0; // 0 ok, else -1/-2/-3
+  int64_t err_q = -1, err_k = -1;
+
+#pragma omp parallel for schedule(static)
+  for (int64_t q = 0; q < n_query; ++q) {
+    int64_t seen_err;
+#pragma omp atomic read
+    seen_err = err_flag;
+    if (seen_err)
+      continue;
+    const uint64_t cell = query[q];
+    const int32_t lvl = dn_level(&m, cell);
+    if (lvl < 0) {
+#pragma omp critical
+      {
+        if (!err_flag) {
+          err_q = q;
+          err_k = 0;
+#pragma omp atomic write
+          err_flag = -3;
+        }
+      }
+      continue;
+    }
+    const int64_t size = (int64_t)1 << (uint64_t)(max_lvl - lvl);
+    uint64_t bidx[3];
+    dn_indices(&m, cell, lvl, bidx);
+    const int64_t base[3] = {(int64_t)bidx[0], (int64_t)bidx[1],
+                             (int64_t)bidx[2]};
+    int64_t cnt = 0;
+    uint64_t nbr[8];
+    int64_t off[8][3];
+    for (int64_t k = 0; k < n_hood; ++k) {
+      const int r = dn_resolve_window(&m, periodic, cells_sorted, n_cells,
+                                      base, size, lvl, &hood[3 * k], nbr, off);
+      if (r < 0) {
+#pragma omp critical
+        {
+          if (!err_flag) {
+            err_q = q;
+            err_k = k;
+#pragma omp atomic write
+            err_flag = r;
+          }
+        }
+        break;
+      }
+      cnt += r;
+    }
+    counts[(size_t)q] = cnt;
+  }
+  if (err_flag) {
+    if (err_cell)
+      *err_cell = query[err_q];
+    if (err_item)
+      *err_item = err_k;
+    return err_flag;
+  }
+
+  // prefix sum
+  std::vector<int64_t> starts((size_t)n_query + 1);
+  starts[0] = 0;
+  for (int64_t q = 0; q < n_query; ++q)
+    starts[(size_t)q + 1] = starts[(size_t)q] + counts[(size_t)q];
+  const int64_t total = starts[(size_t)n_query];
+  if (total > capacity)
+    return total; // caller re-allocates and retries
+
+  // pass 2: fill (parallel, deterministic via per-query offsets)
+#pragma omp parallel for schedule(static)
+  for (int64_t q = 0; q < n_query; ++q) {
+    const uint64_t cell = query[q];
+    const int32_t lvl = dn_level(&m, cell);
+    const int64_t size = (int64_t)1 << (uint64_t)(max_lvl - lvl);
+    uint64_t bidx[3];
+    dn_indices(&m, cell, lvl, bidx);
+    const int64_t base[3] = {(int64_t)bidx[0], (int64_t)bidx[1],
+                             (int64_t)bidx[2]};
+    int64_t w = starts[(size_t)q];
+    uint64_t nbr[8];
+    int64_t off[8][3];
+    for (int64_t k = 0; k < n_hood; ++k) {
+      const int r = dn_resolve_window(&m, periodic, cells_sorted, n_cells,
+                                      base, size, lvl, &hood[3 * k], nbr, off);
+      for (int j = 0; j < r; ++j, ++w) {
+        out_src[w] = q;
+        out_nbr[w] = nbr[j];
+        out_off[3 * w + 0] = off[j][0];
+        out_off[3 * w + 1] = off[j][1];
+        out_off[3 * w + 2] = off[j][2];
+        out_item[w] = k;
+      }
+    }
+  }
+  return total;
+}
+
+// ---------------------------------------------------------------------------
+// Space-filling-curve keys over cell min-corner indices (sfc++ / HSFC
+// replacement; parity with ../partition.py::morton_key / hilbert_key).
+
+// Morton: bit-interleave (x lowest) at smallest-cell resolution.
+void dn_morton_keys(const uint64_t *indices, int64_t n, int32_t bits,
+                    uint64_t *out) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t key = 0;
+    for (int32_t b = 0; b < bits; ++b)
+      for (int d = 0; d < 3; ++d)
+        key |= ((indices[3 * i + d] >> (uint64_t)b) & 1u)
+               << (uint64_t)(3 * b + d);
+    out[i] = key;
+  }
+}
+
+// Hilbert: Skilling's transpose algorithm (3-D).
+void dn_hilbert_keys(const uint64_t *indices, int64_t n, int32_t bits,
+                     uint64_t *out) {
+  const uint64_t N = (uint64_t)1 << (uint64_t)bits;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t x[3] = {indices[3 * i], indices[3 * i + 1], indices[3 * i + 2]};
+    // Gray-decode: inverse undo excess work
+    for (uint64_t q = N >> 1; q > 1; q >>= 1) {
+      const uint64_t p = q - 1;
+      for (int d = 0; d < 3; ++d) {
+        if (x[d] & q) {
+          x[0] ^= p;
+        } else {
+          const uint64_t t = (x[0] ^ x[d]) & p;
+          x[0] ^= t;
+          x[d] ^= t;
+        }
+      }
+    }
+    // Gray encode
+    for (int d = 1; d < 3; ++d)
+      x[d] ^= x[d - 1];
+    uint64_t t = 0;
+    for (uint64_t q = N >> 1; q > 1; q >>= 1)
+      if (x[2] & q)
+        t ^= q - 1;
+    for (int d = 0; d < 3; ++d)
+      x[d] ^= t;
+    // interleave transpose form, MSB first, dim 0 highest per group
+    uint64_t key = 0;
+    for (int32_t b = bits - 1; b >= 0; --b)
+      for (int d = 0; d < 3; ++d)
+        key = (key << 1) | ((x[d] >> (uint64_t)b) & 1u);
+    out[i] = key;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Vectorized mapping queries (host-side bulk id math).
+
+// refinement level per cell (-1 for invalid ids)
+void dn_refinement_levels(const uint64_t grid_length[3], int32_t max_lvl,
+                          const uint64_t *cells, int64_t n, int32_t *out) {
+  DnMapping m;
+  dn_mapping_init(&m, grid_length, max_lvl);
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = dn_level(&m, cells[i]);
+}
+
+// (n,3) min-corner indices per cell; all-ones rows (~0) for invalid ids
+void dn_cell_indices(const uint64_t grid_length[3], int32_t max_lvl,
+                     const uint64_t *cells, int64_t n, uint64_t *out) {
+  DnMapping m;
+  dn_mapping_init(&m, grid_length, max_lvl);
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t lvl = dn_level(&m, cells[i]);
+    if (lvl < 0) {
+      out[3 * i] = out[3 * i + 1] = out[3 * i + 2] = ~(uint64_t)0;
+    } else {
+      dn_indices(&m, cells[i], lvl, &out[3 * i]);
+    }
+  }
+}
+
+int32_t dn_abi_version(void) { return 1; }
+
+} // extern "C"

@@ -121,7 +121,31 @@ def find_neighbors_of(
 
     ``all_cells_sorted`` must be the complete sorted leaf-cell set of
     the grid (replicated structure).
+
+    Dispatches to the native C++ engine (dccrg_tpu/native) when built;
+    the NumPy implementation below is the reference and fallback.
     """
+    from . import native
+
+    if native.lib is not None and len(np.atleast_1d(query_cells)) > 0:
+        index_length = mapping.get_index_length().astype(np.int64)
+        if not np.any(index_length >= _MAX_INDEX):
+            return native.find_neighbors_of(
+                mapping, topology, all_cells_sorted, query_cells, neighborhood
+            )
+    return _find_neighbors_of_numpy(
+        mapping, topology, all_cells_sorted, query_cells, neighborhood
+    )
+
+
+def _find_neighbors_of_numpy(
+    mapping: Mapping,
+    topology: GridTopology,
+    all_cells_sorted: np.ndarray,
+    query_cells: np.ndarray,
+    neighborhood: np.ndarray,
+):
+    """Pure-NumPy neighbor resolution (reference implementation)."""
     query_cells = np.asarray(query_cells, dtype=np.uint64)
     neighborhood = np.asarray(neighborhood, dtype=np.int64).reshape(-1, 3)
     n, k = len(query_cells), len(neighborhood)

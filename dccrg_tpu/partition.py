@@ -36,6 +36,10 @@ def morton_key(mapping: Mapping, cells: np.ndarray) -> np.ndarray:
     bits = max(int(x).bit_length() for x in mapping.get_index_length())
     if 3 * bits > 63:
         raise ValueError("grid too large for 63-bit Morton keys")
+    from . import native
+
+    if native.lib is not None:
+        return native.sfc_keys(idx, bits, "morton")
     key = np.zeros(len(idx), dtype=np.uint64)
     for b in range(bits):
         for d in range(3):
@@ -51,6 +55,10 @@ def hilbert_key(mapping: Mapping, cells: np.ndarray) -> np.ndarray:
     bits = max(int(x).bit_length() for x in mapping.get_index_length())
     if 3 * bits > 63:
         raise ValueError("grid too large for 63-bit Hilbert keys")
+    from . import native
+
+    if native.lib is not None:
+        return native.sfc_keys(idx, bits, "hilbert")
     x = idx.copy()  # [n, 3] "transpose" form, modified in place
     n = np.uint64(1) << np.uint64(bits)
     # Gray-decode: inverse undo excess work (Skilling's algorithm)

@@ -1,0 +1,106 @@
+"""Native (C++) host runtime vs the NumPy reference implementations.
+
+The native library (dccrg_tpu/native/dccrg_native.cpp) re-implements the
+host-side structure code — neighbor-table builder, SFC keys — that the
+reference keeps in C++ (dccrg.hpp:4375-4716, :8147-8220). These tests
+assert bit-identical results between the two engines on uniform and
+refined grids, and that errors carry the same semantics.
+"""
+
+import numpy as np
+import pytest
+
+from dccrg_tpu import native
+from dccrg_tpu.mapping import Mapping
+from dccrg_tpu.neighbors import (
+    StructureError,
+    _find_neighbors_of_numpy,
+    make_neighborhood,
+)
+from dccrg_tpu.partition import hilbert_key, morton_key
+from dccrg_tpu.topology import GridTopology
+
+pytestmark = pytest.mark.skipif(
+    native.lib is None, reason="native library failed to build"
+)
+
+
+def _refined_cell_set(mapping):
+    """Leaf set with one level-0 cell refined (2:1-valid)."""
+    level0 = np.arange(1, mapping.length.total_level0_cells + 1, dtype=np.uint64)
+    target = level0[0]
+    children = mapping.get_all_children(target)
+    cells = np.concatenate([level0[level0 != target], children])
+    return np.sort(cells)
+
+
+@pytest.mark.parametrize("hood_len", [0, 1, 2])
+@pytest.mark.parametrize("periodic", [(False, False, False), (True, True, True)])
+def test_uniform_matches_numpy(hood_len, periodic):
+    mapping = Mapping((5, 4, 3), 0)
+    topology = GridTopology(periodic)
+    cells = np.arange(1, 5 * 4 * 3 + 1, dtype=np.uint64)
+    hood = make_neighborhood(hood_len)
+    got = native.find_neighbors_of(mapping, topology, cells, cells, hood)
+    want = _find_neighbors_of_numpy(mapping, topology, cells, cells, hood)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+@pytest.mark.parametrize("periodic", [(False, False, False), (True, False, True)])
+def test_refined_matches_numpy(periodic):
+    mapping = Mapping((4, 4, 4), 2)
+    topology = GridTopology(periodic)
+    # uniform level-1 grid, then one level-1 cell refined to level 2
+    # (keeps every neighbor pair within 1 refinement level)
+    level0 = np.arange(1, 4 * 4 * 4 + 1, dtype=np.uint64)
+    level1 = mapping.get_all_children(level0).ravel()
+    one = level1[21]
+    cells = np.sort(
+        np.concatenate([level1[level1 != one], mapping.get_all_children(one)])
+    )
+    hood = make_neighborhood(1)
+    got = native.find_neighbors_of(mapping, topology, cells, cells, hood)
+    want = _find_neighbors_of_numpy(mapping, topology, cells, cells, hood)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_gap_raises_structure_error():
+    mapping = Mapping((3, 3, 3), 0)
+    topology = GridTopology((False, False, False))
+    cells = np.arange(1, 28, dtype=np.uint64)
+    broken = cells[cells != 14]  # remove the middle cell
+    hood = make_neighborhood(1)
+    with pytest.raises(StructureError):
+        native.find_neighbors_of(mapping, topology, broken, broken, hood)
+    with pytest.raises(StructureError):
+        _find_neighbors_of_numpy(mapping, topology, broken, broken, hood)
+
+
+def test_invalid_query_raises():
+    mapping = Mapping((2, 2, 2), 0)
+    topology = GridTopology((False, False, False))
+    cells = np.arange(1, 9, dtype=np.uint64)
+    hood = make_neighborhood(1)
+    with pytest.raises(ValueError):
+        native.find_neighbors_of(
+            mapping, topology, cells, np.array([999], dtype=np.uint64), hood
+        )
+
+
+def test_sfc_keys_match_numpy(monkeypatch):
+    mapping = Mapping((8, 8, 8), 1)
+    rng = np.random.default_rng(7)
+    cells = np.unique(
+        rng.integers(1, int(mapping.last_cell), 500, dtype=np.uint64)
+    )
+    lvl = mapping.get_refinement_level(cells)
+    cells = cells[lvl >= 0]
+    native_m = morton_key(mapping, cells)
+    native_h = hilbert_key(mapping, cells)
+    monkeypatch.setattr(native, "lib", None)
+    numpy_m = morton_key(mapping, cells)
+    numpy_h = hilbert_key(mapping, cells)
+    np.testing.assert_array_equal(native_m, numpy_m)
+    np.testing.assert_array_equal(native_h, numpy_h)
