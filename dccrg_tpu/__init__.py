@@ -27,6 +27,7 @@ from .mapping import Mapping
 from .geometry import NoGeometry, CartesianGeometry, StretchedCartesianGeometry
 from .grid import DEFAULT_NEIGHBORHOOD_ID, Grid, default_mesh
 from .dense import DenseGrid, dense_mesh
+from .verify import VerificationError, verify_all
 
 __version__ = "0.1.0"
 
@@ -44,4 +45,6 @@ __all__ = [
     "DEFAULT_NEIGHBORHOOD_ID",
     "default_mesh",
     "dense_mesh",
+    "VerificationError",
+    "verify_all",
 ]

@@ -1,0 +1,102 @@
+"""Collective-communication wrappers.
+
+TPU-native equivalents of the reference's MPI support layer
+(dccrg_mpi_support.hpp): where dccrg wraps MPI_Allgatherv /
+MPI_Allreduce / point-to-point neighbor reduces, this module wraps the
+XLA collectives that ride the ICI mesh. The functions are meant to be
+called *inside* ``shard_map``-mapped code (they need an axis name in
+scope); each also has a ``host_*`` twin that runs the same collective
+as a tiny jitted program over a mesh — the form application code uses
+for occasional global reductions (e.g. the Poisson dot products,
+tests/poisson/poisson_solve.hpp:278-360, use psum the same way).
+
+- ``all_gather``  — All_Gather (dccrg_mpi_support.hpp:101-234)
+- ``all_reduce``  — All_Reduce, sum (dccrg_mpi_support.hpp:240-269)
+- ``some_reduce`` — Some_Reduce: reduce contributions only from a
+  device's peer set (dccrg_mpi_support.hpp:285-380, which reduces
+  values from neighbor processes via point-to-point messages; on TPU
+  the peer sets are static masks and the exchange is one all_gather)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def all_gather(x, axis_name: str):
+    """Every device's ``x`` stacked along a new leading axis."""
+    return lax.all_gather(x, axis_name)
+
+
+def all_reduce(x, axis_name: str, op: str = "sum"):
+    """Elementwise reduction across the mesh axis."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unknown reduction {op!r}")
+
+
+def some_reduce(x, peer_mask, axis_name: str):
+    """Sum of ``x`` over each device's peer set only.
+
+    ``peer_mask``: [n_dev, n_dev] bool, ``peer_mask[q, p]`` true when
+    device q reduces device p's contribution (the reference reduces
+    over processes it shares a boundary with). The device's own row is
+    applied on the device, so the result differs per device.
+    """
+    gathered = lax.all_gather(x, axis_name)  # [n_dev, ...]
+    me = lax.axis_index(axis_name)
+    w = peer_mask[me].astype(x.dtype)  # [n_dev]
+    return jnp.tensordot(w, gathered, axes=1)
+
+
+def _mesh_map(mesh: Mesh, fn, *args):
+    axis = mesh.axis_names[0]
+    spec = NamedSharding(mesh, P(axis))
+    mapped = _shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(axis),) * len(args),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    args = [jnp.asarray(a, device=spec) for a in args]
+    return jax.jit(mapped)(*args)
+
+
+def host_all_gather(mesh: Mesh, x) -> np.ndarray:
+    """Run all_gather over ``mesh``; ``x`` is [n_dev, ...] sharded rows.
+    Returns [n_dev, n_dev, ...] (each device's view, replicated)."""
+    axis = mesh.axis_names[0]
+    out = _mesh_map(mesh, lambda v: all_gather(v[0], axis)[None], jnp.asarray(x))
+    return np.asarray(out)
+
+
+def host_all_reduce(mesh: Mesh, x, op: str = "sum") -> np.ndarray:
+    """Reduce [n_dev, ...] rows across the mesh axis; returns one row."""
+    axis = mesh.axis_names[0]
+    out = _mesh_map(mesh, lambda v: all_reduce(v[0], axis, op)[None], jnp.asarray(x))
+    return np.asarray(out)[0]
+
+
+def host_some_reduce(mesh: Mesh, x, peer_mask) -> np.ndarray:
+    """Per-device neighbor-set sum of [n_dev, ...] rows."""
+    axis = mesh.axis_names[0]
+    mask = jnp.asarray(np.asarray(peer_mask, dtype=bool))
+
+    def body(v):
+        return some_reduce(v[0], mask, axis)[None]
+
+    return np.asarray(_mesh_map(mesh, body, jnp.asarray(x)))

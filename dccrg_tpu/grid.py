@@ -48,7 +48,11 @@ from .neighbors import (
     validate_neighborhood,
     verify_tiling,
 )
-from .partition import PARTITION_METHODS, partition_cells
+from .partition import (
+    PARTITION_METHODS,
+    partition_cells,
+    partition_cells_hierarchical,
+)
 from .topology import GridTopology
 from .types import ERROR_CELL
 
@@ -157,9 +161,18 @@ class Grid:
         self._pins = {}
         self._weights = {}
         self._partitioning_options = {}
+        self._partitioning_levels = []  # hierarchical partitioning
         # jitted function caches
         self._exchange_cache = {}
         self._stencil_cache = {}
+        import os
+
+        self._debug = os.environ.get("DCCRG_DEBUG") == "1"
+        # extensible iteration-cache items (dccrg.hpp:7404-7518)
+        self._cell_items = {}
+        self._cell_item_values = {}
+        self._neighbor_items = {}
+        self._neighbor_item_values = {}
 
     # -- fluent pre-initialize setters (dccrg.hpp:8242-8357) ----------
 
@@ -247,6 +260,57 @@ class Grid:
         self._build_plan(cells, owner)
         self._allocate_fields()
         return self
+
+    def clone(self, cell_data=None) -> "Grid":
+        """New grid with identical structure (cells, owners, neighbor
+        tables, neighborhoods, pins, weights) but its own — default-
+        initialized — cell data, optionally of a different schema: the
+        reference's cross-Cell_Data copy constructor (dccrg.hpp:344-446).
+        """
+        if not self.initialized:
+            raise RuntimeError("clone() requires an initialized grid")
+        spec = cell_data if cell_data is not None else {
+            name: (shape, dtype) for name, (shape, dtype) in self.fields.items()
+        }
+        other = Grid(cell_data=spec)
+        other._length = self._length
+        other._max_ref_lvl = self._max_ref_lvl
+        other._periodic = self._periodic
+        other._hood_len = self._hood_len
+        other._lb_method = self._lb_method
+        other._geometry_kind = self._geometry_kind
+        other._pins = dict(self._pins)
+        other._weights = dict(self._weights)
+        other._partitioning_options = dict(self._partitioning_options)
+        other._partitioning_levels = [dict(lv) for lv in self._partitioning_levels]
+        other.mesh = self.mesh
+        other.axis = self.axis
+        other.n_dev = self.n_dev
+        other.mapping = Mapping(
+            tuple(int(v) for v in self.mapping.length.get()),
+            self.mapping.max_refinement_level,
+        )
+        other.topology = GridTopology(self._periodic)
+        kind, params = self._geometry_kind
+        if kind == "none":
+            other.geometry = NoGeometry(other.mapping, other.topology)
+        elif kind == "cartesian":
+            other.geometry = CartesianGeometry(other.mapping, other.topology, **params)
+        else:
+            other.geometry = StretchedCartesianGeometry(other.mapping, other.topology, **params)
+        other.neighborhoods = {hid: offs.copy() for hid, offs in self.neighborhoods.items()}
+        other.initialized = True
+        other._build_plan(self.plan.cells.copy(), self.plan.owner.copy())
+        other._allocate_fields()
+        return other
+
+    def neighbor_devices(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID) -> np.ndarray:
+        """[n_dev, n_dev] bool: entry [q, p] true when device q receives
+        halo data from device p under the neighborhood — the peer sets
+        the reference's Some_Reduce reduces over (its process-boundary
+        peers, dccrg_mpi_support.hpp:285-380)."""
+        hp = self.plan.hoods[neighborhood_id]
+        return np.asarray((hp.recv_rows >= 0).any(axis=2))
 
     # -- structure plan building --------------------------------------
 
@@ -338,6 +402,19 @@ class Grid:
         self.plan = plan
         self._exchange_cache.clear()
         self._stencil_cache.clear()
+
+        self._update_data_items()
+
+        # continuous self-checking, like the reference's DEBUG builds
+        # (dccrg.hpp:12454-13036). User data is still mid-migration at
+        # this point; _restructure/_allocate_fields check it after.
+        if self._debug:
+            from . import verify as _verify
+
+            _verify.is_consistent(self)
+            _verify.verify_neighbors(self)
+            _verify.verify_remote_neighbor_info(self)
+            _verify.pin_requests_succeeded(self)
 
     def _build_hood_plan(self, plan: _Plan, nl, offsets, n_inner_arr):
         n_dev, L, R = plan.n_dev, plan.L, plan.R
@@ -470,10 +547,148 @@ class Grid:
 
     # -- iteration views (dccrg.hpp:7594-7718) -------------------------
 
-    def get_cells(self) -> np.ndarray:
-        """All local cell ids over all devices, id-sorted (reference
-        get_cells(), dccrg.hpp:661)."""
-        return self.plan.cells.copy()
+    # neighbor-type bitmask constants (dccrg.hpp:91-148)
+    HAS_NO_NEIGHBOR = 0
+    HAS_LOCAL_NEIGHBOR_OF = 1 << 0
+    HAS_LOCAL_NEIGHBOR_TO = 1 << 1
+    HAS_REMOTE_NEIGHBOR_OF = 1 << 2
+    HAS_REMOTE_NEIGHBOR_TO = 1 << 3
+    HAS_LOCAL_NEIGHBOR_BOTH = HAS_LOCAL_NEIGHBOR_OF | HAS_LOCAL_NEIGHBOR_TO
+    HAS_REMOTE_NEIGHBOR_BOTH = HAS_REMOTE_NEIGHBOR_OF | HAS_REMOTE_NEIGHBOR_TO
+
+    def neighbor_type_masks(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID) -> np.ndarray:
+        """Per-cell neighbor-type bitmask in plan.cells order: which of
+        each cell's neighbors_of / neighbors_to live on its own device
+        ("local") vs another device (reference is_neighbor_type_match,
+        dccrg.hpp:2968-3075)."""
+        plan = self.plan
+        nl = plan.hoods[neighborhood_id].lists
+        masks = np.zeros(len(plan.cells), dtype=np.int32)
+        of_nbr_owner = plan.owner[np.searchsorted(plan.cells, nl.of_neighbor)]
+        same = plan.owner[nl.of_source] == of_nbr_owner
+        np.bitwise_or.at(masks, nl.of_source[same], self.HAS_LOCAL_NEIGHBOR_OF)
+        np.bitwise_or.at(masks, nl.of_source[~same], self.HAS_REMOTE_NEIGHBOR_OF)
+        to_nbr_owner = plan.owner[np.searchsorted(plan.cells, nl.to_neighbor)]
+        same_to = plan.owner[nl.to_source] == to_nbr_owner
+        np.bitwise_or.at(masks, nl.to_source[same_to], self.HAS_LOCAL_NEIGHBOR_TO)
+        np.bitwise_or.at(masks, nl.to_source[~same_to], self.HAS_REMOTE_NEIGHBOR_TO)
+        return masks
+
+    def get_cells(
+        self,
+        criteria=None,
+        exact_match: bool = False,
+        neighborhood_id=DEFAULT_NEIGHBORHOOD_ID,
+    ) -> np.ndarray:
+        """Cell ids, optionally filtered by neighbor-type criteria
+        (reference get_cells, dccrg.hpp:661-753). Without criteria:
+        every cell. With criteria: cells whose neighbor-type bitmask
+        matches any criterion — equality under ``exact_match``,
+        otherwise a non-empty intersection with the merged criteria.
+        Always id-sorted (the reference's ``sorted`` flag exists because
+        its hash-map iteration order is arbitrary; here there is only
+        one order)."""
+        if neighborhood_id not in self.plan.hoods:
+            return np.empty(0, np.uint64)
+        cells = self.plan.cells.copy()
+        if criteria is None:
+            return cells
+        criteria = [int(c) for c in np.atleast_1d(criteria)]
+        masks = self.neighbor_type_masks(neighborhood_id)
+        if exact_match:
+            keep = np.isin(masks, criteria)
+        else:
+            merged = 0
+            for c in criteria:
+                merged |= c
+            keep = (masks & merged) > 0
+        return cells[keep]
+
+    # -- extensible iteration-cache items ------------------------------
+    # (reference Additional_Cell_Items / Additional_Neighbor_Items,
+    # dccrg.hpp:7404-7518: user mixins whose update() runs at cache
+    # rebuild; e.g. Is_Local / Center in tests/advection/cell.hpp).
+    # Here an item is a vectorized function evaluated over the whole
+    # cell (or neighbor-entry) set at every structure rebuild.
+
+    def add_cell_data_item(self, name: str, fn) -> None:
+        """Register ``fn(grid, ids) -> array`` recomputed at every
+        structure rebuild and cached for the epoch."""
+        self._cell_items[name] = fn
+        if self.initialized:
+            self._cell_item_values[name] = np.asarray(fn(self, self.plan.cells))
+
+    def remove_cell_data_item(self, name: str) -> None:
+        self._cell_items.pop(name, None)
+        self._cell_item_values.pop(name, None)
+
+    def cell_data_item(self, name: str, ids=None) -> np.ndarray:
+        """The cached item values, for all cells (plan order) or the
+        given ids."""
+        vals = self._cell_item_values[name]
+        if ids is None:
+            return vals.copy()
+        scalar = np.isscalar(ids) or np.asarray(ids).ndim == 0
+        ids = np.atleast_1d(np.asarray(ids, dtype=np.uint64))
+        pos = np.searchsorted(self.plan.cells, ids)
+        if np.any(pos >= len(self.plan.cells)) or np.any(self.plan.cells[pos] != ids):
+            raise KeyError("unknown cell id(s)")
+        out = vals[pos]
+        return out[0] if scalar else out
+
+    def add_neighbor_data_item(self, name: str, fn,
+                               neighborhood_id=DEFAULT_NEIGHBORHOOD_ID) -> None:
+        """Register ``fn(grid, src_ids, nbr_ids, offsets) -> array``
+        over the neighborhood's flat neighbor entries, recomputed at
+        every structure rebuild."""
+        self._neighbor_items[name] = (fn, neighborhood_id)
+        if self.initialized:
+            nl = self.plan.hoods[neighborhood_id].lists
+            self._neighbor_item_values[name] = np.asarray(
+                fn(self, self.plan.cells[nl.of_source], nl.of_neighbor, nl.of_offset)
+            )
+
+    def remove_neighbor_data_item(self, name: str) -> None:
+        self._neighbor_items.pop(name, None)
+        self._neighbor_item_values.pop(name, None)
+
+    def neighbor_data_item(self, name: str, cell=None) -> np.ndarray:
+        """Item values for all neighbor entries, or one cell's."""
+        vals = self._neighbor_item_values[name]
+        if cell is None:
+            return vals.copy()
+        _, hid = self._neighbor_items[name]
+        nl = self.plan.hoods[hid].lists
+        pos = int(np.searchsorted(self.plan.cells, np.uint64(cell)))
+        if pos >= len(self.plan.cells) or self.plan.cells[pos] != np.uint64(cell):
+            raise ValueError(f"unknown cell {cell}")
+        return vals[nl.of_source == pos]
+
+    def _update_data_items(self) -> None:
+        for name, fn in self._cell_items.items():
+            self._cell_item_values[name] = np.asarray(fn(self, self.plan.cells))
+        # drop items whose neighborhood has been removed
+        for name in [n for n, (_, hid) in self._neighbor_items.items()
+                     if hid not in self.plan.hoods]:
+            self.remove_neighbor_data_item(name)
+        for name, (fn, hid) in self._neighbor_items.items():
+            nl = self.plan.hoods[hid].lists
+            self._neighbor_item_values[name] = np.asarray(
+                fn(self, self.plan.cells[nl.of_source], nl.of_neighbor, nl.of_offset)
+            )
+
+    def is_inner(self, cell) -> bool:
+        """True when no neighbor relation of the cell crosses a device
+        boundary (dccrg_iterator_support.hpp:33-56)."""
+        pos = int(np.searchsorted(self.plan.cells, np.uint64(cell)))
+        if pos >= len(self.plan.cells) or self.plan.cells[pos] != np.uint64(cell):
+            raise ValueError(f"unknown cell {cell}")
+        d = int(self.plan.owner[pos])
+        row = self.plan.local_row_of[d][int(cell)]
+        return row < self._n_inner(d)
+
+    def is_outer(self, cell) -> bool:
+        return not self.is_inner(cell)
 
     def local_cells(self) -> CellView:
         return CellView(self.plan.cells.copy(), self.plan.owner.copy())
@@ -776,10 +991,17 @@ class Grid:
                     pos = np.searchsorted(cells, np.uint64(cid))
                     if pos < len(cells) and cells[pos] == np.uint64(cid):
                         weights[pos] = w
-            new_owner = partition_cells(
-                self.mapping, cells, self.n_dev, self._lb_method,
-                weights=weights, pins=self._pins or None,
-            )
+            if self._partitioning_levels:
+                new_owner = partition_cells_hierarchical(
+                    self.mapping, cells, self.n_dev,
+                    self._partitioning_levels,
+                    weights=weights, pins=self._pins or None,
+                )
+            else:
+                new_owner = partition_cells(
+                    self.mapping, cells, self.n_dev, self._lb_method,
+                    weights=weights, pins=self._pins or None,
+                )
         else:
             new_owner = self.plan.owner.copy()
             for cid, dest in self._pins.items():
@@ -851,8 +1073,62 @@ class Grid:
             self.set_load_balancing_method(str(value))
         self._partitioning_options[name] = value
 
-    def get_partitioning_options(self) -> dict:
-        return dict(self._partitioning_options)
+    def get_partitioning_options(self, hierarchial_partitioning_level: int | None = None):
+        """Flat options dict, or (with a level argument) that hierarchy
+        level's option names (dccrg.hpp:5814)."""
+        if hierarchial_partitioning_level is None:
+            return dict(self._partitioning_options)
+        lv = self._hierarchy_level(hierarchial_partitioning_level)
+        return [k for k in lv if k not in ("processes", "method")]
+
+    # hierarchical partitioning (Zoltan hierarchical replacement,
+    # dccrg.hpp:5629-5880): levels group devices, e.g. (host, chip)
+
+    def _hierarchy_level(self, level: int) -> dict:
+        if not 0 <= int(level) < len(self._partitioning_levels):
+            raise IndexError(
+                f"no hierarchial partitioning level {level} "
+                f"(have {len(self._partitioning_levels)})"
+            )
+        return self._partitioning_levels[int(level)]
+
+    def add_partitioning_level(self, processes: int):
+        """Append a hierarchy level whose parts hold ``processes``
+        devices each (dccrg.hpp:5634). On TPU a natural two-level
+        hierarchy is (devices-per-host, 1)."""
+        if int(processes) < 1:
+            raise ValueError("processes per part must be >= 1")
+        self._partitioning_levels.append({"processes": int(processes)})
+        return self
+
+    def remove_partitioning_level(self, hierarchial_partitioning_level: int):
+        self._hierarchy_level(hierarchial_partitioning_level)
+        del self._partitioning_levels[int(hierarchial_partitioning_level)]
+        return self
+
+    def add_partitioning_option(self, level: int, name: str, value):
+        """Set an option on a hierarchy level (dccrg.hpp:5731);
+        'LB_METHOD'/'method' selects the curve for that level's split."""
+        lv = self._hierarchy_level(level)
+        lv[name] = value
+        if name.upper() in ("LB_METHOD", "METHOD"):
+            method = str(value).lower()
+            if method not in PARTITION_METHODS:
+                raise ValueError(
+                    f"unknown method {value!r} for level {level}, have {PARTITION_METHODS}"
+                )
+            lv["method"] = method  # validated lowercase wins over the raw value
+        return self
+
+    def remove_partitioning_option(self, level: int, name: str):
+        lv = self._hierarchy_level(level)
+        lv.pop(name, None)
+        if name.upper() in ("LB_METHOD", "METHOD"):
+            lv.pop("method", None)
+        return self
+
+    def get_partitioning_option_value(self, level: int, name: str):
+        return self._hierarchy_level(level).get(name)
 
     # -- adaptive mesh refinement (dccrg.hpp:2456-3507, 9730-10693) ----
 
@@ -968,6 +1244,11 @@ class Grid:
             arr = np.zeros((self.n_dev, self.plan.R) + shape, dtype=dtype)
             arr[new_dev, new_rows] = host[name][old_dev, old_rows]
             self.data[name] = jnp.asarray(arr, device=self._sharding())
+
+        if self._debug:
+            from . import verify as _verify
+
+            _verify.verify_user_data(self)
 
     def get_removed_cells(self) -> np.ndarray:
         """Cells removed by the last stop_refining (dccrg.hpp:3519)."""

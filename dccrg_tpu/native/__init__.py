@@ -158,6 +158,30 @@ def find_neighbors_of(mapping, topology, all_cells_sorted, query_cells,
         capacity = int(total)
 
 
+def refinement_levels(mapping, cells) -> np.ndarray:
+    """Native bulk refinement-level query (-1 for invalid ids)."""
+    cells = np.ascontiguousarray(cells, dtype=np.uint64)
+    length = np.ascontiguousarray(mapping.length.get(), dtype=np.uint64)
+    out = np.empty(len(cells), dtype=np.int32)
+    lib.dn_refinement_levels(
+        _ptr(length, ctypes.c_uint64), mapping.max_refinement_level,
+        _ptr(cells, ctypes.c_uint64), len(cells), _ptr(out, ctypes.c_int32),
+    )
+    return out.astype(np.int64)
+
+
+def cell_indices(mapping, cells) -> np.ndarray:
+    """Native bulk (n,3) min-corner indices (all-ones for invalid)."""
+    cells = np.ascontiguousarray(cells, dtype=np.uint64)
+    length = np.ascontiguousarray(mapping.length.get(), dtype=np.uint64)
+    out = np.empty((len(cells), 3), dtype=np.uint64)
+    lib.dn_cell_indices(
+        _ptr(length, ctypes.c_uint64), mapping.max_refinement_level,
+        _ptr(cells, ctypes.c_uint64), len(cells), _ptr(out, ctypes.c_uint64),
+    )
+    return out
+
+
 def sfc_keys(indices, bits, kind):
     """Morton or Hilbert keys from (n,3) min-corner indices."""
     idx = np.ascontiguousarray(indices, dtype=np.uint64).reshape(-1, 3)

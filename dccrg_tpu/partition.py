@@ -95,6 +95,99 @@ def hilbert_key(mapping: Mapping, cells: np.ndarray) -> np.ndarray:
     return key
 
 
+def _split_by_weight(order, w, shares):
+    """Cut ``order`` (cell positions in curve order) into len(shares)
+    contiguous runs with cumulative weight proportional to ``shares``
+    (device counts per part). Returns the part index per position in
+    ``order``."""
+    n = len(order)
+    part = np.zeros(n, dtype=np.int64)
+    if n == 0 or len(shares) <= 1:
+        return part
+    wo = w[order]
+    if wo.sum() <= 0:  # all-zero weights: fall back to equal counts
+        wo = np.ones(n, dtype=np.float64)
+    cum = np.cumsum(wo)
+    total = cum[-1]
+    bounds = np.cumsum(np.asarray(shares, dtype=np.float64))
+    bounds = bounds / bounds[-1] * max(total, 1e-300)
+    mid = cum - wo / 2
+    part = np.searchsorted(bounds, mid, side="right")
+    return np.minimum(part, len(shares) - 1)
+
+
+def partition_cells_hierarchical(
+    mapping: Mapping,
+    cells: np.ndarray,
+    n_parts: int,
+    levels,
+    weights: np.ndarray | None = None,
+    pins: dict | None = None,
+) -> np.ndarray:
+    """Hierarchical partition (Zoltan hierarchical replacement,
+    dccrg.hpp:5629-5880): each level splits every current device group
+    into sub-groups of ``processes`` devices using that level's curve
+    method. On TPU the natural hierarchy is (host, chip): e.g. levels
+    ``[{"processes": 4, "method": "block"}, {"processes": 1, "method":
+    "hilbert"}]`` first cuts coarse blocks across hosts, then
+    Hilbert-orders within each host's chips.
+
+    ``levels``: list of dicts with keys ``processes`` (devices per part
+    after this level's split) and optional ``method``.
+    """
+    cells = np.asarray(cells, dtype=np.uint64)
+    n = len(cells)
+    if weights is None:
+        w = np.ones(n, dtype=np.float64)
+    else:
+        w = np.asarray(weights, dtype=np.float64)
+
+    # groups: list of (device_lo, device_hi, cell positions array)
+    groups = [(0, n_parts, np.arange(n))]
+    plan_levels = [dict(lv) for lv in levels]
+    if not plan_levels or int(plan_levels[-1].get("processes", 1)) != 1:
+        plan_levels.append({"processes": 1})  # finish at single devices
+
+    for lv in plan_levels:
+        per = max(1, int(lv.get("processes", 1)))
+        method = lv.get("method", "morton")
+        if method not in PARTITION_METHODS:
+            raise ValueError(f"unknown partition method {method!r}")
+        next_groups = []
+        for lo, hi, pos in groups:
+            span = hi - lo
+            if span <= per:
+                next_groups.append((lo, hi, pos))
+                continue
+            shares = [per] * (span // per) + ([span % per] if span % per else [])
+            sub = cells[pos]
+            if method == "block":
+                curve = np.argsort(sub, kind="stable")
+            elif method == "morton":
+                curve = np.argsort(morton_key(mapping, sub), kind="stable")
+            else:
+                curve = np.argsort(hilbert_key(mapping, sub), kind="stable")
+            part_in_order = _split_by_weight(pos[curve], w, shares)
+            dev_lo = lo
+            for pi, share in enumerate(shares):
+                sel = pos[curve[part_in_order == pi]]
+                next_groups.append((dev_lo, dev_lo + share, sel))
+                dev_lo += share
+        groups = next_groups
+
+    owner = np.empty(n, dtype=np.int32)
+    for lo, hi, pos in groups:
+        owner[pos] = lo  # hi == lo + 1 after the final level
+    if pins:
+        for cid, dest in pins.items():
+            p = np.searchsorted(cells, np.uint64(cid))
+            if p < n and cells[p] == np.uint64(cid):
+                if not 0 <= int(dest) < n_parts:
+                    raise ValueError(f"pin of cell {cid} to invalid device {dest}")
+                owner[p] = int(dest)
+    return owner
+
+
 def partition_cells(
     mapping: Mapping,
     cells: np.ndarray,

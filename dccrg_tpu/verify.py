@@ -1,0 +1,205 @@
+"""DEBUG-style invariant verifiers.
+
+Equivalents of the reference's ``#ifdef DEBUG`` checkers
+(dccrg.hpp:12454-13036): each function recomputes a piece of derived
+grid structure from first principles and compares it with what the
+``Grid`` is actually using, raising ``VerificationError`` on the first
+mismatch. They are pure host-side checks — safe to call at any point
+between operations:
+
+- ``is_consistent``       — replicated structure sanity (dccrg.hpp:12454-12510)
+- ``verify_neighbors``    — recompute-and-compare neighbor lists, incl.
+                            the <=1 refinement-level-difference invariant
+                            (dccrg.hpp:12516-12750)
+- ``verify_remote_neighbor_info`` — boundary classification and halo
+                            send/receive list symmetry (dccrg.hpp:12759-12978)
+- ``verify_user_data``    — field storage layout (dccrg.hpp:12984-13011)
+- ``pin_requests_succeeded`` — pinned cells sit on their device (dccrg.hpp:13017-13035)
+- ``verify_all``          — everything above
+
+Setting ``DCCRG_DEBUG=1`` makes ``Grid`` run ``verify_all`` after every
+structure rebuild (init, AMR commit, load balance) — the reference's
+DEBUG builds do the same continuous self-checking.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .neighbors import _find_neighbors_of_numpy, verify_tiling
+
+# parity with grid.DEFAULT_NEIGHBORHOOD_ID (import would be circular)
+_DEFAULT_HOOD = -0xDCC
+
+
+class VerificationError(AssertionError):
+    """A grid invariant does not hold."""
+
+
+def _fail(msg: str):
+    raise VerificationError(msg)
+
+
+def is_consistent(grid) -> None:
+    """Replicated structure sanity: sorted unique leaf cells that tile
+    the grid, owners in range, and the device row layout matching the
+    replicated cell->owner map."""
+    plan = grid.plan
+    cells, owner = plan.cells, plan.owner
+    if not np.all(np.diff(cells.astype(np.uint64)) > 0):
+        _fail("cell list is not strictly sorted")
+    verify_tiling(grid.mapping, cells)
+    if len(owner) != len(cells):
+        _fail("owner array length mismatch")
+    if np.any((owner < 0) | (owner >= plan.n_dev)):
+        _fail("cell owner out of device range")
+
+    # row layout: each device's local rows hold exactly its cells
+    for d in range(plan.n_dev):
+        mine = np.sort(cells[owner == d])
+        rows = np.sort(plan.local_ids[d])
+        if not np.array_equal(mine, rows):
+            _fail(f"device {d}: local row ids do not match owned cells")
+        if plan.n_local[d] != len(plan.local_ids[d]):
+            _fail(f"device {d}: n_local does not match row count")
+        if len(plan.local_ids[d]) > plan.L:
+            _fail(f"device {d}: local rows exceed capacity L")
+        # ghost rows hold only existing, remote cells
+        gids = plan.ghost_ids[d]
+        pos = np.searchsorted(cells, gids)
+        if len(gids) and (
+            np.any(pos >= len(cells)) or np.any(cells[pos] != gids)
+        ):
+            _fail(f"device {d}: ghost id not an existing cell")
+        if len(gids) and np.any(owner[pos] == d):
+            _fail(f"device {d}: ghost row holds a locally-owned cell")
+        # row lookup agrees with the row arrays
+        for r, cid in enumerate(plan.local_ids[d]):
+            if plan.local_row_of[d][int(cid)] != r:
+                _fail(f"device {d}: row lookup mismatch for cell {cid}")
+        for r, cid in enumerate(gids):
+            if plan.local_row_of[d][int(cid)] != plan.L + r:
+                _fail(f"device {d}: ghost row lookup mismatch for cell {cid}")
+
+
+def verify_neighbors(grid) -> None:
+    """Recompute every neighborhood's neighbors_of/neighbors_to with the
+    NumPy reference engine and compare with the lists the plan was built
+    from; check the <=1 refinement-level-difference invariant."""
+    plan = grid.plan
+    cells = plan.cells
+    for hid, offsets in grid.neighborhoods.items():
+        nl = plan.hoods[hid].lists
+        src, nbr, off, item = _find_neighbors_of_numpy(
+            grid.mapping, grid.topology, cells, cells, offsets
+        )
+        if not (
+            np.array_equal(src, nl.of_source)
+            and np.array_equal(nbr, nl.of_neighbor)
+            and np.array_equal(off, nl.of_offset)
+            and np.array_equal(item, nl.of_item)
+        ):
+            _fail(f"neighborhood {hid}: stored neighbors_of != recomputed")
+        # inversion consistency: to-lists are exactly the inverse relation
+        inv = np.lexsort((np.arange(len(src)), np.searchsorted(cells, nbr)))
+        if not (
+            np.array_equal(np.searchsorted(cells, nbr)[inv], nl.to_source)
+            and np.array_equal(cells[src][inv], nl.to_neighbor)
+            and np.array_equal(-off[inv], nl.to_offset)
+        ):
+            _fail(f"neighborhood {hid}: neighbors_to is not the inverse of neighbors_of")
+        # refinement-level jumps (dccrg.hpp:12729-12747)
+        lvl_src = grid.mapping.get_refinement_level(cells[src])
+        lvl_nbr = grid.mapping.get_refinement_level(nbr)
+        if np.any(np.abs(lvl_src - lvl_nbr) > 1):
+            bad = np.argmax(np.abs(lvl_src - lvl_nbr) > 1)
+            _fail(
+                f"neighborhood {hid}: cells {cells[src[bad]]} and {nbr[bad]} "
+                f"differ by more than one refinement level"
+            )
+
+
+def verify_remote_neighbor_info(grid) -> None:
+    """Boundary (inner/outer) classification and halo-exchange list
+    symmetry: device p's send list to q names the same cells, in the
+    same order, as q's receive list from p; ghost rows are exactly the
+    cells some local cell reads remotely."""
+    plan = grid.plan
+    cells, owner = plan.cells, plan.owner
+    nl = plan.hoods[_DEFAULT_HOOD].lists
+
+    # recompute outer flags: a local cell is outer iff it has a remote
+    # neighbor in its of- or to-lists (dccrg.hpp:9377-9409)
+    nbr_owner = owner[np.searchsorted(cells, nl.of_neighbor)]
+    to_owner = owner[np.searchsorted(cells, nl.to_neighbor)]
+    outer = np.zeros(len(cells), dtype=bool)
+    np.add.at(outer, nl.of_source[owner[nl.of_source] != nbr_owner], True)
+    np.add.at(outer, nl.to_source[owner[nl.to_source] != to_owner], True)
+
+    for d in range(plan.n_dev):
+        n_inner = int(plan.hoods[_DEFAULT_HOOD].n_inner[d])
+        ids = plan.local_ids[d]
+        pos = np.searchsorted(cells, ids)
+        got_outer = outer[pos]
+        if np.any(got_outer[:n_inner]):
+            _fail(f"device {d}: an inner row has a remote neighbor")
+        if np.any(~got_outer[n_inner:len(ids)]):
+            _fail(f"device {d}: an outer row has no remote neighbor")
+
+    # send/receive symmetry per neighborhood
+    for hid, hp in plan.hoods.items():
+        for p in range(plan.n_dev):
+            for q in range(plan.n_dev):
+                srows = hp.send_rows[p, q]
+                rrows = hp.recv_rows[q, p]
+                if np.sum(srows >= 0) != np.sum(rrows >= 0):
+                    _fail(f"hood {hid}: send/recv count mismatch {p}->{q}")
+                for j in range(len(srows)):
+                    if (srows[j] >= 0) != (rrows[j] >= 0):
+                        _fail(f"hood {hid}: send/recv padding mismatch {p}->{q}@{j}")
+                    if srows[j] < 0:
+                        continue
+                    sid = plan.local_ids[p][srows[j]]
+                    rid = plan.ghost_ids[q][rrows[j] - plan.L]
+                    if sid != rid:
+                        _fail(
+                            f"hood {hid}: transfer {p}->{q} slot {j} sends cell "
+                            f"{sid} into ghost row of cell {rid}"
+                        )
+
+
+def verify_user_data(grid) -> None:
+    """Field arrays have the planned sharded layout and the permanent
+    zero pad row really is zero (stencil gathers rely on it)."""
+    plan = grid.plan
+    for name, (shape, dtype) in grid.fields.items():
+        arr = grid.data.get(name)
+        if arr is None:
+            _fail(f"field {name!r} missing from grid.data")
+        want = (plan.n_dev, plan.R) + shape
+        if tuple(arr.shape) != want:
+            _fail(f"field {name!r}: shape {tuple(arr.shape)} != planned {want}")
+        if arr.dtype != dtype:
+            _fail(f"field {name!r}: dtype {arr.dtype} != declared {dtype}")
+        pad = np.asarray(arr[:, plan.R - 1])
+        if np.any(pad != 0):
+            _fail(f"field {name!r}: zero pad row has been written to")
+
+
+def pin_requests_succeeded(grid) -> None:
+    """Every granted pin request placed its cell (dccrg.hpp:13017)."""
+    plan = grid.plan
+    for cid, dev in grid._pins.items():
+        pos = np.searchsorted(plan.cells, np.uint64(cid))
+        if pos >= len(plan.cells) or plan.cells[pos] != np.uint64(cid):
+            continue  # pinned cell no longer exists (refined away)
+        if plan.owner[pos] != dev:
+            _fail(f"pinned cell {cid} is on device {plan.owner[pos]}, not {dev}")
+
+
+def verify_all(grid) -> None:
+    is_consistent(grid)
+    verify_neighbors(grid)
+    verify_remote_neighbor_info(grid)
+    verify_user_data(grid)
+    pin_requests_succeeded(grid)

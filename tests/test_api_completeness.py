@@ -1,0 +1,191 @@
+"""Grid API surface added for reference parity: hierarchical
+partitioning (dccrg.hpp:5629-5880), get_cells criteria filtering
+(dccrg.hpp:661-753), collectives (dccrg_mpi_support.hpp), cross-schema
+clone (dccrg.hpp:344-446), and extensible cache items
+(dccrg.hpp:7404-7518 / tests/additional_cell_data)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dccrg_tpu import Grid, comm
+from dccrg_tpu.grid import DEFAULT_NEIGHBORHOOD_ID
+from dccrg_tpu.partition import partition_cells, partition_cells_hierarchical
+from dccrg_tpu.mapping import Mapping
+
+
+@pytest.fixture
+def mesh8():
+    return Mesh(np.array(jax.devices()[:8]), ("dev",))
+
+
+def make_grid(mesh, length=(4, 4, 4), max_lvl=0, hood=1):
+    return (
+        Grid(cell_data={"rho": np.float32})
+        .set_initial_length(length)
+        .set_maximum_refinement_level(max_lvl)
+        .set_periodic(True, True, True)
+        .set_neighborhood_length(hood)
+        .initialize(mesh)
+    )
+
+
+# -- hierarchical partitioning ----------------------------------------
+
+def test_hierarchical_partition_groups_devices():
+    mapping = Mapping((8, 8, 8), 0)
+    cells = np.arange(1, 513, dtype=np.uint64)
+    owner = partition_cells_hierarchical(
+        mapping, cells, 8,
+        [{"processes": 4, "method": "block"}, {"processes": 1, "method": "morton"}],
+    )
+    # all 8 devices used, balanced to 64 cells each
+    counts = np.bincount(owner, minlength=8)
+    assert np.all(counts == 64)
+    # level-0 split is in block (cell-id) order: first half of ids on
+    # devices 0-3, second half on 4-7
+    assert np.all(owner[:256] < 4) and np.all(owner[256:] >= 4)
+
+
+def test_hierarchical_balance_load(mesh8):
+    grid = make_grid(mesh8)
+    grid.add_partitioning_level(4)
+    grid.add_partitioning_option(0, "LB_METHOD", "block")
+    grid.add_partitioning_level(1)
+    assert grid.get_partitioning_option_value(0, "LB_METHOD") == "block"
+    assert "LB_METHOD" in grid.get_partitioning_options(0)
+    grid.balance_load()
+    counts = np.bincount(grid.plan.owner, minlength=8)
+    assert np.all(counts == 8)
+    grid.remove_partitioning_option(0, "LB_METHOD")
+    assert grid.get_partitioning_option_value(0, "LB_METHOD") is None
+    grid.remove_partitioning_level(1)
+    grid.balance_load()  # still valid with one level
+    with pytest.raises(IndexError):
+        grid.remove_partitioning_level(5)
+
+
+def test_hierarchical_respects_weights_and_pins():
+    mapping = Mapping((4, 4, 4), 0)
+    cells = np.arange(1, 65, dtype=np.uint64)
+    w = np.ones(64)
+    w[:8] = 100.0  # heavy cells
+    owner = partition_cells_hierarchical(
+        mapping, cells, 4, [{"processes": 2, "method": "block"}],
+        weights=w, pins={64: 0},
+    )
+    assert owner[-1] == 0  # pin wins
+    # heavy cells spread: device 0's cell count far below 16
+    assert np.sum(owner == 0) < 16
+
+
+# -- get_cells criteria ------------------------------------------------
+
+def test_get_cells_criteria_match_views(mesh8):
+    grid = make_grid(mesh8)
+    masks = grid.neighbor_type_masks()
+    # every cell has of- and to-neighbors on a periodic uniform grid
+    assert np.all(masks > 0)
+    remote_bits = Grid.HAS_REMOTE_NEIGHBOR_OF | Grid.HAS_REMOTE_NEIGHBOR_TO
+    outer = grid.get_cells(criteria=[remote_bits])
+    np.testing.assert_array_equal(np.sort(outer), np.sort(grid.outer_cells().ids))
+    exact_inner = grid.get_cells(
+        criteria=[Grid.HAS_LOCAL_NEIGHBOR_BOTH], exact_match=True
+    )
+    np.testing.assert_array_equal(np.sort(exact_inner), np.sort(grid.inner_cells().ids))
+    # unknown neighborhood -> empty (reference returns empty)
+    assert len(grid.get_cells(criteria=[1], neighborhood_id=1234)) == 0
+    assert len(grid.get_cells()) == 64
+
+
+def test_is_inner_is_outer(mesh8):
+    grid = make_grid(mesh8)
+    for cid in grid.inner_cells().ids[:3]:
+        assert grid.is_inner(int(cid)) and not grid.is_outer(int(cid))
+    for cid in grid.outer_cells().ids[:3]:
+        assert grid.is_outer(int(cid))
+
+
+# -- collectives -------------------------------------------------------
+
+def test_host_all_reduce_and_gather(mesh8):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    total = comm.host_all_reduce(mesh8, x)
+    assert float(total[0]) == 28.0
+    mx = comm.host_all_reduce(mesh8, x, op="max")
+    assert float(mx[0]) == 7.0
+    g = comm.host_all_gather(mesh8, x)
+    assert g.shape == (8, 8, 1)
+    for d in range(8):
+        np.testing.assert_array_equal(g[d, :, 0], np.arange(8, dtype=np.float32))
+
+
+def test_host_some_reduce_matches_mask(mesh8):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    mask = np.zeros((8, 8), dtype=bool)
+    for q in range(8):
+        mask[q, (q + 1) % 8] = True
+        mask[q, (q - 1) % 8] = True
+    out = comm.host_some_reduce(mesh8, x, mask)
+    for q in range(8):
+        want = x[(q + 1) % 8, 0] + x[(q - 1) % 8, 0]
+        assert float(out[q, 0]) == want
+
+
+def test_neighbor_devices_symmetry(mesh8):
+    grid = make_grid(mesh8)
+    peers = grid.neighbor_devices()
+    assert peers.shape == (8, 8)
+    # halo flows are symmetric on a symmetric stencil
+    np.testing.assert_array_equal(peers, peers.T)
+    assert not np.any(np.diag(peers))
+
+
+# -- clone -------------------------------------------------------------
+
+def test_clone_same_structure_new_schema(mesh8):
+    grid = make_grid(mesh8, max_lvl=1)
+    grid.refine_completely(int(grid.get_cells()[0]))
+    grid.stop_refining()
+    ids = grid.get_cells()
+    grid.set("rho", ids, np.arange(len(ids), dtype=np.float32))
+
+    other = grid.clone(cell_data={"a": np.float64, "b": ((3,), np.int32)})
+    np.testing.assert_array_equal(other.plan.cells, grid.plan.cells)
+    np.testing.assert_array_equal(other.plan.owner, grid.plan.owner)
+    assert set(other.fields) == {"a", "b"}
+    assert np.all(other.get("a", ids) == 0.0)
+    # data independence: writing the clone leaves the original untouched
+    other.set("a", ids[:4], np.ones(4))
+    assert np.all(grid.get("rho", ids) == np.arange(len(ids), dtype=np.float32))
+
+
+# -- extensible cache items -------------------------------------------
+
+def test_cell_and_neighbor_items_recomputed(mesh8):
+    grid = make_grid(mesh8, max_lvl=1)
+
+    # Is_Local-style item (tests/advection/cell.hpp:153-173)
+    grid.add_cell_data_item(
+        "on_dev0", lambda g, ids: g.plan.owner[np.searchsorted(g.plan.cells, ids)] == 0
+    )
+    # Center-style neighbor item: offset magnitude per neighbor entry
+    grid.add_neighbor_data_item(
+        "dist", lambda g, src, nbr, off: np.abs(off).sum(axis=1)
+    )
+    assert grid.cell_data_item("on_dev0").sum() == np.sum(grid.plan.owner == 0)
+    first = int(grid.get_cells()[0])
+    d = grid.neighbor_data_item("dist", first)
+    assert len(d) == len(grid.get_neighbors_of(first))
+
+    n_before = len(grid.cell_data_item("on_dev0"))
+    grid.refine_completely(first)
+    grid.stop_refining()
+    n_after = len(grid.cell_data_item("on_dev0"))
+    assert n_after == n_before + 7  # recomputed for the new cell set
+    grid.remove_cell_data_item("on_dev0")
+    with pytest.raises(KeyError):
+        grid.cell_data_item("on_dev0")

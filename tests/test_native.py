@@ -104,3 +104,20 @@ def test_sfc_keys_match_numpy(monkeypatch):
     numpy_h = hilbert_key(mapping, cells)
     np.testing.assert_array_equal(native_m, numpy_m)
     np.testing.assert_array_equal(native_h, numpy_h)
+
+def test_bulk_mapping_queries_match_numpy():
+    # native dispatch engages at >= 4096 ids
+    mapping = Mapping((16, 16, 16), 2)
+    rng = np.random.default_rng(3)
+    cells = rng.integers(0, int(mapping.last_cell) + 1000, 10_000, dtype=np.uint64)
+    lvl_native = mapping.get_refinement_level(cells)
+    idx_native = mapping.get_indices(cells)
+    import dccrg_tpu.native as nat
+    saved, nat.lib = nat.lib, None
+    try:
+        lvl_numpy = mapping.get_refinement_level(cells)
+        idx_numpy = mapping.get_indices(cells)
+    finally:
+        nat.lib = saved
+    np.testing.assert_array_equal(lvl_native, lvl_numpy)
+    np.testing.assert_array_equal(idx_native, idx_numpy)

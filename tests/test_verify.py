@@ -1,0 +1,112 @@
+"""DEBUG verifier checks (reference dccrg.hpp:12454-13036).
+
+Healthy grids — uniform, refined, rebalanced — must pass ``verify_all``;
+corrupted derived state must be caught by the matching verifier.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from dccrg_tpu import Grid, VerificationError, verify_all
+from dccrg_tpu import verify as V
+from dccrg_tpu.grid import DEFAULT_NEIGHBORHOOD_ID
+
+
+@pytest.fixture
+def mesh8():
+    return Mesh(np.array(jax.devices()[:8]), ("dev",))
+
+
+def make_grid(mesh, length=(4, 4, 2), max_lvl=0, hood=1, periodic=True):
+    return (
+        Grid(cell_data={"rho": np.float32})
+        .set_initial_length(length)
+        .set_maximum_refinement_level(max_lvl)
+        .set_periodic(periodic, periodic, periodic)
+        .set_neighborhood_length(hood)
+        .initialize(mesh)
+    )
+
+
+def test_healthy_uniform_grid_passes(mesh8):
+    grid = make_grid(mesh8)
+    verify_all(grid)
+
+
+def test_healthy_after_refine_and_balance(mesh8):
+    grid = make_grid(mesh8, max_lvl=2)
+    ids = grid.get_cells()
+    grid.refine_completely(int(ids[0]))
+    grid.stop_refining()
+    verify_all(grid)
+    grid.balance_load()
+    verify_all(grid)
+
+
+def test_healthy_with_user_neighborhood(mesh8):
+    grid = make_grid(mesh8)
+    grid.add_neighborhood(7, [[1, 0, 0], [0, 1, 0]])
+    verify_all(grid)
+
+
+def test_pin_verified(mesh8):
+    grid = make_grid(mesh8)
+    cid = int(grid.get_cells()[0])
+    grid.pin(cid, 3)
+    grid.balance_load()
+    V.pin_requests_succeeded(grid)
+    # corrupt: claim the pin went elsewhere
+    grid._pins[cid] = 5
+    with pytest.raises(VerificationError):
+        V.pin_requests_succeeded(grid)
+
+
+def test_corrupt_owner_detected(mesh8):
+    grid = make_grid(mesh8)
+    grid.plan.owner = grid.plan.owner.copy()
+    grid.plan.owner[0] = 99
+    with pytest.raises(VerificationError):
+        V.is_consistent(grid)
+
+
+def test_corrupt_neighbor_list_detected(mesh8):
+    grid = make_grid(mesh8)
+    nl = grid.plan.hoods[DEFAULT_NEIGHBORHOOD_ID].lists
+    nl.of_neighbor = nl.of_neighbor.copy()
+    nl.of_neighbor[0] = nl.of_neighbor[1]
+    with pytest.raises(VerificationError):
+        V.verify_neighbors(grid)
+
+
+def test_corrupt_send_list_detected(mesh8):
+    grid = make_grid(mesh8)
+    hp = grid.plan.hoods[DEFAULT_NEIGHBORHOOD_ID]
+    if not np.any(hp.send_rows >= 0):
+        pytest.skip("no remote transfers on this mesh")
+    hp.send_rows = hp.send_rows.copy()
+    p, q, j = np.argwhere(hp.send_rows >= 0)[0]
+    hp.send_rows[p, q, j] = -1
+    with pytest.raises(VerificationError):
+        V.verify_remote_neighbor_info(grid)
+
+
+def test_corrupt_pad_row_detected(mesh8):
+    grid = make_grid(mesh8)
+    arr = np.asarray(grid.data["rho"]).copy()
+    arr[:, grid.plan.R - 1] = 1.0
+    import jax.numpy as jnp
+
+    grid.data["rho"] = jnp.asarray(arr, device=grid._sharding())
+    with pytest.raises(VerificationError):
+        V.verify_user_data(grid)
+
+
+def test_debug_env_hook(mesh8, monkeypatch):
+    monkeypatch.setenv("DCCRG_DEBUG", "1")
+    grid = make_grid(mesh8, max_lvl=1)
+    ids = grid.get_cells()
+    grid.refine_completely(int(ids[0]))
+    grid.stop_refining()  # runs verify_all internally via _build_plan
