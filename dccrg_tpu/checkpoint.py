@@ -35,17 +35,58 @@ import numpy as np
 ENDIAN_MAGIC = 0x1234567890ABCDEF
 
 
-def _payload_spec(grid):
-    """(names, itemsize per cell, per-field (shape, dtype, nbytes))."""
-    names = sorted(grid.fields)
+def _payload_spec_of(fields):
+    """(names, itemsize per cell, per-field (name, shape, dtype, nbytes))
+    for a ``{name: (shape, dtype)}`` field spec. The per-cell payload is
+    the fields in sorted-name order — the serialization contract shared
+    by save/load and the standalone dc2vtk converter."""
+    names = sorted(fields)
     spec = []
     total = 0
     for n in names:
-        shape, dtype = grid.fields[n]
+        shape, dtype = fields[n]
         nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize if shape else np.dtype(dtype).itemsize
-        spec.append((n, shape, np.dtype(dtype), nbytes))
+        spec.append((n, tuple(shape), np.dtype(dtype), nbytes))
         total += nbytes
     return names, total, spec
+
+
+def _payload_spec(grid):
+    return _payload_spec_of(grid.fields)
+
+
+def parse_metadata(data: bytes, header_size: int = 0):
+    """Parse a .dc file's metadata block (the format documented above):
+    returns (mapping, hood_len, topology, geometry, cells, offsets,
+    payload_start). Shared by load_grid_data and dc_to_vtk."""
+    from .geometry import geometry_from_bytes
+    from .mapping import Mapping
+    from .topology import GridTopology
+
+    pos = header_size
+    (magic,) = struct.unpack_from("<Q", data, pos)
+    pos += 8
+    if magic != ENDIAN_MAGIC:
+        raise ValueError(
+            f"bad endianness magic {magic:#x}: file written on an "
+            "incompatible architecture or wrong header_size"
+        )
+    mapping = Mapping.from_bytes(data[pos : pos + 28])
+    pos += 28
+    (hood_len,) = struct.unpack_from("<I", data, pos)
+    pos += 4
+    topology = GridTopology.from_bytes(data[pos : pos + 3])
+    pos += 3
+    (geom_len,) = struct.unpack_from("<I", data, pos)
+    pos += 4
+    geometry = geometry_from_bytes(data[pos : pos + geom_len], mapping, topology)
+    pos += geom_len
+    (n_cells,) = struct.unpack_from("<Q", data, pos)
+    pos += 8
+    pairs = np.frombuffer(data, dtype=np.uint64, count=2 * n_cells, offset=pos).reshape(-1, 2)
+    cells = pairs[:, 0].copy()
+    offsets = pairs[:, 1].copy()
+    return mapping, hood_len, topology, geometry, cells, offsets, pos + 16 * n_cells
 
 
 def save_grid_data(grid, filename: str, header: bytes = b"") -> None:
@@ -94,31 +135,10 @@ def load_grid_data(grid, filename: str, header_size: int = 0) -> bytes:
     with open(filename, "rb") as f:
         data = f.read()
 
-    pos = header_size
     header = data[:header_size]
-    (magic,) = struct.unpack_from("<Q", data, pos)
-    pos += 8
-    if magic != ENDIAN_MAGIC:
-        raise ValueError(
-            f"bad endianness magic {magic:#x}: file written on an "
-            "incompatible architecture or wrong header_size"
-        )
-    from .mapping import Mapping
-    from .topology import GridTopology
-    from .geometry import geometry_from_bytes
-
-    mapping = Mapping.from_bytes(data[pos : pos + 28])
-    pos += 28
-    (hood_len,) = struct.unpack_from("<I", data, pos)
-    pos += 4
-    topology = GridTopology.from_bytes(data[pos : pos + 3])
-    pos += 3
-    (geom_len,) = struct.unpack_from("<I", data, pos)
-    pos += 4
-    geometry = geometry_from_bytes(data[pos : pos + geom_len], mapping, topology)
-    pos += geom_len
-    (n_cells,) = struct.unpack_from("<Q", data, pos)
-    pos += 8
+    mapping, hood_len, topology, geometry, cells, offsets, _ = parse_metadata(
+        data, header_size
+    )
 
     if mapping != grid.mapping:
         raise ValueError(f"file grid {mapping} does not match {grid.mapping}")
@@ -135,10 +155,6 @@ def load_grid_data(grid, filename: str, header_size: int = 0) -> bytes:
             "file geometry parameters do not match the grid (same kind, "
             "different start/cell lengths or coordinate arrays)"
         )
-
-    pairs = np.frombuffer(data, dtype=np.uint64, count=2 * n_cells, offset=pos).reshape(-1, 2)
-    cells = pairs[:, 0].copy()
-    offsets = pairs[:, 1]
 
     names, cell_bytes, spec = _payload_spec(grid)
     grid.load_cells(cells)

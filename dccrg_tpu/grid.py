@@ -659,8 +659,8 @@ class Grid:
             return vals.copy()
         _, hid = self._neighbor_items[name]
         nl = self.plan.hoods[hid].lists
-        pos = int(np.searchsorted(self.plan.cells, np.uint64(cell)))
-        if pos >= len(self.plan.cells) or self.plan.cells[pos] != np.uint64(cell):
+        pos = self._cell_pos(cell)
+        if pos is None:
             raise ValueError(f"unknown cell {cell}")
         return vals[nl.of_source == pos]
 
@@ -680,8 +680,8 @@ class Grid:
     def is_inner(self, cell) -> bool:
         """True when no neighbor relation of the cell crosses a device
         boundary (dccrg_iterator_support.hpp:33-56)."""
-        pos = int(np.searchsorted(self.plan.cells, np.uint64(cell)))
-        if pos >= len(self.plan.cells) or self.plan.cells[pos] != np.uint64(cell):
+        pos = self._cell_pos(cell)
+        if pos is None:
             raise ValueError(f"unknown cell {cell}")
         d = int(self.plan.owner[pos])
         row = self.plan.local_row_of[d][int(cell)]
@@ -727,18 +727,28 @@ class Grid:
 
     # -- neighbor queries (dccrg.hpp:831-3236) -------------------------
 
+    def _cell_pos(self, cell):
+        """Index of ``cell`` in the sorted replicated cell list, or
+        None for an unknown id (the reference's cell_process lookup)."""
+        pos = int(np.searchsorted(self.plan.cells, np.uint64(cell)))
+        if pos >= len(self.plan.cells) or self.plan.cells[pos] != np.uint64(cell):
+            return None
+        return pos
+
     def get_neighbors_of(self, cell, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
         """[(neighbor id, (dx, dy, dz))] in neighborhood-item order."""
         nl = self.plan.hoods[neighborhood_id].lists
-        pos = int(np.searchsorted(self.plan.cells, np.uint64(cell)))
-        if pos >= len(self.plan.cells) or self.plan.cells[pos] != np.uint64(cell):
+        pos = self._cell_pos(cell)
+        if pos is None:
             raise ValueError(f"unknown cell {cell}")
         m = nl.of_source == pos
         return list(zip(nl.of_neighbor[m].tolist(), map(tuple, nl.of_offset[m])))
 
     def get_neighbors_to(self, cell, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
         nl = self.plan.hoods[neighborhood_id].lists
-        pos = int(np.searchsorted(self.plan.cells, np.uint64(cell)))
+        pos = self._cell_pos(cell)
+        if pos is None:
+            raise ValueError(f"unknown cell {cell}")
         m = nl.to_source == pos
         return list(zip(nl.to_neighbor[m].tolist(), map(tuple, nl.to_offset[m])))
 
@@ -758,6 +768,85 @@ class Grid:
                     elif lo == size:
                         out.append((nid, dim + 1))
         return out
+
+    def get_neighbors_of_at_offset(self, cell, x, y, z,
+                                   neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
+        """Neighbors of ``cell`` produced by the neighborhood item
+        (x, y, z) — [(id, (dx, dy, dz))], empty for the zero offset, an
+        offset outside the neighborhood, or an unknown cell (reference
+        get_neighbors_of_at_offset, dccrg.hpp:3110-3160)."""
+        if (x, y, z) == (0, 0, 0):
+            return []
+        hood = self.plan.hoods.get(neighborhood_id)
+        if hood is None:
+            return []
+        item = np.nonzero(np.all(hood.offsets == np.array([x, y, z]), axis=1))[0]
+        if len(item) == 0:
+            return []
+        pos = self._cell_pos(cell)
+        if pos is None:
+            return []
+        nl = hood.lists
+        m = (nl.of_source == pos) & (nl.of_item == item[0])
+        return list(zip(nl.of_neighbor[m].tolist(), map(tuple, nl.of_offset[m])))
+
+    def get_remote_neighbors_of(self, cell,
+                                neighborhood_id=DEFAULT_NEIGHBORHOOD_ID,
+                                sorted: bool = False):
+        """Neighbors of ``cell`` owned by a different device than the
+        cell itself (reference get_remote_neighbors_of,
+        dccrg.hpp:3175-3234)."""
+        return self._remote_neighbors(cell, neighborhood_id, sorted, to=False)
+
+    def get_remote_neighbors_to(self, cell,
+                                neighborhood_id=DEFAULT_NEIGHBORHOOD_ID,
+                                sorted: bool = False):
+        """Cells considering ``cell`` a neighbor that live on a
+        different device (reference get_remote_neighbors_to,
+        dccrg.hpp:3236-3296)."""
+        return self._remote_neighbors(cell, neighborhood_id, sorted, to=True)
+
+    def _remote_neighbors(self, cell, neighborhood_id, sorted, to):
+        hood = self.plan.hoods.get(neighborhood_id)
+        if hood is None:
+            return np.empty(0, np.uint64)
+        pos = self._cell_pos(cell)
+        if pos is None:
+            return np.empty(0, np.uint64)
+        nl = hood.lists
+        if to:
+            nbrs = nl.to_neighbor[nl.to_source == pos]
+        else:
+            nbrs = nl.of_neighbor[nl.of_source == pos]
+        own = int(self.plan.owner[pos])
+        nbr_owner = self.plan.owner[np.searchsorted(self.plan.cells, nbrs)]
+        out = nbrs[nbr_owner != own]
+        return np.sort(out) if sorted else out
+
+    def find_cells(self, indices_min, indices_max,
+                   minimum_refinement_level: int = 0,
+                   maximum_refinement_level: int | None = None) -> np.ndarray:
+        """Existing cells whose index volume overlaps the inclusive box
+        [indices_min, indices_max] and whose refinement level is within
+        the given range (reference find_cells, dccrg.hpp:4908-5030).
+        Indices are in smallest-possible-cell units; result id-sorted."""
+        if maximum_refinement_level is None:
+            maximum_refinement_level = self.mapping.max_refinement_level
+        if minimum_refinement_level > maximum_refinement_level:
+            raise ValueError("minimum refinement level > maximum")
+        if maximum_refinement_level > self.mapping.max_refinement_level:
+            raise ValueError("maximum refinement level too large")
+        lo = np.asarray(indices_min, dtype=np.int64)
+        hi = np.asarray(indices_max, dtype=np.int64)
+        if np.any(lo > hi):
+            raise ValueError("minimum index > maximum index")
+        cells = self.plan.cells
+        lvl = self.mapping.get_refinement_level(cells)
+        keep = (lvl >= minimum_refinement_level) & (lvl <= maximum_refinement_level)
+        idx = self.mapping.get_indices(cells).astype(np.int64)
+        size = self.mapping.get_cell_length_in_indices(cells).astype(np.int64)
+        overlap = np.all((idx <= hi) & (idx + size[:, None] - 1 >= lo), axis=1)
+        return cells[keep & overlap]
 
     # -- user neighborhoods (dccrg.hpp:6491-6681) ----------------------
 
@@ -1348,8 +1437,8 @@ class Grid:
         return 1
 
     def is_local(self, cell, device=None) -> bool:
-        pos = np.searchsorted(self.plan.cells, np.uint64(cell))
-        if pos >= len(self.plan.cells) or self.plan.cells[pos] != np.uint64(cell):
+        pos = self._cell_pos(cell)
+        if pos is None:
             return False
         if device is None:
             return True
@@ -1357,7 +1446,7 @@ class Grid:
 
     def get_process(self, cell) -> int:
         """Owning device of a cell (reference cell_process lookup)."""
-        pos = np.searchsorted(self.plan.cells, np.uint64(cell))
-        if pos >= len(self.plan.cells) or self.plan.cells[pos] != np.uint64(cell):
+        pos = self._cell_pos(cell)
+        if pos is None:
             raise ValueError(f"unknown cell {cell}")
         return int(self.plan.owner[pos])

@@ -1,6 +1,6 @@
 """Auxiliary subsystems: observability, VTK dumps, profiling."""
 
 from .profiling import PhaseTimer
-from .vtk import write_vtk_file
+from .vtk import dc_to_vtk, write_vtk_file
 
-__all__ = ["PhaseTimer", "write_vtk_file"]
+__all__ = ["PhaseTimer", "dc_to_vtk", "write_vtk_file"]

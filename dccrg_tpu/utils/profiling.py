@@ -42,6 +42,10 @@ class PhaseTimer:
             self.totals[name] += time.perf_counter() - t0
             self.counts[name] += 1
 
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
+
     def report(self) -> dict:
         """{phase: {total, count, mean}} — the avg the reference prints
         per rank; min/max over ranks is meaningless on one host."""

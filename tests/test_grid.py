@@ -81,6 +81,72 @@ def test_neighbor_queries():
     assert sorted(tos) == sorted(ids)
 
 
+def test_neighbors_of_at_offset():
+    g = Grid(cell_data={"v": jnp.float32}).set_initial_length((4, 4, 4)).initialize(mesh_of(2))
+    assert g.get_neighbors_of_at_offset(22, 1, 0, 0) == [(23, (1, 0, 0))]
+    assert g.get_neighbors_of_at_offset(22, -1, -1, 0) == [(17, (-1, -1, 0))]
+    assert g.get_neighbors_of_at_offset(22, 0, 0, 0) == []
+    assert g.get_neighbors_of_at_offset(22, 5, 0, 0) == []  # outside hood
+    assert g.get_neighbors_of_at_offset(9999, 1, 0, 0) == []  # unknown cell
+    # at a non-periodic boundary the offset window is empty
+    assert g.get_neighbors_of_at_offset(1, -1, 0, 0) == []
+
+
+def test_neighbors_of_at_offset_refined():
+    g = (
+        Grid(cell_data={"v": jnp.float32})
+        .set_initial_length((2, 2, 1))
+        .set_maximum_refinement_level(1)
+        .initialize(mesh_of(2))
+    )
+    g.refine_completely(2)
+    g.stop_refining()
+    # cell 1's +x window is covered by the 8 children of refined cell 2
+    at = g.get_neighbors_of_at_offset(1, 1, 0, 0)
+    assert len(at) == 8
+    assert {off[0] for _, off in at} <= {2, 3}  # all in the +x window
+    assert all(n in g.get_cells() for n, _ in at)
+
+
+def test_remote_neighbor_queries():
+    g = Grid(cell_data={"v": jnp.float32}).set_initial_length((8, 1, 1)).initialize(
+        mesh_of(4), partition="block"
+    )
+    # block partition: cells 1-2 on dev0, 3-4 on dev1, ...
+    assert list(g.get_remote_neighbors_of(2, sorted=True)) == [3]
+    assert list(g.get_remote_neighbors_to(2, sorted=True)) == [3]
+    assert len(g.get_remote_neighbors_of(1)) == 0  # inner cell
+    assert len(g.get_remote_neighbors_of(9999)) == 0  # unknown cell
+
+
+def test_find_cells_box():
+    g = (
+        Grid(cell_data={"v": jnp.float32})
+        .set_initial_length((2, 2, 1))
+        .set_maximum_refinement_level(1)
+        .initialize(mesh_of(2))
+    )
+    g.refine_completely(1)
+    g.stop_refining()
+    # index space is 4x4x2; the full box finds every leaf cell
+    np.testing.assert_array_equal(
+        g.find_cells((0, 0, 0), (3, 3, 1)), g.get_cells()
+    )
+    # level filter: only the 8 children of cell 1
+    lvl1 = g.find_cells((0, 0, 0), (3, 3, 1), minimum_refinement_level=1)
+    assert len(lvl1) == 8
+    # a corner box inside refined region: single smallest cell
+    one = g.find_cells((0, 0, 0), (0, 0, 0), minimum_refinement_level=1)
+    assert len(one) == 1
+    # the same corner unfiltered also matches only that child (cell 1
+    # was refined away)
+    np.testing.assert_array_equal(g.find_cells((0, 0, 0), (0, 0, 0)), one)
+    with pytest.raises(ValueError):
+        g.find_cells((2, 0, 0), (1, 0, 0))
+    with pytest.raises(ValueError):
+        g.find_cells((0, 0, 0), (1, 1, 1), 1, 0)
+
+
 def test_process_and_locality():
     g = Grid(cell_data={"v": jnp.float32}).set_initial_length((4, 4, 1)).initialize(mesh_of(4))
     for c in [1, 8, 16]:

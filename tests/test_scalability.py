@@ -1,0 +1,48 @@
+"""Scalability harness tests (reference tests/scalability)."""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from dccrg_tpu.models.scalability import ScalabilityModel, run_sweep
+
+
+def mesh_of(n):
+    return Mesh(np.array(jax.devices()[:n]), ("dev",))
+
+
+def test_model_runs_and_reports():
+    model = ScalabilityModel((8, 8, 8), floats_per_cell=4, work_iters=8,
+                             mesh=mesh_of(4))
+    rep = model.run(steps=3, warmup=1)
+    assert rep["n_devices"] == 4
+    assert rep["n_cells"] == 512
+    assert rep["solve_s_per_step"] > 0
+    assert rep["halo_s_per_step"] > 0
+    assert rep["cell_updates_per_sec"] > 0
+    # 4 f32 lanes per ghost cell
+    assert rep["halo_bytes_per_step"] == 16 * model.grid.get_number_of_update_receive_cells()
+
+
+def test_solve_preserves_determinism():
+    """Same step on 1 vs 8 devices gives identical payloads (the
+    reference requires any-process-count equivalence, tests/README:5-6)."""
+    out = []
+    for n in (1, 8):
+        m = ScalabilityModel((4, 4, 4), floats_per_cell=2, work_iters=4,
+                             mesh=mesh_of(n))
+        m.step()
+        out.append(np.asarray(m.grid.get("payload", m.grid.get_cells())))
+    # summation order over gathered neighbors differs with the mesh
+    # size; tolerance covers f32 reassociation noise only
+    np.testing.assert_allclose(out[0], out[1], rtol=1e-5, atol=1e-6)
+
+
+def test_sweep_driver():
+    rows = run_sweep(device_counts=[1, 2], length=(4, 4, 4),
+                     floats_per_cell=2, work_iters=2, steps=2)
+    assert [r["n_devices"] for r in rows] == [1, 2]
+    rows_weak = run_sweep(device_counts=[1, 2], length=(4, 4, 4),
+                          floats_per_cell=2, work_iters=2, steps=2, weak=True)
+    assert rows_weak[1]["n_cells"] == 2 * rows_weak[0]["n_cells"]

@@ -38,6 +38,25 @@ def test_vtk_output(tmp_path):
     assert f"POINTS {8 * len(cells)} float" in text
 
 
+def test_dc_to_vtk_standalone(tmp_path):
+    from dccrg_tpu.utils import dc_to_vtk
+
+    g = make_grid((2, 2, 1), max_lvl=1)
+    g.refine_completely(1)
+    g.stop_refining()
+    cells = g.get_cells()
+    g.set("v", cells, np.arange(len(cells), dtype=np.float32))
+    dc = str(tmp_path / "state.dc")
+    g.save_grid_data(dc, header=b"hdr!")
+    vtk = str(tmp_path / "state.vtk")
+    written = dc_to_vtk(dc, vtk, fields={"v": ((), np.float32)}, header_size=4)
+    np.testing.assert_array_equal(written, cells)
+    text = open(vtk).read()
+    assert "UNSTRUCTURED_GRID" in text
+    assert "SCALARS v double 1" in text
+    assert f"CELL_DATA {len(cells)}" in text
+
+
 def test_phase_timer():
     t = PhaseTimer()
     with t.phase("solve"):
