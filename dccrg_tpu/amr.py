@@ -64,6 +64,8 @@ def resolve_adaptation(
     dont_unrefines: set,
     pins: dict | None = None,
     weights: dict | None = None,
+    topology=None,
+    hood_len: int = 1,
 ) -> AmrResult:
     """Run the full commit pipeline on the replicated structure."""
     n = len(cells)
@@ -130,12 +132,34 @@ def resolve_adaptation(
             dont_unref[i] = True
 
     # --- override_unrefines (dccrg.hpp:9935-10124) ---------------------
-    # one pass over the pair arrays: per cell, the maximum
-    # post-refinement level found anywhere in its neighborhood
-    max_nbr_final = np.full(n, -1, dtype=np.int64)
-    if len(unref_parent):
-        np.maximum.at(max_nbr_final, pair_src, final_lvl[pair_nbr])
+    # The reference walks the neighborhood AROUND THE PARENT (BFS over
+    # neighbors_, :10019-10124): the parent's neighborhood window has
+    # the parent's own edge length as its radius unit — twice the
+    # children's — so a cell just outside the children's windows can
+    # still violate the <=1-level rule against the new parent. Check
+    # cells intersecting the parent's would-be window directly.
     accepted_parents = []
+    if len(unref_parent):
+        # geometry of potential violators: anything whose
+        # post-refinement level exceeds the candidate's children
+        idx_all = mapping.get_indices(cells).astype(np.int64)
+        size_all = (1 << (mapping.max_refinement_level - lvl)).astype(np.int64)
+        index_length = mapping.get_index_length().astype(np.int64)
+        radius = max(int(hood_len), 1)
+        periodic = np.array(
+            [topology.is_periodic(d) if topology is not None else False
+             for d in range(3)]
+        )
+        # per child level, the (indices, sizes) of all finer-than-child
+        # cells — shared by every candidate at that level
+        fine_by_lvl = {}
+
+        def fine_cells_at(child_lvl):
+            if child_lvl not in fine_by_lvl:
+                fine = final_lvl > child_lvl
+                fine_by_lvl[child_lvl] = (idx_all[fine], size_all[fine])
+            return fine_by_lvl[child_lvl]
+
     for parent in sorted(unref_parent):
         kids = mapping.get_all_children(np.uint64(parent))
         kid_idx = []
@@ -151,11 +175,29 @@ def resolve_adaptation(
         kid_idx = np.array(kid_idx)
         if refine_flag[kid_idx].any() or dont_unref[kid_idx].any():
             continue
-        # parent (level l-1) must stay within 1 level of everything in
-        # its children's neighborhoods: no neighbor with final level
-        # > child level may exist
+        # parent (level child-1) must stay within 1 level of everything
+        # in ITS neighborhood: no cell with final level > child level
+        # may intersect the parent's window
         child_lvl = lvl[kid_idx[0]]
-        if max_nbr_final[kid_idx].max() > child_lvl:
+        fi, fs = fine_cells_at(child_lvl)
+        if len(fi) == 0:
+            accepted_parents.append(parent)
+            continue
+        s_p = 2 * size_all[kid_idx[0]]
+        base = idx_all[kid_idx[0]]  # parent min corner = first child's
+        lo = base - radius * s_p
+        hi = base + (radius + 1) * s_p  # exclusive
+        hit = np.ones(len(fi), dtype=bool)
+        for d in range(3):
+            if periodic[d]:
+                span = index_length[d]
+                h = np.zeros(len(fi), dtype=bool)
+                for shift in (-span, 0, span):
+                    h |= (fi[:, d] + shift < hi[d]) & (fi[:, d] + fs + shift > lo[d])
+                hit &= h
+            else:
+                hit &= (fi[:, d] < hi[d]) & (fi[:, d] + fs > lo[d])
+        if hit.any():
             continue
         accepted_parents.append(parent)
 

@@ -771,24 +771,53 @@ class Grid:
 
     def get_neighbors_of_at_offset(self, cell, x, y, z,
                                    neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
-        """Neighbors of ``cell`` produced by the neighborhood item
-        (x, y, z) — [(id, (dx, dy, dz))], empty for the zero offset, an
-        offset outside the neighborhood, or an unknown cell (reference
-        get_neighbors_of_at_offset, dccrg.hpp:3110-3160)."""
+        """Neighbors of ``cell`` inside the neighborhood window at
+        offset (x, y, z) — [(id, (dx, dy, dz))], empty for the zero
+        offset, an offset outside the neighborhood, or an unknown cell
+        (reference get_neighbors_of_at_offset, dccrg.hpp:3110-3160).
+
+        Matches by window intersection, so a coarser neighbor covering
+        several windows is returned at each of them (as the reference's
+        index matching does), even though the stored neighbor list
+        holds it only once."""
         if (x, y, z) == (0, 0, 0):
             return []
         hood = self.plan.hoods.get(neighborhood_id)
         if hood is None:
             return []
-        item = np.nonzero(np.all(hood.offsets == np.array([x, y, z]), axis=1))[0]
-        if len(item) == 0:
+        if not np.any(np.all(hood.offsets == np.array([x, y, z]), axis=1)):
             return []
         pos = self._cell_pos(cell)
         if pos is None:
             return []
         nl = hood.lists
-        m = (nl.of_source == pos) & (nl.of_item == item[0])
-        return list(zip(nl.of_neighbor[m].tolist(), map(tuple, nl.of_offset[m])))
+        m = nl.of_source == pos
+        nbrs, offs = nl.of_neighbor[m], nl.of_offset[m]
+        if len(nbrs) == 0:
+            return []
+        size = int(self.mapping.get_cell_length_in_indices(np.uint64(cell)))
+        win = self.mapping.get_indices(np.uint64(cell)).astype(np.int64)
+        win += np.array([x, y, z], dtype=np.int64) * size
+        il = self.mapping.get_index_length().astype(np.int64)
+        for d in range(3):
+            if self.topology.is_periodic(d):
+                win[d] %= il[d]
+            elif not 0 <= win[d] < il[d]:
+                return []
+        nidx = self.mapping.get_indices(nbrs).astype(np.int64)
+        nsize = self.mapping.get_cell_length_in_indices(nbrs).astype(np.int64)
+        hit = np.ones(len(nbrs), dtype=bool)
+        for d in range(3):
+            if self.topology.is_periodic(d):
+                h = np.zeros(len(nbrs), dtype=bool)
+                for shift in (-il[d], 0, il[d]):
+                    h |= (nidx[:, d] + shift < win[d] + size) & (
+                        nidx[:, d] + nsize + shift > win[d]
+                    )
+                hit &= h
+            else:
+                hit &= (nidx[:, d] < win[d] + size) & (nidx[:, d] + nsize > win[d])
+        return list(zip(nbrs[hit].tolist(), map(tuple, offs[hit])))
 
     def get_remote_neighbors_of(self, cell,
                                 neighborhood_id=DEFAULT_NEIGHBORHOOD_ID,
@@ -1295,6 +1324,8 @@ class Grid:
             self._dont_unrefines,
             pins=self._pins,
             weights=self._weights,
+            topology=self.topology,
+            hood_len=self._hood_len,
         )
         self._refines.clear()
         self._unrefines.clear()

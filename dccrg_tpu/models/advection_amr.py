@@ -1,0 +1,262 @@
+"""Adaptive advection: the reference advection test's full loop —
+upwind finite-volume fluxes over AMR face neighbors, the relative-
+density-difference adaptation criterion, and periodic load balancing
+(tests/advection/2d.cpp:321-442, solve.hpp:44-333, adapter.hpp:47-311)
+— on the general distributed grid.
+
+TPU-first formulation: the reference's per-cell scatter loop (visit
+each face once, update both sides, solve.hpp:166-234) becomes a
+*gather* kernel — every cell accumulates its own flux from all of its
+face neighbors, so each face is evaluated twice (once per side) with
+identical face velocity / area / upwind density, which keeps the scheme
+conservative while staying embarrassingly parallel for the MXU/VPU.
+Face detection is the reference's offset arithmetic
+(solve.hpp:76-120): a neighbor at logical offset ``o`` with index
+length ``nl`` is a face neighbor in dimension d when ``o_d`` equals the
+cell's index length (+d side) or ``-nl`` (-d side) and the windows
+overlap in both other dimensions.
+
+Static per-cell quantities (edge lengths, velocities at the center,
+index length) are fields refreshed once per structure epoch and halo-
+exchanged once, so the per-step exchange only moves density (the
+reference's transfer-count trick, tests/advection/cell.hpp:31-55).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..grid import Grid
+
+STATIC_FIELDS = ("vx", "vy", "vz", "lx", "ly", "lz", "ilen")
+
+
+def velocity(centers: np.ndarray) -> np.ndarray:
+    """Solid-body rotation about (0.5, 0.5) (solve.hpp:339-346)."""
+    v = np.zeros_like(centers)
+    v[:, 0] = 0.5 - centers[:, 1]
+    v[:, 1] = centers[:, 0] - 0.5
+    return v
+
+
+def hump(centers: np.ndarray, x0=0.25, y0=0.5, radius=0.15) -> np.ndarray:
+    """Cosine hump initial density (tests/advection/initialize.hpp:54-66)."""
+    r = np.minimum(
+        np.sqrt((centers[:, 0] - x0) ** 2 + (centers[:, 1] - y0) ** 2), radius
+    ) / radius
+    return (1.0 + np.cos(np.pi * r)) / 4
+
+
+def _face_masks(cell_ilen, nbr_ilen, offs, mask):
+    """[L,S] boolean plus/minus face masks per dimension
+    (solve.hpp:76-120's overlap/direction arithmetic, vectorized)."""
+    ci = cell_ilen[:, None]
+    overlap = [(offs[:, :, d] < ci) & (offs[:, :, d] > -nbr_ilen) for d in range(3)]
+    pos = [offs[:, :, d] == ci for d in range(3)]
+    neg = [offs[:, :, d] == -nbr_ilen for d in range(3)]
+    faces = []
+    for d in range(3):
+        others = [overlap[e] for e in range(3) if e != d]
+        both = others[0] & others[1] & mask
+        faces.append((pos[d] & both, neg[d] & both))
+    return faces
+
+
+def make_flux_kernel():
+    """The upwind flux gather kernel (solve.hpp:44-266)."""
+
+    def kernel(cell, nbr, offs, mask, dt):
+        rho_c = cell["density"][:, None]
+        rho_n = nbr["density"]
+        ilen_c = cell["ilen"]
+        ilen_n = nbr["ilen"]
+        lens_c = [cell["lx"][:, None], cell["ly"][:, None], cell["lz"][:, None]]
+        lens_n = [nbr["lx"], nbr["ly"], nbr["lz"]]
+        vels_c = [cell["vx"][:, None], cell["vy"][:, None], cell["vz"][:, None]]
+        vels_n = [nbr["vx"], nbr["vy"], nbr["vz"]]
+        vol_c = (cell["lx"] * cell["ly"] * cell["lz"])[:, None]
+
+        faces = _face_masks(ilen_c, ilen_n, offs, mask)
+        flux = jnp.zeros_like(rho_n)
+        for d, (face_pos, face_neg) in enumerate(faces):
+            # velocity interpolated to the shared face (solve.hpp:168-175)
+            v = (lens_c[d] * vels_n[d] + lens_n[d] * vels_c[d]) / (
+                lens_c[d] + lens_n[d] + 1e-30
+            )
+            o1, o2 = [e for e in range(3) if e != d]
+            area = jnp.minimum(lens_c[o1] * lens_c[o2], lens_n[o1] * lens_n[o2])
+            # +d face: positive v carries cell density out (solve.hpp:180-234)
+            up_pos = jnp.where(v >= 0, rho_c, rho_n)
+            up_neg = jnp.where(v >= 0, rho_n, rho_c)
+            m = dt * v * area / vol_c
+            flux = flux - jnp.where(face_pos, up_pos * m, 0.0)
+            flux = flux + jnp.where(face_neg, up_neg * m, 0.0)
+        return {"flux": jnp.sum(flux, axis=1)}
+
+    return kernel
+
+
+def make_diff_kernel(diff_threshold: float):
+    """Max relative density difference over face neighbors
+    (adapter.hpp:110-131)."""
+
+    def kernel(cell, nbr, offs, mask):
+        rho_c = cell["density"][:, None]
+        rho_n = nbr["density"]
+        faces = _face_masks(cell["ilen"], nbr["ilen"], offs, mask)
+        is_face = jnp.zeros(mask.shape, dtype=bool)
+        for fp, fn in faces:
+            is_face = is_face | fp | fn
+        diff = jnp.abs(rho_c - rho_n) / (jnp.minimum(rho_c, rho_n) + diff_threshold)
+        return {"max_diff": jnp.max(jnp.where(is_face, diff, 0.0), axis=1)}
+
+    return kernel
+
+
+class AmrAdvection:
+    """The reference test's main program (tests/advection/2d.cpp):
+    solve / adapt every ``adapt_n`` / balance every ``balance_n``."""
+
+    def __init__(self, length=(32, 32, 1), max_refinement_level=1, mesh=None,
+                 cfl=0.5, diff_increase=0.02, diff_threshold=0.025,
+                 unrefine_sensitivity=0.5, partition=None):
+        self.cfl = cfl
+        self.diff_increase = diff_increase
+        self.diff_threshold = diff_threshold
+        self.unrefine_sensitivity = unrefine_sensitivity
+        cell_len = tuple(1.0 / n for n in length)
+        self.grid = (
+            Grid(cell_data={
+                "density": jnp.float32, "flux": jnp.float32,
+                "max_diff": jnp.float32,
+                "vx": jnp.float32, "vy": jnp.float32, "vz": jnp.float32,
+                "lx": jnp.float32, "ly": jnp.float32, "lz": jnp.float32,
+                "ilen": jnp.int32,
+            })
+            .set_initial_length(length)
+            .set_maximum_refinement_level(max_refinement_level)
+            .set_neighborhood_length(1)
+            .set_geometry("cartesian", start=(0.0, 0.0, 0.0),
+                          level_0_cell_length=cell_len)
+            .initialize(mesh, partition=partition)
+        )
+        self._flux_kernel = make_flux_kernel()
+        self._diff_kernel = make_diff_kernel(diff_threshold)
+        self._refresh_static()
+        cells = self.grid.get_cells()
+        self.grid.set("density", cells,
+                      hump(self.grid.geometry.get_center(cells)).astype(np.float32))
+        self.time = 0.0
+
+    # -- static per-epoch fields ---------------------------------------
+
+    def _refresh_static(self) -> None:
+        g = self.grid
+        cells = g.get_cells()
+        centers = g.geometry.get_center(cells)
+        lengths = g.geometry.get_length(cells)
+        v = velocity(centers)
+        for d, name in enumerate(("vx", "vy", "vz")):
+            g.set(name, cells, v[:, d].astype(np.float32))
+        for d, name in enumerate(("lx", "ly", "lz")):
+            g.set(name, cells, lengths[:, d].astype(np.float32))
+        g.set("ilen", cells,
+              g.mapping.get_cell_length_in_indices(cells).astype(np.int32))
+        # ghosts of static fields are valid for the whole epoch
+        g.update_copies_of_remote_neighbors(fields=list(STATIC_FIELDS))
+
+    # -- time stepping (2d.cpp:321-343) --------------------------------
+
+    def max_time_step(self) -> float:
+        """Global CFL limit (solve.hpp:289-333)."""
+        g = self.grid
+        cells = g.get_cells()
+        steps = []
+        for lname, vname in (("lx", "vx"), ("ly", "vy"), ("lz", "vz")):
+            l = g.get(lname, cells).astype(np.float64)
+            v = np.abs(g.get(vname, cells).astype(np.float64))
+            with np.errstate(divide="ignore"):
+                s = np.where(v > 0, l / np.maximum(v, 1e-300), np.inf)
+            steps.append(s.min())
+        return float(min(steps))
+
+    def step(self, dt: float | None = None) -> float:
+        if dt is None:
+            dt = self.cfl * self.max_time_step()
+        g = self.grid
+        g.update_copies_of_remote_neighbors(fields=["density"])
+        g.apply_stencil(
+            self._flux_kernel,
+            ["density", "vx", "vy", "vz", "lx", "ly", "lz", "ilen"],
+            ["flux"],
+            extra_args=(jnp.float32(dt),),
+        )
+        # apply_fluxes (solve.hpp:272-279)
+        g.data["density"] = g.data["density"] + g.data["flux"]
+        g.data["flux"] = jnp.zeros_like(g.data["flux"])
+        self.time += dt
+        return dt
+
+    # -- adaptation (adapter.hpp:47-311) -------------------------------
+
+    def adapt(self) -> tuple:
+        """check_for_adaptation + adapt_grid: returns (created, removed)."""
+        g = self.grid
+        if g.mapping.max_refinement_level == 0:
+            return (np.empty(0, np.uint64), np.empty(0, np.uint64))
+        g.update_copies_of_remote_neighbors(fields=["density"])
+        g.apply_stencil(
+            self._diff_kernel, ["density", "ilen"], ["max_diff"]
+        )
+        cells = g.get_cells()
+        diff = g.get("max_diff", cells).astype(np.float64)
+        lvl = g.mapping.get_refinement_level(cells)
+        refine_diff = (lvl + 1) * self.diff_increase
+        unrefine_diff = self.unrefine_sensitivity * refine_diff
+
+        to_refine = cells[(diff > refine_diff) & (lvl < g.mapping.max_refinement_level)]
+        keep = cells[(diff <= refine_diff) & (diff >= unrefine_diff) & (lvl > 0)]
+        to_unrefine = cells[(diff < unrefine_diff) & (lvl > 0)]
+        # conflict resolution between siblings is the grid's job
+        # (refine_completely overrides sibling unrefines, dccrg.hpp:2517)
+        for c in to_refine:
+            g.refine_completely(c)
+        for c in keep:
+            g.dont_unrefine(c)
+        for c in to_unrefine:
+            g.unrefine_completely(c)
+        created = g.stop_refining()
+        removed = g.get_removed_cells()
+        # project data across the structure change (adapter.hpp:229-301)
+        g.assign_children_from_parents(fields=["density"])
+        g.average_parents_from_children(fields=["density"])
+        g.clear_refined_unrefined_data()
+        self._refresh_static()
+        g.data["flux"] = jnp.zeros_like(g.data["flux"])
+        return created, removed
+
+    # -- load balancing (2d.cpp:425-438) -------------------------------
+
+    def balance(self) -> None:
+        self.grid.balance_load()
+        self._refresh_static()
+
+    # -- diagnostics ---------------------------------------------------
+
+    def total_mass(self) -> float:
+        g = self.grid
+        cells = g.get_cells()
+        rho = g.get("density", cells).astype(np.float64)
+        vol = np.prod(g.geometry.get_length(cells), axis=1)
+        return float(np.sum(rho * vol))
+
+    def run(self, steps: int, adapt_n: int = 0, balance_n: int = 0) -> None:
+        """The main loop (2d.cpp:321-442)."""
+        for i in range(1, steps + 1):
+            self.step()
+            if adapt_n and i % adapt_n == 0:
+                self.adapt()
+            if balance_n and i % balance_n == 0:
+                self.balance()

@@ -15,8 +15,13 @@ match the reference:
   or the coarser (level-1) cell containing it, or the 8 finer (level+1)
   cells inside it enumerated in z-order (x fastest) — dccrg's
   "expand to all siblings" rule (dccrg.hpp:4680-4713).
-- Each neighbor is recorded once per neighborhood item it satisfies
-  (duplicates across items are kept, dccrg.hpp:4497-4501).
+- Each distinct (neighbor, offset) relation is recorded once: a
+  coarser neighbor covering several neighborhood windows would repeat
+  with an identical min-corner offset, so those exact duplicates are
+  collapsed (see _dedup_entries; stencil kernels must see each physical
+  face once — the reference's advection DEBUG check asserts the same,
+  tests/advection/solve.hpp:236-266). Distinct offsets for the same
+  neighbor (periodic wrap-around) are all kept.
 - Recorded offsets are the displacement of the neighbor's min corner
   from the cell's min corner in smallest-cell index units, *logical*
   (not wrapped) across periodic boundaries — what the reference's
@@ -130,12 +135,36 @@ def find_neighbors_of(
     if native.lib is not None and len(np.atleast_1d(query_cells)) > 0:
         index_length = mapping.get_index_length().astype(np.int64)
         if not np.any(index_length >= _MAX_INDEX):
-            return native.find_neighbors_of(
+            out = native.find_neighbors_of(
                 mapping, topology, all_cells_sorted, query_cells, neighborhood
             )
-    return _find_neighbors_of_numpy(
+            return _dedup_entries(*out)
+    return _dedup_entries(*_find_neighbors_of_numpy(
         mapping, topology, all_cells_sorted, query_cells, neighborhood
+    ))
+
+
+def _dedup_entries(src, nbr, off, item):
+    """Collapse exact-duplicate (source, neighbor, offset) entries.
+
+    A neighbor one level coarser than the queried cell covers up to 4
+    neighborhood windows, and every one of those items records it with
+    the same min-corner offset. Stencil kernels must see each physical
+    neighbor relation once (the reference's advection DEBUG check
+    asserts face-detected neighbors match the unique
+    get_face_neighbors_of set, tests/advection/solve.hpp:236-266), so
+    the first entry — lowest item index — is kept. A neighbor CAN
+    legitimately recur with different offsets (periodic wrap-around
+    self-neighbors), which is preserved."""
+    if len(src) == 0:
+        return src, nbr, off, item
+    key = np.stack(
+        [src.astype(np.int64), nbr.astype(np.int64),
+         off[:, 0], off[:, 1], off[:, 2]], axis=1,
     )
+    _, first = np.unique(key, axis=0, return_index=True)
+    keep = np.sort(first)
+    return src[keep], nbr[keep], off[keep], item[keep]
 
 
 def _find_neighbors_of_numpy(

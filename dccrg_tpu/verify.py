@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .neighbors import _find_neighbors_of_numpy, verify_tiling
+from .neighbors import _dedup_entries, _find_neighbors_of_numpy, verify_tiling
 
 # parity with grid.DEFAULT_NEIGHBORHOOD_ID (import would be circular)
 _DEFAULT_HOOD = -0xDCC
@@ -90,9 +90,9 @@ def verify_neighbors(grid) -> None:
     cells = plan.cells
     for hid, offsets in grid.neighborhoods.items():
         nl = plan.hoods[hid].lists
-        src, nbr, off, item = _find_neighbors_of_numpy(
+        src, nbr, off, item = _dedup_entries(*_find_neighbors_of_numpy(
             grid.mapping, grid.topology, cells, cells, offsets
-        )
+        ))
         if not (
             np.array_equal(src, nl.of_source)
             and np.array_equal(nbr, nl.of_neighbor)

@@ -105,6 +105,22 @@ def test_neighbors_of_at_offset_refined():
     at = g.get_neighbors_of_at_offset(1, 1, 0, 0)
     assert len(at) == 8
     assert {off[0] for _, off in at} <= {2, 3}  # all in the +x window
+    # the inverse view: a child of 2 sees coarse cell 1 at EVERY window
+    # it covers (the reference's index matching returns it per offset)
+    g2 = (
+        Grid(cell_data={"v": jnp.float32})
+        .set_initial_length((2, 2, 1))
+        .set_maximum_refinement_level(1)
+        .initialize(mesh_of(2))
+    )
+    g2.refine_completely(2)
+    g2.stop_refining()
+    kids = g2.mapping.get_all_children(np.uint64(2))
+    # kids[0] at the -x face corner: cell 1 covers its (-1,0,0) and
+    # (-1,1,0) windows
+    for w in ((-1, 0, 0), (-1, 1, 0)):
+        at = g2.get_neighbors_of_at_offset(int(kids[0]), *w)
+        assert 1 in [n for n, _ in at], w
     assert all(n in g.get_cells() for n, _ in at)
 
 

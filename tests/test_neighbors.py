@@ -103,7 +103,13 @@ def brute_force_neighbors_of(mapping, topology, cells, cell, hood):
                 # logical offset: window offset + position within window
                 rel = vi - wrapped
                 out.append((it, int(v), tuple(h * s + rel)))
-    return out
+    # the engine collapses exact-duplicate (neighbor, offset) entries
+    # (a coarser neighbor covering several windows), keeping the
+    # first / lowest item
+    seen = {}
+    for it, v, off in out:
+        seen.setdefault((v, off), (it, v, off))
+    return list(seen.values())
 
 
 def engine_neighbors_of(mapping, topology, cells, cell, hood):
