@@ -29,6 +29,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..grid import Grid
+from ..neighbors import face_masks
 
 STATIC_FIELDS = ("vx", "vy", "vz", "lx", "ly", "lz", "ilen")
 
@@ -49,21 +50,6 @@ def hump(centers: np.ndarray, x0=0.25, y0=0.5, radius=0.15) -> np.ndarray:
     return (1.0 + np.cos(np.pi * r)) / 4
 
 
-def _face_masks(cell_ilen, nbr_ilen, offs, mask):
-    """[L,S] boolean plus/minus face masks per dimension
-    (solve.hpp:76-120's overlap/direction arithmetic, vectorized)."""
-    ci = cell_ilen[:, None]
-    overlap = [(offs[:, :, d] < ci) & (offs[:, :, d] > -nbr_ilen) for d in range(3)]
-    pos = [offs[:, :, d] == ci for d in range(3)]
-    neg = [offs[:, :, d] == -nbr_ilen for d in range(3)]
-    faces = []
-    for d in range(3):
-        others = [overlap[e] for e in range(3) if e != d]
-        both = others[0] & others[1] & mask
-        faces.append((pos[d] & both, neg[d] & both))
-    return faces
-
-
 def make_flux_kernel():
     """The upwind flux gather kernel (solve.hpp:44-266)."""
 
@@ -78,7 +64,7 @@ def make_flux_kernel():
         vels_n = [nbr["vx"], nbr["vy"], nbr["vz"]]
         vol_c = (cell["lx"] * cell["ly"] * cell["lz"])[:, None]
 
-        faces = _face_masks(ilen_c, ilen_n, offs, mask)
+        faces = face_masks(ilen_c[:, None], ilen_n, offs, mask)
         flux = jnp.zeros_like(rho_n)
         for d, (face_pos, face_neg) in enumerate(faces):
             # velocity interpolated to the shared face (solve.hpp:168-175)
@@ -105,7 +91,7 @@ def make_diff_kernel(diff_threshold: float):
     def kernel(cell, nbr, offs, mask):
         rho_c = cell["density"][:, None]
         rho_n = nbr["density"]
-        faces = _face_masks(cell["ilen"], nbr["ilen"], offs, mask)
+        faces = face_masks(cell["ilen"][:, None], nbr["ilen"], offs, mask)
         is_face = jnp.zeros(mask.shape, dtype=bool)
         for fp, fn in faces:
             is_face = is_face | fp | fn

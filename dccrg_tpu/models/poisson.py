@@ -1,32 +1,39 @@
 """Poisson solver on the distributed grid.
 
 Equivalent of the reference's tests/poisson solver family
-(tests/poisson/poisson_solve.hpp): an iterative Krylov solve of
-nabla^2 u = rhs over grid cells, where each iteration updates ghost
-copies of the search direction and forms the 7-point Laplacian matvec
-from face neighbors.
+(tests/poisson/poisson_solve.hpp): the Numerical-Recipes 2.7.6
+biconjugate scheme over grid cells, with per-cell per-direction
+geometry factors so the same solver covers uniform, AMR, and stretched
+grids, plus boundary (Dirichlet) cells and skipped cells
+(poisson_solve.hpp:222-258's cells / cells_to_skip / boundary
+classification).
 
 Fidelity notes:
 
-- The reference iterates its Numerical-Recipes biconjugate scheme with
-  ``update_copies_of_remote_neighbors`` on a *sub-selection of cell
-  fields* chosen by ``Poisson_Cell::transfer_switch``
-  (poisson_solve.hpp:47-141): only the field needed per phase crosses
-  the network. Here that boundary is the ``fields=[...]`` argument of
-  the halo update — each CG iteration moves only ``p``.
-- Global dot products (MPI_Allreduce at poisson_solve.hpp:278-360) are
-  jnp reductions over the sharded field arrays: XLA inserts the
-  all-reduce.
-- The matvec runs through the gather-based stencil engine over a
-  user-declared face-only neighborhood (``add_neighborhood``), the
-  same mechanism apps use for custom stencils (dccrg.hpp:6491-6663).
-- Missing face neighbors (non-periodic boundaries) contribute no flux
-  (homogeneous Neumann); periodic problems project out the constant
-  nullspace, like the reference's failure_* handling of the singular
-  system.
+- Geometry factors: per direction, the offset to the face neighbor's
+  center is half_own + half_neighbor (missing or skipped neighbors act
+  as equal-size cells with no coupling); f_dir = ±2/(offset · total)
+  and the diagonal is -Σf (set_scaling_factor,
+  poisson_solve.hpp:691-830). A direction with 4 finer face neighbors
+  applies f/4 to each (:332-338).
+- The matrix is asymmetric under AMR, so the solve iterates both A·p0
+  and transpose(A)·p1 — the transpose using the *neighbor's* factor of
+  the opposite direction (:422-466).
+- The reference iterates `update_copies_of_remote_neighbors` on a
+  sub-selection of fields chosen by ``Poisson_Cell::transfer_switch``
+  (poisson_solve.hpp:47-141); here that boundary is the ``fields``
+  argument of the halo update — each iteration moves only p0/p1,
+  factors move once at preparation (the GEOMETRY transfer, :968-970).
+- Global dot products (MPI_Allreduce, :341-349) are jnp reductions
+  over the sharded fields: XLA inserts the all-reduce.
+- Cells neither solved nor skipped are boundary cells: their solution
+  feeds the initial residual (Dirichlet data, initialize_solver
+  :986-1041) and is never changed.
 
 ``DensePoissonSolver`` is the uniform fast path on DenseGrid for
-large problems.
+large problems (the serial reference solver's role,
+tests/poisson/reference_poisson_solve.hpp, doubles as the parity
+check).
 """
 
 from __future__ import annotations
@@ -39,53 +46,97 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..grid import DEFAULT_NEIGHBORHOOD_ID, Grid
 from ..dense import DenseGrid
-from ..neighbors import make_neighborhood
+from ..neighbors import face_masks, make_neighborhood
 
 POISSON_NEIGHBORHOOD_ID = 0xB01550
 
+# cell_type values (poisson_solve.hpp:143-149)
+SOLVE_CELL, BOUNDARY_CELL, SKIP_CELL = 1, 0, -1
+
+POISSON_FIELDS = {
+    "rhs": jnp.float32, "solution": jnp.float32,
+    "r0": jnp.float32, "r1": jnp.float32,
+    "p0": jnp.float32, "p1": jnp.float32, "Ap0": jnp.float32,
+    "fxp": jnp.float32, "fxn": jnp.float32,
+    "fyp": jnp.float32, "fyn": jnp.float32,
+    "fzp": jnp.float32, "fzn": jnp.float32,
+    "scale": jnp.float32, "ctype": jnp.int32, "ilen": jnp.int32,
+}
+
+_F_NAMES = (("fxp", "fxn"), ("fyp", "fyn"), ("fzp", "fzn"))
+_GEOMETRY_FIELDS = [n for pair in _F_NAMES for n in pair] + ["scale", "ctype", "ilen"]
+
+
+def _matvec_kernel(transpose: bool):
+    """A·p (or transpose(A)·p) over face neighbors
+    (poisson_solve.hpp:296-338 forward, :422-466 transpose)."""
+    src = "p1" if transpose else "p0"
+
+    def kernel(cell, nbr, offs, mask):
+        p_c = cell[src]
+        p_n = nbr[src]
+        faces = face_masks(cell["ilen"][:, None], nbr["ilen"], offs, mask)
+        if transpose:
+            # transpose reads A[n, c]: the /4 averaging applies when
+            # THIS cell is the finer side of n's face (:463-466)
+            finer = cell["ilen"][:, None] < nbr["ilen"]
+        else:
+            # finer face neighbors: 4 per direction, each weighted f/4
+            finer = nbr["ilen"] < cell["ilen"][:, None]
+        w = jnp.where(finer, 0.25, 1.0) * (nbr["ctype"] != SKIP_CELL)
+        acc = cell["scale"] * p_c
+        for d, (face_pos, face_neg) in enumerate(faces):
+            if transpose:
+                # neighbor's factor of the opposite direction (:436-455)
+                m_pos = nbr[_F_NAMES[d][1]]
+                m_neg = nbr[_F_NAMES[d][0]]
+            else:
+                m_pos = cell[_F_NAMES[d][0]][:, None]
+                m_neg = cell[_F_NAMES[d][1]][:, None]
+            acc = acc + jnp.sum(jnp.where(face_pos, m_pos * w * p_n, 0.0), axis=1)
+            acc = acc + jnp.sum(jnp.where(face_neg, m_neg * w * p_n, 0.0), axis=1)
+        return {"out": acc}
+
+    def wrapped(cell, nbr, offs, mask):
+        out = kernel(cell, nbr, offs, mask)
+        # only solve cells carry the result; others stay 0
+        return {("r1" if transpose else "Ap0"):
+                jnp.where(cell["ctype"] == SOLVE_CELL, out["out"], 0.0)}
+
+    return wrapped
+
 
 class PoissonSolver:
-    """CG Poisson solve on the general (AMR-capable) grid.
+    """Biconjugate Poisson solve on the general (AMR-capable) grid.
 
-    v1 restriction: refinement level 0 (the reference's uniform
-    variants; its AMR poisson uses per-direction geometry factors,
-    planned for the general path later).
+    Either wraps an existing grid declared with POISSON_FIELDS (the
+    reference solver is grid-agnostic the same way,
+    poisson_solve.hpp:252-258) or builds a uniform one from ``length``.
     """
 
-    def __init__(self, length, mesh=None, periodic=(True, True, True), dtype=jnp.float32):
-        self.grid = (
-            Grid(cell_data={"rhs": dtype, "solution": dtype, "r": dtype, "p": dtype, "Ap": dtype})
-            .set_initial_length(length)
-            .set_periodic(*periodic)
-            .set_neighborhood_length(1)
-            .initialize(mesh)
-        )
-        self.grid.add_neighborhood(POISSON_NEIGHBORHOOD_ID, make_neighborhood(0))
-        self.periodic = tuple(periodic)
-        # uniform level-0 cell lengths
-        self.dx = self.grid.geometry.get_length(np.uint64(1))
-        rdx2 = (1.0 / self.dx**2).astype(np.float32)
-        self._rdx2 = jnp.asarray(rdx2)
-        # local-row validity mask for global reductions
-        mask = np.zeros((self.grid.n_dev, self.grid.plan.R), dtype=np.float32)
-        for d in range(self.grid.n_dev):
-            mask[d, : self.grid.plan.n_local[d]] = 1.0
-        self._mask = jax.device_put(jnp.asarray(mask), self.grid._sharding())
-        self._matvec_kernel = self._make_matvec()
-
-    def _make_matvec(self):
-        rdx2 = self._rdx2
-
-        def kernel(cell, nbr, offs, mask):
-            p_c = cell["p"]
-            p_n = nbr["p"]
-            # per-slot 1/dx^2 by face axis (offset is nonzero along
-            # exactly one axis for the face neighborhood)
-            fac = jnp.sum(jnp.where(offs != 0, rdx2[None, None, :], 0.0), axis=-1)
-            terms = jnp.where(mask, fac * (p_n - p_c[:, None]), 0.0)
-            return {"Ap": jnp.sum(terms, axis=1)}
-
-        return kernel
+    def __init__(self, length=None, mesh=None, periodic=(True, True, True),
+                 dtype=jnp.float32, grid: Grid | None = None,
+                 max_refinement_level: int = 0):
+        if grid is not None:
+            self.grid = grid
+        else:
+            self.grid = (
+                Grid(cell_data=dict(POISSON_FIELDS))
+                .set_initial_length(length)
+                .set_periodic(*periodic)
+                .set_maximum_refinement_level(max_refinement_level)
+                .set_neighborhood_length(1)
+                .initialize(mesh)
+            )
+        missing = [n for n in POISSON_FIELDS if n not in self.grid.fields]
+        if missing:
+            raise ValueError(f"grid lacks Poisson fields {missing}")
+        if POISSON_NEIGHBORHOOD_ID not in self.grid.neighborhoods:
+            self.grid.add_neighborhood(POISSON_NEIGHBORHOOD_ID, make_neighborhood(0))
+        self._fwd = _matvec_kernel(transpose=False)
+        self._tr = _matvec_kernel(transpose=True)
+        self._prepared_epoch = None
+        self._solve_mask = None
 
     # -- field setup ---------------------------------------------------
 
@@ -102,57 +153,178 @@ class PoissonSolver:
     def solution(self) -> np.ndarray:
         return self.grid.get("solution", self.grid.get_cells())
 
+    # -- preparation (cache_system_info, poisson_solve.hpp:838-970) ----
+
+    def prepare(self, cells_to_solve=None, cells_to_skip=None) -> None:
+        """Classify cells and compute geometry factors for the current
+        structure epoch."""
+        g = self.grid
+        cells = g.get_cells()
+        n = len(cells)
+
+        def positions(ids, what):
+            ids = np.asarray(ids, dtype=np.uint64)
+            pos = np.searchsorted(cells, ids)
+            bad = (pos >= n) | (cells[np.minimum(pos, n - 1)] != ids)
+            if bad.any():
+                raise ValueError(f"{what} contains unknown cell id(s): "
+                                 f"{ids[bad][:5].tolist()}")
+            return pos
+
+        ctype = np.full(n, BOUNDARY_CELL, dtype=np.int32)
+        if cells_to_solve is None:
+            ctype[:] = SOLVE_CELL
+        else:
+            ctype[positions(cells_to_solve, "cells_to_solve")] = SOLVE_CELL
+        if cells_to_skip is not None:
+            pos = positions(cells_to_skip, "cells_to_skip")
+            # solve wins over skip (poisson_solve.hpp:230-233)
+            ctype[pos[ctype[pos] != SOLVE_CELL]] = SKIP_CELL
+
+        lengths = g.geometry.get_length(cells).astype(np.float64)
+        half = lengths / 2.0
+        ilen = g.mapping.get_cell_length_in_indices(cells).astype(np.int64)
+
+        # host face classification over the face-hood neighbor lists
+        nl = g.plan.hoods[POISSON_NEIGHBORHOOD_ID].lists
+        src, nbr_pos = nl.of_source, np.searchsorted(cells, nl.of_neighbor)
+        offs = nl.of_offset
+        ok = ctype[nbr_pos] != SKIP_CELL
+        faces = face_masks(ilen[src], ilen[nbr_pos], offs, ok)
+        # per (cell, direction, sign): non-skip face neighbor half size
+        has = np.zeros((n, 3, 2), dtype=bool)
+        nbr_half = np.zeros((n, 3, 2), dtype=np.float64)
+        for d in range(3):
+            for s, mm in enumerate(faces[d]):
+                has[src[mm], d, s] = True
+                nbr_half[src[mm], d, s] = half[nbr_pos[mm], d]
+
+        # offsets to neighbor centers; missing/skipped neighbors act as
+        # equal-size cells (poisson_solve.hpp:716-723)
+        pos_off = half + np.where(has[:, :, 0], nbr_half[:, :, 0], half)
+        neg_off = half + np.where(has[:, :, 1], nbr_half[:, :, 1], half)
+        tot = pos_off + neg_off
+        f_pos = np.where(has[:, :, 0], 2.0 / (pos_off * tot), 0.0)
+        f_neg = np.where(has[:, :, 1], 2.0 / (neg_off * tot), 0.0)
+        scale = -(f_pos.sum(axis=1) + f_neg.sum(axis=1))
+
+        for d in range(3):
+            g.set(_F_NAMES[d][0], cells, f_pos[:, d].astype(np.float32))
+            g.set(_F_NAMES[d][1], cells, f_neg[:, d].astype(np.float32))
+        g.set("scale", cells, scale.astype(np.float32))
+        g.set("ctype", cells, ctype)
+        g.set("ilen", cells, ilen.astype(np.int32))
+        # the GEOMETRY transfer: factors valid for the whole epoch
+        g.update_copies_of_remote_neighbors(
+            neighborhood_id=POISSON_NEIGHBORHOOD_ID, fields=_GEOMETRY_FIELDS
+        )
+
+        mask = np.zeros((g.n_dev, g.plan.R), dtype=np.float32)
+        for d in range(g.n_dev):
+            mask[d, : g.plan.n_local[d]] = 1.0
+        self._solve_mask = jax.device_put(jnp.asarray(mask), g._sharding()) * (
+            g.data["ctype"] == SOLVE_CELL
+        )
+        self._prepared_epoch = (g.plan.epoch,
+                                None if cells_to_solve is None else tuple(cells_to_solve),
+                                None if cells_to_skip is None else tuple(cells_to_skip))
+
     # -- reductions ----------------------------------------------------
 
     def _dot(self, a: str, b: str) -> float:
-        return float(jnp.sum(self.grid.data[a] * self.grid.data[b] * self._mask))
+        return float(jnp.sum(self.grid.data[a] * self.grid.data[b] * self._solve_mask))
 
-    def _matvec(self) -> None:
-        """Ap <- A p: ghost update of p only, then the face stencil."""
+    def _exchange_p(self, fields) -> None:
         self.grid.update_copies_of_remote_neighbors(
-            neighborhood_id=POISSON_NEIGHBORHOOD_ID, fields=["p"]
+            neighborhood_id=POISSON_NEIGHBORHOOD_ID, fields=fields
         )
+
+    def _apply(self, transpose: bool) -> None:
+        fields_in = ["p1" if transpose else "p0", "ilen", "ctype", "scale"] + [
+            n for pair in _F_NAMES for n in pair
+        ]
         self.grid.apply_stencil(
-            self._matvec_kernel, ["p"], ["Ap"], neighborhood_id=POISSON_NEIGHBORHOOD_ID
+            self._tr if transpose else self._fwd,
+            fields_in,
+            ["r1" if transpose else "Ap0"],
+            neighborhood_id=POISSON_NEIGHBORHOOD_ID,
         )
 
-    def _remove_mean(self, field: str) -> None:
-        total = float(jnp.sum(self.grid.data[field] * self._mask))
-        n = float(np.sum(self.grid.plan.n_local))
-        self.grid.data[field] = self.grid.data[field] - (total / n) * self._mask
+    # -- solve (poisson_solve.hpp:252-523) -----------------------------
 
-    # -- CG (the reference's iteration at poisson_solve.hpp:278-360) ---
-
-    def solve(self, rtol: float = 1e-5, max_iterations: int = 1000) -> dict:
+    def solve(self, rtol: float = 1e-5, max_iterations: int = 1000,
+              cells_to_solve=None, cells_to_skip=None,
+              cache_is_up_to_date: bool = False) -> dict:
         g = self.grid
-        singular = all(self.periodic)
+        # re-prepare only when the structure epoch or the cell
+        # classification changed (the reference's cache_is_up_to_date
+        # flag, poisson_solve.hpp:241-245, made automatic: the key
+        # includes plan.epoch, which changes on refine/balance)
+        del cache_is_up_to_date
+        key = (g.plan.epoch,
+               None if cells_to_solve is None else tuple(cells_to_solve),
+               None if cells_to_skip is None else tuple(cells_to_skip))
+        if key != self._prepared_epoch:
+            self.prepare(cells_to_solve, cells_to_skip)
+        mask = self._solve_mask
+        dims = g.mapping.length.get()
+        singular = (
+            cells_to_solve is None and cells_to_skip is None
+            and all(g.topology.is_periodic(d) or int(dims[d]) == 1
+                    for d in range(3))
+        )
         if singular:
             self._remove_mean("rhs")
-        # r = rhs - A x ; start from x = 0 unless a warm start is set
-        g.data["p"] = g.data["solution"]
-        self._matvec()
-        g.data["r"] = (g.data["rhs"] - g.data["Ap"]) * self._mask
-        g.data["p"] = g.data["r"]
-        rs = self._dot("r", "r")
+
+        # r0 = rhs - A·solution, with boundary cells' solution as data
+        # (initialize_solver, poisson_solve.hpp:986-1041)
+        g.data["p0"] = g.data["solution"]
+        self._exchange_p(["p0"])
+        self._apply(transpose=False)
+        g.data["r0"] = (g.data["rhs"] - g.data["Ap0"]) * mask
+        g.data["r1"] = g.data["r0"]
+        g.data["p0"] = g.data["r0"]
+        g.data["p1"] = g.data["r0"]
+
+        dot_r = self._dot("r0", "r1")
         b2 = self._dot("rhs", "rhs")
-        target = max(rtol * rtol * max(b2, 1e-30), 1e-30)
+        # pure-Dirichlet/Laplace problems have zero rhs on solve cells;
+        # fall back to the initial residual so rtol still applies
+        r2_0 = self._dot("r0", "r0")
+        target = max(rtol * rtol * max(b2, r2_0, 1e-30), 1e-30)
         iterations = 0
-        while rs > target and iterations < max_iterations:
-            self._matvec()
-            pAp = self._dot("p", "Ap")
-            if pAp == 0.0:
+        residual = self._dot("r0", "r0")
+        while residual > target and iterations < max_iterations:
+            self._exchange_p(["p0", "p1"])
+            self._apply(transpose=False)
+            dot_p = self._dot("p1", "Ap0")
+            if dot_p == 0.0 or dot_r == 0.0:
                 break
-            alpha = rs / pAp
-            g.data["solution"] = g.data["solution"] + alpha * g.data["p"] * self._mask
-            g.data["r"] = g.data["r"] - alpha * g.data["Ap"] * self._mask
-            rs_new = self._dot("r", "r")
-            beta = rs_new / rs
-            g.data["p"] = (g.data["r"] + beta * g.data["p"]) * self._mask
-            rs = rs_new
+            alpha = dot_r / dot_p
+            g.data["solution"] = g.data["solution"] + alpha * g.data["p0"] * mask
+            g.data["r0"] = g.data["r0"] - alpha * g.data["Ap0"] * mask
+            # r1 -= alpha · transpose(A)·p1 (:415-470); the kernel
+            # writes A^T p1 into r1's slot, so stash r1 first
+            r1_old = g.data["r1"]
+            self._apply(transpose=True)
+            g.data["r1"] = r1_old - alpha * g.data["r1"] * mask
+            new_dot_r = self._dot("r0", "r1")
+            beta = new_dot_r / dot_r
+            g.data["p0"] = (g.data["r0"] + beta * g.data["p0"]) * mask
+            g.data["p1"] = (g.data["r1"] + beta * g.data["p1"]) * mask
+            dot_r = new_dot_r
+            residual = self._dot("r0", "r0")
             iterations += 1
         if singular:
             self._remove_mean("solution")
-        return {"iterations": iterations, "residual": float(np.sqrt(max(rs, 0.0)))}
+        return {"iterations": iterations, "residual": float(np.sqrt(max(residual, 0.0)))}
+
+    def _remove_mean(self, field: str) -> None:
+        total = float(jnp.sum(self.grid.data[field] * self._solve_mask))
+        cnt = float(jnp.sum(self._solve_mask))
+        self.grid.data[field] = (
+            self.grid.data[field] - (total / max(cnt, 1.0)) * self._solve_mask
+        )
 
 
 class DensePoissonSolver:

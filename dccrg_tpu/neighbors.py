@@ -51,6 +51,28 @@ from .topology import GridTopology
 _MAX_INDEX = 2**62
 
 
+def face_masks(cell_ilen, nbr_ilen, offs, mask):
+    """Per-dimension (plus, minus) face masks for gathered stencil
+    blocks — the reference's face-detection offset arithmetic
+    (tests/advection/solve.hpp:76-120): a neighbor at logical offset
+    ``o`` with index length ``nl`` is a face neighbor in dimension d
+    when ``o_d`` equals the cell's index length (+d side) or ``-nl``
+    (-d side) and the windows overlap in both other dimensions.
+
+    Works on [L, S]-shaped device blocks (jnp) and on flat [E]-shaped
+    host arrays (numpy) alike: ``cell_ilen`` broadcastable against
+    ``nbr_ilen``, ``offs[..., 3]``, boolean ``mask``."""
+    ci = cell_ilen
+    overlap = [(offs[..., d] < ci) & (offs[..., d] > -nbr_ilen) for d in range(3)]
+    faces = []
+    for d in range(3):
+        others = [overlap[e] for e in range(3) if e != d]
+        both = others[0] & others[1] & mask
+        faces.append(((offs[..., d] == ci) & both,
+                      (offs[..., d] == -nbr_ilen) & both))
+    return faces
+
+
 def make_neighborhood(length: int) -> np.ndarray:
     """Default neighborhood offsets (dccrg.hpp:8017-8076): the 6 face
     offsets for length 0 (-z, -y, -x, +x, +y, +z order), else the full
