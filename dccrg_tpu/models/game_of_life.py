@@ -25,14 +25,28 @@ def life_kernel(cell, nbr, offs, mask):
 
 class GameOfLife:
     def __init__(self, length=(10, 10, 1), periodic=(False, False, False), mesh=None,
-                 partition=None):
+                 partition=None, max_refinement_level=0):
+        """``max_refinement_level > 0`` allows running the game on a
+        refined grid (the reference's refined GoL variants,
+        tests/game_of_life/refined.cpp, refined2d.cpp): live counting
+        runs over the AMR neighbor lists unchanged."""
         self.grid = (
             Grid(cell_data={"live": jnp.int32, "total": jnp.int32})
             .set_initial_length(length)
             .set_periodic(*periodic)
+            .set_maximum_refinement_level(max_refinement_level)
             .set_neighborhood_length(1)
             .initialize(mesh, partition=partition)
         )
+
+    def refine(self, ids) -> None:
+        """Refine the given cells and commit; new children inherit the
+        parent's live state (refined.cpp re-initializes equivalently)."""
+        for c in np.atleast_1d(ids):
+            self.grid.refine_completely(c)
+        self.grid.stop_refining()
+        self.grid.assign_children_from_parents(fields=["live"])
+        self.grid.clear_refined_unrefined_data()
 
     def set_alive(self, ids) -> None:
         self.grid.set("live", np.asarray(ids, dtype=np.uint64),

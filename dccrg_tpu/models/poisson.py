@@ -138,6 +138,15 @@ class PoissonSolver:
         self._prepared_epoch = None
         self._solve_mask = None
 
+    def _cache_key(self, cells_to_solve, cells_to_skip):
+        return (
+            self.grid.plan.epoch,
+            None if cells_to_solve is None
+            else np.asarray(cells_to_solve, np.uint64).tobytes(),
+            None if cells_to_skip is None
+            else np.asarray(cells_to_skip, np.uint64).tobytes(),
+        )
+
     # -- field setup ---------------------------------------------------
 
     def set_rhs(self, values) -> None:
@@ -225,9 +234,7 @@ class PoissonSolver:
         self._solve_mask = jax.device_put(jnp.asarray(mask), g._sharding()) * (
             g.data["ctype"] == SOLVE_CELL
         )
-        self._prepared_epoch = (g.plan.epoch,
-                                None if cells_to_solve is None else tuple(cells_to_solve),
-                                None if cells_to_skip is None else tuple(cells_to_skip))
+        self._prepared_epoch = self._cache_key(cells_to_solve, cells_to_skip)
 
     # -- reductions ----------------------------------------------------
 
@@ -261,18 +268,13 @@ class PoissonSolver:
         # flag, poisson_solve.hpp:241-245, made automatic: the key
         # includes plan.epoch, which changes on refine/balance)
         del cache_is_up_to_date
-        key = (g.plan.epoch,
-               None if cells_to_solve is None else tuple(cells_to_solve),
-               None if cells_to_skip is None else tuple(cells_to_skip))
-        if key != self._prepared_epoch:
+        if self._cache_key(cells_to_solve, cells_to_skip) != self._prepared_epoch:
             self.prepare(cells_to_solve, cells_to_skip)
         mask = self._solve_mask
-        dims = g.mapping.length.get()
-        singular = (
-            cells_to_solve is None and cells_to_skip is None
-            and all(g.topology.is_periodic(d) or int(dims[d]) == 1
-                    for d in range(3))
-        )
+        # with no Dirichlet classification every boundary closure —
+        # periodic wrap or missing-neighbor zero flux alike — is
+        # Neumann, so the operator always has the constant nullspace
+        singular = cells_to_solve is None and cells_to_skip is None
         if singular:
             self._remove_mean("rhs")
 
@@ -286,14 +288,13 @@ class PoissonSolver:
         g.data["p0"] = g.data["r0"]
         g.data["p1"] = g.data["r0"]
 
-        dot_r = self._dot("r0", "r1")
+        # r1 == r0 here, so one reduction serves all three initial dots
+        dot_r = residual = r2_0 = self._dot("r0", "r0")
         b2 = self._dot("rhs", "rhs")
         # pure-Dirichlet/Laplace problems have zero rhs on solve cells;
         # fall back to the initial residual so rtol still applies
-        r2_0 = self._dot("r0", "r0")
         target = max(rtol * rtol * max(b2, r2_0, 1e-30), 1e-30)
         iterations = 0
-        residual = self._dot("r0", "r0")
         while residual > target and iterations < max_iterations:
             self._exchange_p(["p0", "p1"])
             self._apply(transpose=False)

@@ -263,6 +263,37 @@ def test_glider_on_periodic_grid():
     np.testing.assert_array_equal(np.sort(gol.alive_cells()), np.sort(glider))
 
 
+def test_refined_blinker_far_refinement():
+    """GoL on a refined grid (tests/game_of_life/refined.cpp): refining
+    cells far from the pattern must not disturb the oscillator."""
+    gol = GameOfLife(mesh=mesh_of(4), max_refinement_level=1)
+    vertical = [gol_id(4, 3), gol_id(4, 4), gol_id(4, 5)]
+    horizontal = [gol_id(3, 4), gol_id(4, 4), gol_id(5, 4)]
+    gol.set_alive(vertical)
+    # refine the far corner (cells at x>=8, y>=8 are >1 cell away)
+    gol.refine([gol_id(9, 9), gol_id(8, 9), gol_id(9, 8)])
+    lvl = gol.grid.mapping.get_refinement_level(gol.grid.get_cells())
+    assert lvl.max() == 1
+    for turn in range(4):
+        gol.step()
+        expect = horizontal if turn % 2 == 0 else vertical
+        np.testing.assert_array_equal(np.sort(gol.alive_cells()), np.sort(expect))
+
+
+def test_refined_gol_device_invariance():
+    """Refined-grid GoL must evolve identically on 1 vs 8 devices
+    (tests/README:5-6)."""
+    out = []
+    for n in (1, 8):
+        gol = GameOfLife(length=(6, 6, 1), mesh=mesh_of(n), max_refinement_level=1)
+        gol.set_alive([1 + 1 + 1 * 6, 1 + 2 + 1 * 6, 1 + 3 + 1 * 6])
+        gol.refine([1, 36])
+        for _ in range(4):
+            gol.step()
+        out.append(np.sort(gol.alive_cells()))
+    np.testing.assert_array_equal(out[0], out[1])
+
+
 @pytest.mark.parametrize("partition", ["block", "morton", "hilbert"])
 def test_device_count_invariance(partition, rng):
     """Same results on 1 and 8 devices for random initial states (the
