@@ -30,10 +30,20 @@ from .mapping import Mapping
 from .topology import GridTopology
 from .types import ERROR_INDEX, as_cell_array
 
+# batch size above which per-cell queries dispatch to the native C++
+# kernels (below it, Python call overhead dominates the native win)
+_NATIVE_BATCH = 4096
+
+
 class _GeometryBase:
     """Shared implementation: everything derives from per-dimension
     level-0 cell boundary coordinates + uniform subdivision within a
-    level-0 cell."""
+    level-0 cell.
+
+    The NumPy paths and the native kernels compute with the SAME
+    formulas (same operation order), so results are bit-identical
+    regardless of batch size or native availability — asserted by
+    tests/test_native.py."""
 
     geometry_id: int = -1
 
@@ -45,6 +55,15 @@ class _GeometryBase:
     # one per dimension, each of length length[d]+1 (monotone increasing)
     def _boundaries(self, dimension: int) -> np.ndarray:
         raise NotImplementedError
+
+    def _native(self, n: int):
+        """The native module when available and worth dispatching to."""
+        if n >= _NATIVE_BATCH:
+            from . import native
+
+            if native.lib is not None:
+                return native
+        return None
 
     # --- extents ------------------------------------------------------
 
@@ -72,7 +91,17 @@ class _GeometryBase:
         return lvl, bad, l0, frac, extent
 
     def _min_and_length_flat(self, cells):
-        """(min corner, edge lengths) in one structure pass (1-d input)."""
+        """(min corner, edge lengths) in one structure pass (1-d input).
+
+        Dispatches to the native C++ kernel for large batches (the
+        geometry micro-benchmark hot path); NumPy is the reference
+        implementation and fallback."""
+        arr = np.atleast_1d(np.asarray(cells))
+        native = self._native(len(arr))
+        if native is not None:
+            return native.geometry_min_len(
+                self.mapping, [self._boundaries(d) for d in range(3)], arr
+            )
         lvl, bad, l0, frac, extent = self._cell_level_and_l0(cells)
         mins = np.empty(l0.shape, dtype=np.float64)
         lens = np.empty(l0.shape, dtype=np.float64)
@@ -111,8 +140,26 @@ class _GeometryBase:
         return out[0] if scalar else out
 
     def get_center(self, cells) -> np.ndarray:
-        mins, lens, scalar = self._min_and_length(cells)
-        out = mins + 0.5 * lens
+        arr = np.asarray(cells)
+        scalar = np.isscalar(cells) or arr.ndim == 0
+        flat = np.atleast_1d(arr).reshape(-1)
+        native = self._native(len(flat))
+        if native is not None:
+            out = native.geometry_centers(
+                self.mapping, [self._boundaries(d) for d in range(3)], flat
+            )
+        else:
+            # same formula and operation order as dn_geometry_centers:
+            # lo + (frac + extent/2) * (hi - lo)
+            lvl, bad, l0, frac, extent = self._cell_level_and_l0(flat)
+            out = np.empty(l0.shape, dtype=np.float64)
+            for d in range(3):
+                b = self._boundaries(d)
+                lo = b[np.minimum(l0[:, d], len(b) - 2)]
+                hi = b[np.minimum(l0[:, d] + 1, len(b) - 1)]
+                out[:, d] = lo + (frac[:, d] + 0.5 * extent) * (hi - lo)
+            out[bad] = np.nan
+        out = out.reshape(((1,) if scalar else arr.shape) + (3,))
         return out[0] if scalar else out
 
     # --- coordinate -> cell ------------------------------------------
@@ -217,6 +264,7 @@ class CartesianGeometry(_GeometryBase):
             raise ValueError(f"level_0_cell_length must be > 0, got {l0len}")
         self.start = start.copy()
         self.level_0_cell_length = l0len.copy()
+        self._len_tbl = None  # invalidate the per-level length cache
 
     def get_level_0_cell_length(self) -> np.ndarray:
         return self.level_0_cell_length.copy()
@@ -227,19 +275,41 @@ class CartesianGeometry(_GeometryBase):
             n + 1, dtype=np.float64
         )
 
-    # Faster closed-form override (no searchsorted / boundary arrays).
+    # Faster closed-form overrides (no searchsorted / boundary arrays;
+    # the geometry lookup throughputs in BASELINE.md hit these paths).
 
-    def _min_and_length_flat(self, cells):
-        cells_arr = as_cell_array(cells)
-        lvl = np.atleast_1d(np.asarray(self.mapping.get_refinement_level(cells_arr), np.int64))
-        bad = lvl < 0
-        idx = np.atleast_2d(self.mapping.get_indices(np.where(bad, np.uint64(1), cells_arr)))
-        scale = float(1 << self.mapping.max_refinement_level)
-        mins = self.start + idx.astype(np.float64) * (self.level_0_cell_length / scale)
-        lens = self.level_0_cell_length[None, :] / (1 << np.where(bad, 0, lvl)).astype(np.float64)[:, None]
-        mins[bad] = np.nan
-        lens[bad] = np.nan
-        return mins, lens
+    def _length_table(self):
+        """[max_ref_lvl + 1, 3] edge lengths per level (tiny, cached)."""
+        tbl = getattr(self, "_len_tbl", None)
+        n = self.mapping.max_refinement_level + 1
+        if tbl is None or tbl.shape[0] != n:
+            tbl = self.level_0_cell_length[None, :] / (
+                1 << np.arange(n, dtype=np.int64)
+            ).astype(np.float64)[:, None]
+            self._len_tbl = tbl
+        return tbl
+
+    def get_length(self, cells) -> np.ndarray:
+        """Edge lengths from the refinement level alone — uniform cells
+        need no index math (cf. dccrg_cartesian_geometry.hpp:226-280).
+        NumPy and native paths read the same per-level table, so they
+        are bit-identical."""
+        arr = np.asarray(cells)
+        scalar = np.isscalar(cells) or arr.ndim == 0
+        flat = as_cell_array(arr.reshape(-1))
+        native = self._native(len(flat))
+        if native is not None:
+            lens = native.cell_lengths(self.mapping, self._length_table(), flat)
+        else:
+            lvl = np.atleast_1d(
+                np.asarray(self.mapping.get_refinement_level(flat), np.int64)
+            )
+            bad = lvl < 0
+            lens = self._length_table()[np.where(bad, 0, lvl)]
+            if bad.any():
+                lens[bad] = np.nan
+        out = lens.reshape(((1,) if scalar else arr.shape) + (3,))
+        return out[0] if scalar else out
 
     def to_bytes(self) -> bytes:
         return struct.pack("<i", self.geometry_id) + self.start.tobytes() + self.level_0_cell_length.tobytes()

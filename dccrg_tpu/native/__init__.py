@@ -31,12 +31,13 @@ def _load():
     # code from one machine must not be reused on another (SIGILL)
     import platform
 
+    flags = "-O3 -march=native -std=c++17 -ffp-contract=off"
     try:
         gxx = subprocess.run(["g++", "--version"], capture_output=True,
                              text=True).stdout.splitlines()[0]
     except OSError:
         return None
-    fingerprint = _SRC.read_bytes() + f"|{platform.machine()}|{gxx}".encode()
+    fingerprint = _SRC.read_bytes() + f"|{platform.machine()}|{gxx}|{flags}".encode()
     tag = hashlib.sha256(fingerprint).hexdigest()[:16]
     so = _HERE / f"_dccrg_native_{tag}.so"
     if not so.exists():
@@ -50,6 +51,9 @@ def _load():
         tmp = _HERE / f".build_{os.getpid()}_{tag}.so"
         cmd = [
             "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+            # no FMA contraction: the geometry kernels promise
+            # bit-identical results vs the NumPy fallbacks
+            "-ffp-contract=off",
             "-fopenmp", "-o", str(tmp), str(_SRC),
         ]
         try:
@@ -96,6 +100,18 @@ def _load():
     dll.dn_cell_indices.restype = None
     dll.dn_cell_indices.argtypes = [u64p, ctypes.c_int32, u64p,
                                     ctypes.c_int64, u64p]
+    f64p = ctypes.POINTER(ctypes.c_double)
+    dll.dn_geometry_min_len.restype = None
+    dll.dn_geometry_min_len.argtypes = [u64p, ctypes.c_int32,
+                                        f64p, f64p, f64p,
+                                        u64p, ctypes.c_int64, f64p, f64p]
+    dll.dn_cell_lengths.restype = None
+    dll.dn_cell_lengths.argtypes = [u64p, ctypes.c_int32, f64p,
+                                    u64p, ctypes.c_int64, f64p]
+    dll.dn_geometry_centers.restype = None
+    dll.dn_geometry_centers.argtypes = [u64p, ctypes.c_int32,
+                                        f64p, f64p, f64p,
+                                        u64p, ctypes.c_int64, f64p]
     return dll
 
 
@@ -178,6 +194,54 @@ def cell_indices(mapping, cells) -> np.ndarray:
     lib.dn_cell_indices(
         _ptr(length, ctypes.c_uint64), mapping.max_refinement_level,
         _ptr(cells, ctypes.c_uint64), len(cells), _ptr(out, ctypes.c_uint64),
+    )
+    return out
+
+
+def geometry_min_len(mapping, boundaries, cells):
+    """Native (min corner, edge length) lookup: ``boundaries`` is the
+    per-dimension level-0 boundary coordinate arrays."""
+    cells = np.ascontiguousarray(cells, dtype=np.uint64)
+    length = np.ascontiguousarray(mapping.length.get(), dtype=np.uint64)
+    bd = [np.ascontiguousarray(b, dtype=np.float64) for b in boundaries]
+    n = len(cells)
+    out_min = np.empty((n, 3), dtype=np.float64)
+    out_len = np.empty((n, 3), dtype=np.float64)
+    lib.dn_geometry_min_len(
+        _ptr(length, ctypes.c_uint64), mapping.max_refinement_level,
+        _ptr(bd[0], ctypes.c_double), _ptr(bd[1], ctypes.c_double),
+        _ptr(bd[2], ctypes.c_double),
+        _ptr(cells, ctypes.c_uint64), n,
+        _ptr(out_min, ctypes.c_double), _ptr(out_len, ctypes.c_double),
+    )
+    return out_min, out_len
+
+
+def geometry_centers(mapping, boundaries, cells) -> np.ndarray:
+    """Native (n,3) cell center coordinates."""
+    cells = np.ascontiguousarray(cells, dtype=np.uint64)
+    length = np.ascontiguousarray(mapping.length.get(), dtype=np.uint64)
+    bd = [np.ascontiguousarray(b, dtype=np.float64) for b in boundaries]
+    out = np.empty((len(cells), 3), dtype=np.float64)
+    lib.dn_geometry_centers(
+        _ptr(length, ctypes.c_uint64), mapping.max_refinement_level,
+        _ptr(bd[0], ctypes.c_double), _ptr(bd[1], ctypes.c_double),
+        _ptr(bd[2], ctypes.c_double),
+        _ptr(cells, ctypes.c_uint64), len(cells), _ptr(out, ctypes.c_double),
+    )
+    return out
+
+
+def cell_lengths(mapping, length_table, cells) -> np.ndarray:
+    """Native (n,3) edge lengths from the per-level length table."""
+    cells = np.ascontiguousarray(cells, dtype=np.uint64)
+    length = np.ascontiguousarray(mapping.length.get(), dtype=np.uint64)
+    tbl = np.ascontiguousarray(length_table, dtype=np.float64)
+    out = np.empty((len(cells), 3), dtype=np.float64)
+    lib.dn_cell_lengths(
+        _ptr(length, ctypes.c_uint64), mapping.max_refinement_level,
+        _ptr(tbl, ctypes.c_double),
+        _ptr(cells, ctypes.c_uint64), len(cells), _ptr(out, ctypes.c_double),
     )
     return out
 

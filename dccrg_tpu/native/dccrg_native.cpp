@@ -19,6 +19,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <vector>
 
 #ifdef _OPENMP
@@ -30,12 +31,42 @@ extern "C" {
 // ---------------------------------------------------------------------------
 // Mapping: 1-based, level-major cell ids (parity with dccrg_mapping.hpp).
 
+// Division by a runtime-invariant u64 via 128-bit reciprocal multiply:
+// recip = floor((2^64 - 1) / d) gives q0 = mulhi(n, recip) within 2 of
+// floor(n / d) for any n; a tiny correction loop finishes the job.
+// (Replaces the hardware divides in the per-cell index math — the hot
+// op of the geometry/position lookups, tests/geometry README.)
+struct DnDiv {
+  uint64_t d;
+  uint64_t recip;
+};
+
+static inline DnDiv dn_div_make(uint64_t d) {
+  DnDiv v;
+  v.d = d;
+  v.recip = d ? ~(uint64_t)0 / d : 0;
+  return v;
+}
+
+static inline uint64_t dn_div(uint64_t n, const DnDiv dv, uint64_t *rem) {
+  uint64_t q = (uint64_t)(((__uint128_t)n * dv.recip) >> 64);
+  uint64_t r = n - q * dv.d;
+  while (r >= dv.d) {
+    r -= dv.d;
+    ++q;
+  }
+  *rem = r;
+  return q;
+}
+
 struct DnMapping {
   uint64_t length[3];       // level-0 extents
   int32_t max_lvl;          // maximum refinement level
   uint64_t level_first[32]; // first cell id of each level (1-based)
   uint64_t last_cell;
   uint64_t index_length[3]; // extents in smallest-cell index units
+  DnDiv div_lx[32];         // per-level reciprocal divisors for
+  DnDiv div_ly[32];         // length[0] << lvl and length[1] << lvl
 };
 
 static void dn_mapping_init(DnMapping *m, const uint64_t length[3],
@@ -50,6 +81,8 @@ static void dn_mapping_init(DnMapping *m, const uint64_t length[3],
     m->level_first[l] = acc;
     acc += per;
     per *= 8;
+    m->div_lx[l] = dn_div_make(length[0] << (uint64_t)l);
+    m->div_ly[l] = dn_div_make(length[1] << (uint64_t)l);
   }
   m->last_cell = acc - 1;
   for (int d = 0; d < 3; ++d)
@@ -59,22 +92,25 @@ static void dn_mapping_init(DnMapping *m, const uint64_t length[3],
 static inline int32_t dn_level(const DnMapping *m, uint64_t cell) {
   if (cell == 0 || cell > m->last_cell)
     return -1;
-  for (int l = m->max_lvl; l >= 0; --l)
-    if (cell >= m->level_first[l])
-      return l;
-  return -1;
+  // branchless: level = (number of level-firsts <= cell) - 1; random
+  // per-cell levels would mispredict an early-exit scan on every call
+  int32_t lvl = -1;
+  for (int32_t l = 0; l <= m->max_lvl; ++l)
+    lvl += (int32_t)(cell >= m->level_first[l]);
+  return lvl;
 }
 
 // indices (smallest-cell units) of a cell known to be valid at level lvl
 static inline void dn_indices(const DnMapping *m, uint64_t cell, int32_t lvl,
                               uint64_t out[3]) {
   const uint64_t within = cell - m->level_first[lvl];
-  const uint64_t lx = m->length[0] << (uint64_t)lvl;
-  const uint64_t ly = m->length[1] << (uint64_t)lvl;
   const uint64_t shift = (uint64_t)(m->max_lvl - lvl);
-  out[0] = (within % lx) << shift;
-  out[1] = ((within / lx) % ly) << shift;
-  out[2] = (within / (lx * ly)) << shift;
+  uint64_t ox, oy;
+  const uint64_t rest = dn_div(within, m->div_lx[lvl], &ox);
+  const uint64_t oz = dn_div(rest, m->div_ly[lvl], &oy);
+  out[0] = ox << shift;
+  out[1] = oy << shift;
+  out[2] = oz << shift;
 }
 
 // cell id at given smallest-cell indices and refinement level
@@ -369,6 +405,93 @@ void dn_cell_indices(const uint64_t grid_length[3], int32_t max_lvl,
     } else {
       dn_indices(&m, cells[i], lvl, &out[3 * i]);
     }
+  }
+}
+
+// Per-cell geometry lookup: min corner and edge lengths from
+// per-dimension level-0 boundary coordinate arrays (bd[d] has
+// grid_length[d]+1 monotone values).  Covers all three geometries —
+// the hot path of the reference's geometry micro-benchmarks
+// (tests/geometry README).  NaN rows for invalid ids.
+void dn_geometry_min_len(const uint64_t grid_length[3], int32_t max_lvl,
+                         const double *bx, const double *by, const double *bz,
+                         const uint64_t *cells, int64_t n, double *out_min,
+                         double *out_len) {
+  DnMapping m;
+  dn_mapping_init(&m, grid_length, max_lvl);
+  const double *bd[3] = {bx, by, bz};
+  const double inv_scale = 1.0 / (double)((uint64_t)1 << max_lvl);
+  const uint64_t mask = ((uint64_t)1 << max_lvl) - 1;
+  const double nan = std::numeric_limits<double>::quiet_NaN();
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t lvl = dn_level(&m, cells[i]);
+    if (lvl < 0) {
+      for (int d = 0; d < 3; ++d) {
+        out_min[3 * i + d] = nan;
+        out_len[3 * i + d] = nan;
+      }
+      continue;
+    }
+    uint64_t idx[3];
+    dn_indices(&m, cells[i], lvl, idx);
+    const double extent = 1.0 / (double)((uint64_t)1 << lvl);
+    for (int d = 0; d < 3; ++d) {
+      const uint64_t l0 = idx[d] >> max_lvl;
+      const double lo = bd[d][l0], hi = bd[d][l0 + 1];
+      const double frac = (double)(idx[d] & mask) * inv_scale;
+      out_min[3 * i + d] = lo + frac * (hi - lo);
+      out_len[3 * i + d] = (hi - lo) * extent;
+    }
+  }
+}
+
+// Per-cell center coordinates in one pass (no separate min/len
+// round-trip through the caller).
+void dn_geometry_centers(const uint64_t grid_length[3], int32_t max_lvl,
+                         const double *bx, const double *by, const double *bz,
+                         const uint64_t *cells, int64_t n, double *out) {
+  DnMapping m;
+  dn_mapping_init(&m, grid_length, max_lvl);
+  const double *bd[3] = {bx, by, bz};
+  const double inv_scale = 1.0 / (double)((uint64_t)1 << max_lvl);
+  const uint64_t mask = ((uint64_t)1 << max_lvl) - 1;
+  const double nan = std::numeric_limits<double>::quiet_NaN();
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t lvl = dn_level(&m, cells[i]);
+    if (lvl < 0) {
+      out[3 * i] = out[3 * i + 1] = out[3 * i + 2] = nan;
+      continue;
+    }
+    uint64_t idx[3];
+    dn_indices(&m, cells[i], lvl, idx);
+    const double half_extent = 0.5 / (double)((uint64_t)1 << lvl);
+    for (int d = 0; d < 3; ++d) {
+      const uint64_t l0 = idx[d] >> max_lvl;
+      const double lo = bd[d][l0], hi = bd[d][l0 + 1];
+      const double frac = (double)(idx[d] & mask) * inv_scale;
+      out[3 * i + d] = lo + (frac + half_extent) * (hi - lo);
+    }
+  }
+}
+
+// Per-cell edge lengths only: level lookup + a copy from the
+// (max_lvl+1, 3) per-level length table — no index math (the
+// reference's "cell size" micro-benchmark, tests/geometry README).
+void dn_cell_lengths(const uint64_t grid_length[3], int32_t max_lvl,
+                     const double *len_table, const uint64_t *cells,
+                     int64_t n, double *out) {
+  DnMapping m;
+  dn_mapping_init(&m, grid_length, max_lvl);
+  const double nan = std::numeric_limits<double>::quiet_NaN();
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t lvl = dn_level(&m, cells[i]);
+    const double *row = lvl < 0 ? nullptr : &len_table[3 * lvl];
+    out[3 * i] = row ? row[0] : nan;
+    out[3 * i + 1] = row ? row[1] : nan;
+    out[3 * i + 2] = row ? row[2] : nan;
   }
 }
 

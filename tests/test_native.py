@@ -121,3 +121,53 @@ def test_bulk_mapping_queries_match_numpy():
         nat.lib = saved
     np.testing.assert_array_equal(lvl_native, lvl_numpy)
     np.testing.assert_array_equal(idx_native, idx_numpy)
+
+
+@pytest.mark.parametrize("geometry_kind", ["cartesian", "stretched", "none"])
+def test_geometry_kernels_match_numpy(geometry_kind):
+    """The native geometry kernels (min/len, centers, lengths) must be
+    bit-identical to the NumPy fallbacks — same formulas, same
+    operation order — across the n=4096 dispatch threshold."""
+    from dccrg_tpu.geometry import (
+        CartesianGeometry,
+        NoGeometry,
+        StretchedCartesianGeometry,
+        _NATIVE_BATCH,
+    )
+
+    mapping = Mapping((4, 3, 2), 3)
+    topology = GridTopology((False, True, False))
+    if geometry_kind == "cartesian":
+        geom = CartesianGeometry(mapping, topology, start=(0.5, -1.0, 2.0),
+                                 level_0_cell_length=(0.1, 0.2, 0.3))
+    elif geometry_kind == "stretched":
+        rng0 = np.random.default_rng(1)
+        coords = [np.cumsum(np.abs(rng0.standard_normal(n + 1)) + 0.05)
+                  for n in (4, 3, 2)]
+        geom = StretchedCartesianGeometry(mapping, topology, coordinates=coords)
+    else:
+        geom = NoGeometry(mapping, topology)
+
+    rng = np.random.default_rng(0)
+    big = rng.integers(1, int(mapping.get_last_cell()) + 1,
+                       size=_NATIVE_BATCH + 100).astype(np.uint64)
+    # sprinkle invalid ids to cover the NaN rows
+    big[::97] = 0
+
+    for method in ("get_length", "get_center", "get_min", "get_max"):
+        fn = getattr(geom, method)
+        batched = fn(big)
+        # per-slice results (below the threshold -> NumPy fallback)
+        small = np.concatenate([fn(big[i:i + 1000]) for i in range(0, len(big), 1000)])
+        np.testing.assert_array_equal(batched, small, err_msg=method)
+
+
+def test_cartesian_set_invalidates_length_cache():
+    from dccrg_tpu.geometry import CartesianGeometry
+
+    mapping = Mapping((2, 2, 2), 1)
+    topology = GridTopology((False, False, False))
+    geom = CartesianGeometry(mapping, topology, level_0_cell_length=(1.0, 1.0, 1.0))
+    np.testing.assert_array_equal(geom.get_length(np.uint64(1)), [1.0, 1.0, 1.0])
+    geom.set((0, 0, 0), (2.0, 2.0, 2.0))
+    np.testing.assert_array_equal(geom.get_length(np.uint64(1)), [2.0, 2.0, 2.0])
