@@ -324,24 +324,29 @@ class Grid:
         cells = cells[order]
         owner = np.asarray(owner, dtype=np.int32)[order]
 
-        # per-hood neighbor lists (host)
+        # per-hood neighbor lists (host), with neighbor positions in the
+        # sorted cell array resolved once per hood (reused everywhere)
         hood_lists = {
             hid: build_neighbor_lists(self.mapping, self.topology, cells, offs)
             for hid, offs in self.neighborhoods.items()
+        }
+        hood_gidx = {
+            hid: (np.searchsorted(cells, hl.of_neighbor),
+                  np.searchsorted(cells, hl.to_neighbor))
+            for hid, hl in hood_lists.items()
         }
 
         # remote-dependency classification against the union of hoods
         # (the reference tracks boundary cells per neighborhood;
         # rows are ordered by the default hood's classification)
         nl = hood_lists[DEFAULT_NEIGHBORHOOD_ID]
+        nbr_idx, to_nbr_idx = hood_gidx[DEFAULT_NEIGHBORHOOD_ID]
         src_owner = owner[nl.of_source]
-        nbr_idx = np.searchsorted(cells, nl.of_neighbor)
         nbr_owner = owner[nbr_idx]
         remote_edge = src_owner != nbr_owner
         # outer: local cell with a remote neighbor in of- or to-lists
         outer_flag = np.zeros(len(cells), dtype=bool)
         np.add.at(outer_flag, nl.of_source[remote_edge], True)
-        to_nbr_idx = np.searchsorted(cells, nl.to_neighbor)
         remote_to = owner[nl.to_source] != owner[to_nbr_idx]
         np.add.at(outer_flag, nl.to_source[remote_to], True)
 
@@ -356,17 +361,15 @@ class Grid:
             # cells) or must send to (covered by send lists); ghost rows
             # only store copies we receive -> remote neighbors_of plus
             # remote neighbors_to sources we *read* in to-gathers.
-            gh = set()
-            for hl in hood_lists.values():
-                s_own = owner[hl.of_source]
-                n_own = owner[np.searchsorted(cells, hl.of_neighbor)]
-                m = (s_own == d) & (n_own != d)
-                gh.update(hl.of_neighbor[m].tolist())
-                t_own = owner[hl.to_source]
-                tn_own = owner[np.searchsorted(cells, hl.to_neighbor)]
-                m2 = (t_own == d) & (tn_own != d)
-                gh.update(hl.to_neighbor[m2].tolist())
-            ghost_ids.append(np.array(sorted(gh), dtype=np.uint64))
+            gh = []
+            for hid, hl in hood_lists.items():
+                of_g, to_g = hood_gidx[hid]
+                m = (owner[hl.of_source] == d) & (owner[of_g] != d)
+                gh.append(hl.of_neighbor[m])
+                m2 = (owner[hl.to_source] == d) & (owner[to_g] != d)
+                gh.append(hl.to_neighbor[m2])
+            ghost_ids.append(np.unique(np.concatenate(gh)) if gh else
+                             np.empty(0, np.uint64))
 
         n_local = np.array([len(x) for x in local_ids], dtype=np.int64)
         n_ghost = np.array([len(x) for x in ghost_ids], dtype=np.int64)
@@ -376,11 +379,21 @@ class Grid:
 
         # row lookup per device: cell id -> row
         row_of = [dict() for _ in range(n_dev)]
+        # vectorized variant: row_by_gidx[d][global cell index] -> row
+        # on device d (or -1); used by the table builders
+        row_by_gidx = np.full((n_dev, len(cells)), -1, dtype=np.int32)
         for d in range(n_dev):
             for r, cid in enumerate(local_ids[d]):
                 row_of[d][int(cid)] = r
             for r, cid in enumerate(ghost_ids[d]):
                 row_of[d][int(cid)] = L + r
+            row_by_gidx[d, np.searchsorted(cells, local_ids[d])] = np.arange(
+                len(local_ids[d]), dtype=np.int32
+            )
+            if len(ghost_ids[d]):
+                row_by_gidx[d, np.searchsorted(cells, ghost_ids[d])] = L + np.arange(
+                    len(ghost_ids[d]), dtype=np.int32
+                )
 
         plan = _Plan(
             cells=cells,
@@ -396,7 +409,9 @@ class Grid:
 
         for hid, offs in self.neighborhoods.items():
             plan.hoods[hid] = self._build_hood_plan(
-                plan, hood_lists[hid], offs, n_inner_arr if hid == DEFAULT_NEIGHBORHOOD_ID else None
+                plan, hood_lists[hid], offs,
+                n_inner_arr if hid == DEFAULT_NEIGHBORHOOD_ID else None,
+                hood_gidx[hid], row_by_gidx,
             )
         plan.epoch = getattr(self, "plan", None).epoch + 1 if getattr(self, "plan", None) else 0
         self.plan = plan
@@ -416,58 +431,64 @@ class Grid:
             _verify.verify_remote_neighbor_info(self)
             _verify.pin_requests_succeeded(self)
 
-    def _build_hood_plan(self, plan: _Plan, nl, offsets, n_inner_arr):
+    def _build_hood_plan(self, plan: _Plan, nl, offsets, n_inner_arr, gidx,
+                         row_by_gidx):
         n_dev, L, R = plan.n_dev, plan.L, plan.R
         cells, owner = plan.cells, plan.owner
 
-        # --- stencil gather tables (neighbors_of) ---
-        # group of-entries by device of the source cell
-        src_owner = owner[nl.of_source]
-        nbr_idx = np.searchsorted(cells, nl.of_neighbor)
+        def build_table(src_gidx, nbr_gidx, offs_arr):
+            """Pad ragged per-cell entries into [n_dev, L, S] tables —
+            fully vectorized (the entry stream is already ordered by
+            source cell, so a stable sort by (device, source row) keeps
+            each cell's neighborhood-item order)."""
+            entry_dev = owner[src_gidx].astype(np.int64)
+            src_rows = row_by_gidx[entry_dev, src_gidx].astype(np.int64)
+            nrows = row_by_gidx[entry_dev, nbr_gidx]
+            # every neighbor must have a row (local or ghost) on the
+            # source's device — -1 would silently alias the pad row
+            if len(nrows) and int(nrows.min()) < 0:
+                raise AssertionError(
+                    "ghost coverage bug: neighbor without a row on its "
+                    "reader's device"
+                )
+            key = entry_dev * L + src_rows
+            order = np.argsort(key, kind="stable")
+            ksort = key[order]
+            n = len(ksort)
+            if n == 0:
+                S = 1
+                return (
+                    np.full((n_dev, L, S), R - 1, dtype=np.int32),
+                    np.zeros((n_dev, L, S, 3), dtype=np.int32),
+                    np.zeros((n_dev, L, S), dtype=bool),
+                )
+            # slot = rank of the entry within its (device, row) group
+            change = np.empty(n, dtype=bool)
+            change[0] = True
+            change[1:] = ksort[1:] != ksort[:-1]
+            group_start = np.maximum.accumulate(
+                np.where(change, np.arange(n), 0)
+            )
+            slot = np.arange(n) - group_start
+            S = max(1, int(slot.max()) + 1)
+            rows = np.full((n_dev * L * S,), R - 1, dtype=np.int32)
+            offs = np.zeros((n_dev * L * S, 3), dtype=np.int32)
+            mask = np.zeros((n_dev * L * S,), dtype=bool)
+            flat = ksort * S + slot
+            rows[flat] = nrows[order]
+            offs[flat] = offs_arr[order]
+            mask[flat] = True
+            return (
+                rows.reshape(n_dev, L, S),
+                offs.reshape(n_dev, L, S, 3),
+                mask.reshape(n_dev, L, S),
+            )
 
-        def build_table(src_rows_all, entry_dev, nbr_ids, offs_arr):
-            """Pad ragged per-cell entries into [n_dev, L, S] tables."""
-            counts = np.zeros((n_dev, L), dtype=np.int64)
-            for d in range(n_dev):
-                m = entry_dev == d
-                if np.any(m):
-                    np.add.at(counts[d], src_rows_all[m], 1)
-            S = max(1, int(counts.max()))
-            rows = np.full((n_dev, L, S), R - 1, dtype=np.int32)
-            offs = np.zeros((n_dev, L, S, 3), dtype=np.int32)
-            mask = np.zeros((n_dev, L, S), dtype=bool)
-            slot = np.zeros((n_dev, L), dtype=np.int64)
-            for d in range(n_dev):
-                m = entry_dev == d
-                if not np.any(m):
-                    continue
-                srows = src_rows_all[m]
-                nids = nbr_ids[m]
-                offl = offs_arr[m]
-                rowmap = plan.local_row_of[d]
-                for i in range(len(srows)):
-                    r = srows[i]
-                    s = slot[d, r]
-                    rows[d, r, s] = rowmap[int(nids[i])]
-                    offs[d, r, s] = offl[i]
-                    mask[d, r, s] = True
-                    slot[d, r] = s + 1
-            return rows, offs, mask
-
-        # map of-source cell (global index) -> its local row on its device
-        src_rows = np.empty(len(nl.of_source), dtype=np.int64)
-        for i, (gidx, d) in enumerate(zip(nl.of_source, src_owner)):
-            src_rows[i] = plan.local_row_of[d][int(cells[gidx])]
         nbr_rows, nbr_offs, nbr_mask = build_table(
-            src_rows, src_owner, nl.of_neighbor, nl.of_offset
+            nl.of_source, gidx[0], nl.of_offset
         )
-
-        to_owner = owner[nl.to_source]
-        to_rows_src = np.empty(len(nl.to_source), dtype=np.int64)
-        for i, (gidx, d) in enumerate(zip(nl.to_source, to_owner)):
-            to_rows_src[i] = plan.local_row_of[d][int(cells[gidx])]
         to_rows, to_offs, to_mask = build_table(
-            to_rows_src, to_owner, nl.to_neighbor, nl.to_offset
+            nl.to_source, gidx[1], nl.to_offset
         )
 
         # --- halo send/receive lists (dccrg.hpp:8729-8891) ---
@@ -488,9 +509,11 @@ class Grid:
         for p in range(n_dev):
             for q in range(n_dev):
                 ids = pair_ids[p][q]
-                for j, cid in enumerate(ids):
-                    send_rows[p, q, j] = plan.local_row_of[p][int(cid)]
-                    recv_rows[q, p, j] = plan.local_row_of[q][int(cid)]
+                if len(ids) == 0:
+                    continue
+                pair_gidx = np.searchsorted(cells, ids)
+                send_rows[p, q, : len(ids)] = row_by_gidx[p, pair_gidx]
+                recv_rows[q, p, : len(ids)] = row_by_gidx[q, pair_gidx]
 
         return _HoodPlan(
             offsets=offsets,

@@ -160,13 +160,13 @@ def find_neighbors_of(
             out = native.find_neighbors_of(
                 mapping, topology, all_cells_sorted, query_cells, neighborhood
             )
-            return _dedup_entries(*out)
-    return _dedup_entries(*_find_neighbors_of_numpy(
+            return _dedup_entries(mapping, query_cells, *out)
+    return _dedup_entries(mapping, query_cells, *_find_neighbors_of_numpy(
         mapping, topology, all_cells_sorted, query_cells, neighborhood
     ))
 
 
-def _dedup_entries(src, nbr, off, item):
+def _dedup_entries(mapping, query_cells, src, nbr, off, item):
     """Collapse exact-duplicate (source, neighbor, offset) entries.
 
     A neighbor one level coarser than the queried cell covers up to 4
@@ -177,16 +177,31 @@ def _dedup_entries(src, nbr, off, item):
     get_face_neighbors_of set, tests/advection/solve.hpp:236-266), so
     the first entry — lowest item index — is kept. A neighbor CAN
     legitimately recur with different offsets (periodic wrap-around
-    self-neighbors), which is preserved."""
+    self-neighbors), which is preserved.
+
+    Only entries whose neighbor is COARSER than the source can be
+    exact duplicates (same-level and finer neighbors are unique per
+    window, and wrap-around recurrences differ in offset), so the
+    uniqueness pass runs on that usually-tiny subset."""
     if len(src) == 0:
         return src, nbr, off, item
+    query_cells = np.atleast_1d(np.asarray(query_cells, dtype=np.uint64))
+    src_lvl = mapping.get_refinement_level(query_cells)
+    nbr_lvl = mapping.get_refinement_level(nbr)
+    cand = nbr_lvl < src_lvl[src]
+    if not cand.any():
+        return src, nbr, off, item
+    ci = np.nonzero(cand)[0]
     key = np.stack(
-        [src.astype(np.int64), nbr.astype(np.int64),
-         off[:, 0], off[:, 1], off[:, 2]], axis=1,
+        [src[ci].astype(np.int64), nbr[ci].astype(np.int64),
+         off[ci, 0], off[ci, 1], off[ci, 2]], axis=1,
     )
     _, first = np.unique(key, axis=0, return_index=True)
-    keep = np.sort(first)
-    return src[keep], nbr[keep], off[keep], item[keep]
+    keep = np.ones(len(src), dtype=bool)
+    keep[ci] = False
+    keep[ci[first]] = True
+    idx = np.nonzero(keep)[0]
+    return src[idx], nbr[idx], off[idx], item[idx]
 
 
 def _find_neighbors_of_numpy(

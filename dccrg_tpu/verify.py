@@ -90,7 +90,7 @@ def verify_neighbors(grid) -> None:
     cells = plan.cells
     for hid, offsets in grid.neighborhoods.items():
         nl = plan.hoods[hid].lists
-        src, nbr, off, item = _dedup_entries(*_find_neighbors_of_numpy(
+        src, nbr, off, item = _dedup_entries(grid.mapping, cells, *_find_neighbors_of_numpy(
             grid.mapping, grid.topology, cells, cells, offsets
         ))
         if not (
