@@ -112,6 +112,13 @@ def _load():
     dll.dn_geometry_centers.argtypes = [u64p, ctypes.c_int32,
                                         f64p, f64p, f64p,
                                         u64p, ctypes.c_int64, f64p]
+    dll.dn_table_counts.restype = ctypes.c_int64
+    dll.dn_table_counts.argtypes = [i32p, i32p, ctypes.c_int64,
+                                    ctypes.c_int64, ctypes.c_int64, i64p]
+    dll.dn_table_fill.restype = None
+    dll.dn_table_fill.argtypes = [i32p, i32p, i32p, i64p, ctypes.c_int64,
+                                  ctypes.c_int64, ctypes.c_int64,
+                                  ctypes.c_int64, i64p, i32p, i32p, u8p]
     return dll
 
 
@@ -196,6 +203,39 @@ def cell_indices(mapping, cells) -> np.ndarray:
         _ptr(cells, ctypes.c_uint64), len(cells), _ptr(out, ctypes.c_uint64),
     )
     return out
+
+
+def build_stencil_table(entry_dev, src_rows, nbr_rows, offs, n_dev, L, pad_row):
+    """Pad the ragged per-cell neighbor entry stream into
+    ([n_dev, L, S] rows, [n_dev, L, S, 3] offsets, [n_dev, L, S] mask)
+    preserving per-cell entry order."""
+    entry_dev = np.ascontiguousarray(entry_dev, dtype=np.int32)
+    src_rows = np.ascontiguousarray(src_rows, dtype=np.int32)
+    nbr_rows = np.ascontiguousarray(nbr_rows, dtype=np.int32)
+    offs = np.ascontiguousarray(offs, dtype=np.int64).reshape(-1, 3)
+    n = len(entry_dev)
+    counts = np.zeros(n_dev * L, dtype=np.int64)
+    S = int(lib.dn_table_counts(
+        _ptr(entry_dev, ctypes.c_int32), _ptr(src_rows, ctypes.c_int32),
+        n, n_dev, L, _ptr(counts, ctypes.c_int64),
+    ))
+    S = max(1, S)
+    rows = np.full(n_dev * L * S, pad_row, dtype=np.int32)
+    out_offs = np.zeros(n_dev * L * S * 3, dtype=np.int32)
+    mask = np.zeros(n_dev * L * S, dtype=np.uint8)
+    slots = np.zeros(n_dev * L, dtype=np.int64)
+    lib.dn_table_fill(
+        _ptr(entry_dev, ctypes.c_int32), _ptr(src_rows, ctypes.c_int32),
+        _ptr(nbr_rows, ctypes.c_int32), _ptr(offs, ctypes.c_int64),
+        n, n_dev, L, S,
+        _ptr(slots, ctypes.c_int64), _ptr(rows, ctypes.c_int32),
+        _ptr(out_offs, ctypes.c_int32), _ptr(mask, ctypes.c_uint8),
+    )
+    return (
+        rows.reshape(n_dev, L, S),
+        out_offs.reshape(n_dev, L, S, 3),
+        mask.reshape(n_dev, L, S).astype(bool),
+    )
 
 
 def geometry_min_len(mapping, boundaries, cells):

@@ -495,6 +495,41 @@ void dn_cell_lengths(const uint64_t grid_length[3], int32_t max_lvl,
   }
 }
 
+// Stencil gather-table builder (the runtime's plan construction —
+// reference update_cell_pointers, dccrg.hpp:11453-11767): pad the
+// ragged per-cell neighbor entry stream into [n_dev, L, S] tables.
+// Entries arrive ordered per cell; a sequential fill with per-(dev,
+// row) slot counters preserves that order with no sort at all.
+int64_t dn_table_counts(const int32_t *entry_dev, const int32_t *src_rows,
+                        int64_t n, int64_t n_dev, int64_t L,
+                        int64_t *counts /* [n_dev*L], zeroed */) {
+  int64_t s_max = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t c = ++counts[(int64_t)entry_dev[i] * L + src_rows[i]];
+    if (c > s_max)
+      s_max = c;
+  }
+  return s_max;
+}
+
+void dn_table_fill(const int32_t *entry_dev, const int32_t *src_rows,
+                   const int32_t *nbr_rows, const int64_t *offs, int64_t n,
+                   int64_t n_dev, int64_t L, int64_t S, int64_t *slots
+                   /* [n_dev*L], zeroed */, int32_t *rows_out
+                   /* [n_dev*L*S], pre-filled with the pad row */,
+                   int32_t *offs_out /* [n_dev*L*S*3], zeroed */,
+                   uint8_t *mask_out /* [n_dev*L*S], zeroed */) {
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t cell = (int64_t)entry_dev[i] * L + src_rows[i];
+    const int64_t at = cell * S + slots[cell]++;
+    rows_out[at] = nbr_rows[i];
+    offs_out[3 * at] = (int32_t)offs[3 * i];
+    offs_out[3 * at + 1] = (int32_t)offs[3 * i + 1];
+    offs_out[3 * at + 2] = (int32_t)offs[3 * i + 2];
+    mask_out[at] = 1;
+  }
+}
+
 int32_t dn_abi_version(void) { return 1; }
 
 } // extern "C"
