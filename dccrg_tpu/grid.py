@@ -164,6 +164,7 @@ class Grid:
         self._partitioning_levels = []  # hierarchical partitioning
         # jitted function caches
         self._exchange_cache = {}
+        self._pending = {}
         self._stencil_cache = {}
         import os
 
@@ -921,10 +922,34 @@ class Grid:
     # -- halo exchange (dccrg.hpp:978-1014, 5046-5413) -----------------
 
     def _exchange_fn(self, neighborhood_id, field_names):
+        """Fused halo exchange: the split-phase start/finish programs
+        composed under one jit (XLA fuses them into one program)."""
         key = (self.plan.epoch, neighborhood_id, field_names)
         fn = self._exchange_cache.get(key)
         if fn is not None:
             return fn
+        start, finish = self._exchange_split_fns(neighborhood_id, field_names)
+
+        @jax.jit
+        def exchange(*fields):
+            return finish(*start(*fields), *fields)
+
+        self._exchange_cache[key] = exchange
+        return exchange
+
+    def _exchange_split_fns(self, neighborhood_id, field_names):
+        """Split-phase halo exchange as two jitted programs.
+
+        ``start`` runs the all_to_all and returns only the received
+        ghost payload; ``finish`` scatters that payload into the
+        *current* field arrays, touching ghost rows only — the
+        reference's receives write ``remote_neighbors`` exclusively
+        (dccrg.hpp:10726-10935), so user writes to local rows between
+        start and wait must survive."""
+        key = (self.plan.epoch, neighborhood_id, field_names, "split")
+        fns = self._exchange_cache.get(key)
+        if fns is not None:
+            return fns
         hood = self.plan.hoods[neighborhood_id]
         R = self.plan.R
         sh = self._sharding()
@@ -934,32 +959,52 @@ class Grid:
         mesh = self.mesh
         n_f = len(field_names)
 
-        def body(send_r, recv_r, *fields):
-            send_r, recv_r = send_r[0], recv_r[0]  # [n_dev, M]
-            rr = jnp.where(recv_r >= 0, recv_r, R - 1).reshape(-1)
+        def start_body(send_r, *fields):
+            send_r = send_r[0]  # [n_dev, M]
             outs = []
             for f in fields:
                 fl = f[0]  # [R, ...]
                 buf = fl[jnp.clip(send_r, 0)]  # [n_dev, M, ...]
                 rbuf = jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=True)
-                fl = fl.at[rr].set(rbuf.reshape((-1,) + fl.shape[1:]), mode="drop")
+                outs.append(rbuf[None])  # per-device [1, n_dev, M, ...]
+            return tuple(outs)
+
+        def finish_body(recv_r, *bufs_and_fields):
+            recv_r = recv_r[0]  # [n_dev, M]
+            rr = jnp.where(recv_r >= 0, recv_r, R - 1).reshape(-1)
+            bufs, fields = bufs_and_fields[:n_f], bufs_and_fields[n_f:]
+            outs = []
+            for rbuf, f in zip(bufs, fields):
+                fl = f[0]
+                fl = fl.at[rr].set(rbuf[0].reshape((-1,) + fl.shape[1:]), mode="drop")
                 fl = fl.at[R - 1].set(0)  # keep the zero pad row zero
                 outs.append(fl[None])
             return tuple(outs)
 
-        mapped = _shard_map(
-            body,
+        start_mapped = _shard_map(
+            start_body,
             mesh=mesh,
-            in_specs=(P(axis), P(axis)) + (P(axis),) * n_f,
+            in_specs=(P(axis),) + (P(axis),) * n_f,
+            out_specs=(P(axis),) * n_f,
+        )
+        finish_mapped = _shard_map(
+            finish_body,
+            mesh=mesh,
+            in_specs=(P(axis),) + (P(axis),) * (2 * n_f),
             out_specs=(P(axis),) * n_f,
         )
 
         @jax.jit
-        def exchange(*fields):
-            return mapped(send, recv, *fields)
+        def start(*fields):
+            return start_mapped(send, *fields)
 
-        self._exchange_cache[key] = exchange
-        return exchange
+        @jax.jit
+        def finish(*bufs_and_fields):
+            return finish_mapped(recv, *bufs_and_fields)
+
+        fns = (start, finish)
+        self._exchange_cache[key] = fns
+        return fns
 
     def update_copies_of_remote_neighbors(
         self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID, fields=None
@@ -968,6 +1013,7 @@ class Grid:
         update_copies_of_remote_neighbors() (dccrg.hpp:978), one fused
         all_to_all. ``fields`` selects which per-cell fields move (the
         get_mpi_datatype() / transfer_switch boundary)."""
+        self._check_not_in_flight(neighborhood_id)
         if self.n_dev == 1:
             return
         names = tuple(sorted(fields)) if fields is not None else tuple(sorted(self.fields))
@@ -976,28 +1022,54 @@ class Grid:
         for n, arr in zip(names, out):
             self.data[n] = arr
 
-    # split-phase parity API (dccrg.hpp:5046-5413). Dispatch is async
-    # in JAX, so start returns immediately; wait installs the results.
-    def start_remote_neighbor_copy_updates(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID, fields=None):
-        if self.n_dev == 1:
-            self._pending = None
-            return
-        names = tuple(sorted(fields)) if fields is not None else tuple(sorted(self.fields))
-        fn = self._exchange_fn(neighborhood_id, names)
-        self._pending = (names, fn(*(self.data[n] for n in names)))
+    def _check_not_in_flight(self, neighborhood_id):
+        entry = self._pending.get(neighborhood_id)
+        if entry is not None and entry[0] == self.plan.epoch:
+            raise RuntimeError(
+                f"neighborhood {neighborhood_id} already has an in-flight halo "
+                "update; call wait_remote_neighbor_copy_updates first"
+            )
+        if entry is not None:
+            # orphaned by a structure rebuild: its wait would raise
+            # anyway, and this fresh update supersedes it
+            del self._pending[neighborhood_id]
 
-    def wait_remote_neighbor_copy_updates(self) -> None:
-        if getattr(self, "_pending", None) is None:
+    # split-phase parity API (dccrg.hpp:5046-5413). Dispatch is async
+    # in JAX, so start returns immediately; wait scatters ONLY the
+    # received ghost rows into the then-current arrays — local-row
+    # writes made between start and wait survive, matching the
+    # reference's receives-touch-remote_neighbors-only semantics
+    # (dccrg.hpp:10726-10935).
+    def start_remote_neighbor_copy_updates(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID, fields=None):
+        self._check_not_in_flight(neighborhood_id)
+        names = tuple(sorted(fields)) if fields is not None else tuple(sorted(self.fields))
+        if self.n_dev == 1:
+            self._pending[neighborhood_id] = (self.plan.epoch, names, None, None)
             return
-        names, out = self._pending
+        start, finish = self._exchange_split_fns(neighborhood_id, names)
+        bufs = start(*(self.data[n] for n in names))
+        self._pending[neighborhood_id] = (self.plan.epoch, names, finish, bufs)
+
+    def wait_remote_neighbor_copy_updates(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID) -> None:
+        if neighborhood_id not in self._pending:
+            return
+        epoch, names, finish, bufs = self._pending.pop(neighborhood_id)
+        if epoch != self.plan.epoch:
+            raise RuntimeError(
+                "grid structure changed between start_remote_neighbor_copy_updates "
+                "and wait_remote_neighbor_copy_updates; the in-flight halo payload "
+                "is stale"
+            )
+        if finish is None:  # single-device: nothing was exchanged
+            return
+        out = finish(*bufs, *(self.data[n] for n in names))
         for n, arr in zip(names, out):
             self.data[n] = arr
-        self._pending = None
 
-    def wait_remote_neighbor_copy_update_receives(self) -> None:
-        self.wait_remote_neighbor_copy_updates()
+    def wait_remote_neighbor_copy_update_receives(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID) -> None:
+        self.wait_remote_neighbor_copy_updates(neighborhood_id)
 
-    def wait_remote_neighbor_copy_update_sends(self) -> None:
+    def wait_remote_neighbor_copy_update_sends(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID) -> None:
         pass
 
     def get_number_of_update_send_cells(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID) -> int:

@@ -201,6 +201,56 @@ def test_split_phase_exchange():
             assert host[d, g.plan.L + r] == 10.0 * float(cid)
 
 
+def test_split_phase_interleaved_writes_survive():
+    """Writes to an exchanged field between start and wait must not be
+    reverted by wait: the reference's receives only ever write ghost
+    (remote_neighbors) copies (dccrg.hpp:10726-10935)."""
+    g = Grid(cell_data={"v": jnp.float32}).set_initial_length((8, 1, 1)).initialize(
+        mesh_of(4), partition="block"
+    )
+    ids = np.arange(1, 9, dtype=np.uint64)
+    g.set("v", ids, (10 * ids).astype(np.float32))
+    g.start_remote_neighbor_copy_updates()
+    # interleaved compute: overwrite every local cell's value
+    g.set("v", ids, (100 * ids).astype(np.float32))
+    g.wait_remote_neighbor_copy_updates()
+    host = np.asarray(g.data["v"])
+    # local rows keep the interleaved write...
+    for cid in ids:
+        assert float(g.get("v", cid)) == 100.0 * float(cid)
+    # ...while ghost rows hold the values captured at start time
+    for d in range(4):
+        for r, cid in enumerate(g.plan.ghost_ids[d]):
+            assert host[d, g.plan.L + r] == 10.0 * float(cid)
+
+
+def test_split_phase_double_start_raises():
+    g = Grid(cell_data={"v": jnp.float32}).set_initial_length((8, 1, 1)).initialize(
+        mesh_of(4), partition="block"
+    )
+    g.start_remote_neighbor_copy_updates()
+    with pytest.raises(RuntimeError):
+        g.start_remote_neighbor_copy_updates()
+    g.wait_remote_neighbor_copy_updates()
+    # distinct neighborhoods may be in flight concurrently
+    g.add_neighborhood(9, [[1, 0, 0]])
+    g.start_remote_neighbor_copy_updates()
+    g.start_remote_neighbor_copy_updates(neighborhood_id=9)
+    g.wait_remote_neighbor_copy_updates(neighborhood_id=9)
+    g.wait_remote_neighbor_copy_updates()
+
+
+def test_split_phase_stale_after_structure_change():
+    g = Grid(cell_data={"v": jnp.float32}).set_initial_length((8, 1, 1)).initialize(
+        mesh_of(4), partition="block"
+    )
+    g.start_remote_neighbor_copy_updates()
+    g.refine_completely(1)
+    g.stop_refining()
+    with pytest.raises(RuntimeError):
+        g.wait_remote_neighbor_copy_updates()
+
+
 def test_transfer_accounting():
     g = Grid(cell_data={"v": jnp.float32}).set_initial_length((8, 1, 1)).initialize(
         mesh_of(4), partition="block"
