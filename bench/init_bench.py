@@ -1,0 +1,63 @@
+"""Grid construction speed (the reference's tests/init suite).
+
+Times Grid.initialize at growing sizes on the host (structure building
+is host work in this design; the reference's equivalent is
+create_level_0_cells + initialize_neighbors, dccrg.hpp:8089-8420).
+
+Run: python bench/init_bench.py [--max 256]
+"""
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+import dccrg_tpu as dt  # noqa: E402
+
+
+def time_init(n, partition):
+    t0 = time.time()
+    g = (
+        dt.Grid(cell_data={"density": jnp.float32})
+        .set_initial_length((n, n, n))
+        .set_maximum_refinement_level(0)
+        .set_neighborhood_length(1)
+        .initialize(partition=partition)
+    )
+    dt_s = time.time() - t0
+    n_cells = len(g.plan.cells)
+    del g
+    return dt_s, n_cells
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max", type=int, default=256)
+    args = ap.parse_args()
+    sizes = [s for s in (64, 128, 256, 512) if s <= args.max]
+    results = []
+    for n in sizes:
+        for part in ("block", "morton"):
+            # best of 2: the first touch of a fresh heap region pays
+            # page faults that later builds (and long-running apps)
+            # amortize away
+            secs, n_cells = min(time_init(n, part) for _ in range(2))
+            results.append({
+                "size": f"{n}^3", "partition": part, "seconds": round(secs, 2),
+                "cells_per_s": round(n_cells / secs),
+            })
+            print(json.dumps(results[-1]))
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -55,9 +55,37 @@ from .partition import (
 )
 from .topology import GridTopology
 from .types import ERROR_CELL
+from . import uniform as uniform_mod
 
 # Parity with the reference's default neighborhood id (dccrg.hpp:99).
 DEFAULT_NEIGHBORHOOD_ID = -0xDCC
+
+_allocator_tuned = False
+
+
+def _tune_allocator():
+    """Raise glibc's mmap/trim thresholds before the first large plan
+    build: big numpy temporaries otherwise go through mmap and pay a
+    page fault per 4K page on every rebuild (~2x on 128^3 structure
+    builds on a quiet host). Applied lazily so merely importing the
+    package leaves process-global malloc behavior untouched; opt out
+    entirely with DCCRG_NO_MALLOPT=1."""
+    global _allocator_tuned
+    if _allocator_tuned:
+        return
+    _allocator_tuned = True
+    import os
+
+    if os.environ.get("DCCRG_NO_MALLOPT") == "1":
+        return
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL("libc.so.6")
+        libc.mallopt(-3, 1 << 30)  # M_MMAP_THRESHOLD
+        libc.mallopt(-1, 1 << 30)  # M_TRIM_THRESHOLD
+    except Exception:
+        pass
 
 
 def default_mesh(devices=None) -> Mesh:
@@ -82,24 +110,65 @@ class CellView:
         return iter(self.ids)
 
 
-@dataclass
 class _HoodPlan:
-    """Per-neighborhood static tables (one structure epoch)."""
+    """Per-neighborhood static tables (one structure epoch).
 
-    offsets: np.ndarray  # [K, 3] neighborhood items
-    # stencil gather tables, per device, padded:
-    nbr_rows: np.ndarray  # [n_dev, L, S] int32 row into device rows (pad: zero row)
-    nbr_offs: np.ndarray  # [n_dev, L, S, 3] int32 logical offsets (smallest-cell units)
-    nbr_mask: np.ndarray  # [n_dev, L, S] bool
-    to_rows: np.ndarray  # [n_dev, L, T] int32 neighbors_to gather table
-    to_offs: np.ndarray  # [n_dev, L, T, 3] int32
-    to_mask: np.ndarray  # [n_dev, L, T] bool
-    # halo exchange tables:
-    send_rows: np.ndarray  # [n_dev(src), n_dev(dst), M] int32 local row or -1
-    recv_rows: np.ndarray  # [n_dev(dst), n_dev(src), M] int32 ghost row or -1
-    n_inner: np.ndarray  # [n_dev] rows [0, n_inner) have no remote deps
-    # host-side lists for queries
-    lists: object = None  # NeighborLists
+    ``lists`` (the flat host-side neighbor-entry stream for queries)
+    and the neighbors_to gather tables may be passed as zero-arg
+    callables: they are built on first access. The uniform fast path
+    (uniform.py) uses this so a 256^3 init never materializes the
+    ~0.5G-entry stream unless a query API actually needs it.
+    """
+
+    def __init__(self, offsets, nbr_rows, nbr_offs, nbr_mask,
+                 send_rows, recv_rows, n_inner, lists=None, to_tables=None,
+                 to_rows=None, to_offs=None, to_mask=None, offs_const=None):
+        self.offsets = offsets  # [K, 3] neighborhood items
+        # stencil gather tables, per device, padded:
+        self.nbr_rows = nbr_rows  # [n_dev, L, S] int32 row (pad: zero row)
+        self._nbr_offs = nbr_offs  # [n_dev, L, S, 3] int32 offsets, or thunk
+        self.nbr_mask = nbr_mask  # [n_dev, L, S] bool
+        # when slot offsets are per-slot constants (uniform grids),
+        # stencils synthesize noffs = mask * offs_const on device and
+        # the full nbr_offs array is only built if a host query asks
+        self.offs_const = offs_const  # [S, 3] int32 or None
+        # halo exchange tables:
+        self.send_rows = send_rows  # [n_dev(src), n_dev(dst), M] int32 or -1
+        self.recv_rows = recv_rows  # [n_dev(dst), n_dev(src), M] int32 or -1
+        self.n_inner = n_inner  # [n_dev] rows [0, n_inner) have no remote deps
+        self._lists = lists  # NeighborLists or thunk
+        if to_tables is None and to_rows is not None:
+            to_tables = (to_rows, to_offs, to_mask)
+        self._to = to_tables  # (rows, offs, mask) or thunk
+
+    @property
+    def lists(self):
+        if callable(self._lists):
+            self._lists = self._lists()
+        return self._lists
+
+    @property
+    def nbr_offs(self):
+        if callable(self._nbr_offs):
+            self._nbr_offs = self._nbr_offs()
+        return self._nbr_offs
+
+    def _to_tables(self):
+        if callable(self._to):
+            self._to = self._to()
+        return self._to
+
+    @property
+    def to_rows(self):  # [n_dev, L, T] int32 neighbors_to gather table
+        return self._to_tables()[0]
+
+    @property
+    def to_offs(self):  # [n_dev, L, T, 3] int32
+        return self._to_tables()[1]
+
+    @property
+    def to_mask(self):  # [n_dev, L, T] bool
+        return self._to_tables()[2]
 
 
 @dataclass
@@ -113,7 +182,7 @@ class _Plan:
     R: int  # total rows per device (L + ghost cap + 1 zero row)
     n_local: np.ndarray  # [n_dev]
     local_ids: list  # per device: uint64 ids in row order [inner|outer]
-    local_row_of: dict  # (not used in hot paths) cell id -> (dev, row)
+    row_of_pos: np.ndarray  # int32 [n_cells]: row on the OWNER device
     ghost_ids: list  # per device: uint64 ids in ghost-row order
     hoods: dict = dataclass_field(default_factory=dict)  # hood id -> _HoodPlan
     epoch: int = 0
@@ -320,10 +389,17 @@ class Grid:
         reference's initialize_neighbors + update_remote_neighbor_info +
         recalculate_neighbor_update_send_receive_lists +
         update_cell_pointers pipeline (dccrg.hpp:8371-8420)."""
+        _tune_allocator()
         n_dev = self.n_dev
         order = np.argsort(cells, kind="stable")
         cells = cells[order]
         owner = np.asarray(owner, dtype=np.int32)[order]
+
+        # all-level-0 grids take the closed-form fast path (uniform.py):
+        # identical tables, no entry stream, bounded temporaries
+        if uniform_mod.is_uniform(cells, self.mapping.length.total_level0_cells):
+            self._build_plan_uniform(cells, owner)
+            return
 
         # per-hood neighbor lists (host), with neighbor positions in the
         # sorted cell array resolved once per hood (reused everywhere)
@@ -378,19 +454,16 @@ class Grid:
         G = int(n_ghost.max()) if n_dev > 1 else 0
         R = L + G + 1  # final row = permanent zero pad
 
-        # row lookup per device: cell id -> row
-        row_of = [dict() for _ in range(n_dev)]
-        # vectorized variant: row_by_gidx[d][global cell index] -> row
-        # on device d (or -1); used by the table builders
+        # row lookups: row_by_gidx[d][global cell index] -> row on
+        # device d (or -1), used by the table builders; row_of_pos is
+        # the owner-device row per cell (host get/set lookups).
         row_by_gidx = np.full((n_dev, len(cells)), -1, dtype=np.int32)
+        row_of_pos = np.full(len(cells), -1, dtype=np.int32)
         for d in range(n_dev):
-            for r, cid in enumerate(local_ids[d]):
-                row_of[d][int(cid)] = r
-            for r, cid in enumerate(ghost_ids[d]):
-                row_of[d][int(cid)] = L + r
-            row_by_gidx[d, np.searchsorted(cells, local_ids[d])] = np.arange(
-                len(local_ids[d]), dtype=np.int32
-            )
+            lpos = np.searchsorted(cells, local_ids[d])
+            lrows = np.arange(len(local_ids[d]), dtype=np.int32)
+            row_by_gidx[d, lpos] = lrows
+            row_of_pos[lpos] = lrows
             if len(ghost_ids[d]):
                 row_by_gidx[d, np.searchsorted(cells, ghost_ids[d])] = L + np.arange(
                     len(ghost_ids[d]), dtype=np.int32
@@ -404,7 +477,7 @@ class Grid:
             R=R,
             n_local=n_local,
             local_ids=local_ids,
-            local_row_of=row_of,
+            row_of_pos=row_of_pos,
             ghost_ids=ghost_ids,
         )
 
@@ -414,6 +487,50 @@ class Grid:
                 n_inner_arr if hid == DEFAULT_NEIGHBORHOOD_ID else None,
                 hood_gidx[hid], row_by_gidx,
             )
+        self._finish_plan(plan)
+
+    def _build_plan_uniform(self, cells: np.ndarray, owner: np.ndarray):
+        """Closed-form plan construction for all-level-0 grids
+        (uniform.py): same layout and tables as the generic path, no
+        neighbor-entry stream, bounded temporaries."""
+        layout, hood_data = uniform_mod.build_uniform_plan(
+            self.mapping, self.topology, self.neighborhoods, cells, owner,
+            self.n_dev,
+        )
+        plan = _Plan(
+            cells=cells,
+            owner=owner,
+            n_dev=self.n_dev,
+            L=layout["L"],
+            R=layout["R"],
+            n_local=layout["n_local"],
+            local_ids=layout["local_ids"],
+            row_of_pos=layout["row_of_pos"],
+            ghost_ids=layout["ghost_ids"],
+        )
+        mapping, topology = self.mapping, self.topology
+        for hid, offs in self.neighborhoods.items():
+            hd = hood_data[hid]
+
+            def lists_thunk(offs=offs):
+                return build_neighbor_lists(mapping, topology, cells, offs)
+
+            plan.hoods[hid] = _HoodPlan(
+                offsets=offs,
+                nbr_rows=hd["nbr_rows"],
+                nbr_offs=hd["nbr_offs"],
+                nbr_mask=hd["nbr_mask"],
+                offs_const=hd["offs_const"],
+                to_tables=hd["to_thunk"],
+                send_rows=hd["send_rows"],
+                recv_rows=hd["recv_rows"],
+                n_inner=(layout["n_inner"]
+                         if hid == DEFAULT_NEIGHBORHOOD_ID else None),
+                lists=lists_thunk,
+            )
+        self._finish_plan(plan)
+
+    def _finish_plan(self, plan: _Plan):
         plan.epoch = getattr(self, "plan", None).epoch + 1 if getattr(self, "plan", None) else 0
         self.plan = plan
         self._exchange_cache.clear()
@@ -488,9 +605,9 @@ class Grid:
         nbr_rows, nbr_offs, nbr_mask = build_table(
             nl.of_source, gidx[0], nl.of_offset
         )
-        to_rows, to_offs, to_mask = build_table(
-            nl.to_source, gidx[1], nl.to_offset
-        )
+
+        def to_tables():
+            return build_table(nl.to_source, gidx[1], nl.to_offset)
 
         # --- halo send/receive lists (dccrg.hpp:8729-8891) ---
         # device q receives every remote neighbor it reads; sender p is
@@ -521,9 +638,7 @@ class Grid:
             nbr_rows=nbr_rows,
             nbr_offs=nbr_offs,
             nbr_mask=nbr_mask,
-            to_rows=to_rows,
-            to_offs=to_offs,
-            to_mask=to_mask,
+            to_tables=to_tables,
             send_rows=send_rows,
             recv_rows=recv_rows,
             n_inner=(n_inner_arr if n_inner_arr is not None else None),
@@ -549,9 +664,7 @@ class Grid:
         if np.any(pos >= len(self.plan.cells)) or np.any(self.plan.cells[np.minimum(pos, len(self.plan.cells)-1)] != ids):
             raise KeyError("unknown cell id(s)")
         dev = self.plan.owner[pos]
-        rows = np.array(
-            [self.plan.local_row_of[d][int(c)] for d, c in zip(dev, ids)], dtype=np.int64
-        )
+        rows = self.plan.row_of_pos[pos].astype(np.int64)
         return dev, rows
 
     def get(self, field: str, ids) -> np.ndarray:
@@ -708,7 +821,7 @@ class Grid:
         if pos is None:
             raise ValueError(f"unknown cell {cell}")
         d = int(self.plan.owner[pos])
-        row = self.plan.local_row_of[d][int(cell)]
+        row = int(self.plan.row_of_pos[pos])
         return row < self._n_inner(d)
 
     def is_outer(self, cell) -> bool:
@@ -1125,8 +1238,14 @@ class Grid:
         hood = self.plan.hoods[neighborhood_id]
         L, R = self.plan.L, self.plan.R
         sh = self._sharding()
+        uniform_offs = hood.offs_const is not None
         nbr_rows = jax.device_put(jnp.asarray(hood.nbr_rows), sh)
-        nbr_offs = jax.device_put(jnp.asarray(hood.nbr_offs), sh)
+        if uniform_offs:
+            # per-slot constant offsets: synthesized in-body from the
+            # mask instead of storing [n_dev, L, S, 3] in HBM
+            nbr_offs = jnp.asarray(hood.offs_const)  # [S, 3] replicated
+        else:
+            nbr_offs = jax.device_put(jnp.asarray(hood.nbr_offs), sh)
         nbr_mask = jax.device_put(jnp.asarray(hood.nbr_mask), sh)
         if include_to:
             to_rows = jax.device_put(jnp.asarray(hood.to_rows), sh)
@@ -1136,7 +1255,11 @@ class Grid:
         axis, mesh = self.axis, self.mesh
 
         def body(nrows, noffs, nmask, *args):
-            nrows, noffs, nmask = nrows[0], noffs[0], nmask[0]
+            nrows, nmask = nrows[0], nmask[0]
+            if uniform_offs:
+                noffs = nmask[:, :, None] * noffs[None, :, :]
+            else:
+                noffs = noffs[0]
             if include_to:
                 trows, toffs, tmask, *args = args
                 trows, toffs, tmask = trows[0], toffs[0], tmask[0]
@@ -1163,7 +1286,8 @@ class Grid:
         mapped = _shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis)) + to_specs
+            in_specs=(P(axis), P() if uniform_offs else P(axis), P(axis))
+            + to_specs
             + (P(axis),) * (n_in + n_out) + (P(),) * n_extra,
             out_specs=(P(axis),) * n_out,
             check_vma=False,
@@ -1176,6 +1300,153 @@ class Grid:
             return mapped(nbr_rows, nbr_offs, nbr_mask, *args)
 
         return run
+
+    # -- fused multi-step execution ------------------------------------
+
+    def compile_step_loop(
+        self,
+        kernel,
+        fields_in,
+        fields_out,
+        exchange_fields=None,
+        neighborhood_id=DEFAULT_NEIGHBORHOOD_ID,
+        n_extra=0,
+    ):
+        """One jitted program running ``n_steps`` time steps on device.
+
+        Each iteration refreshes ghost rows of ``exchange_fields``
+        (an all_to_all, as update_copies_of_remote_neighbors), gathers
+        neighbors and runs ``kernel`` (same signature as apply_stencil's),
+        and writes the result into ``fields_out`` — the whole time loop
+        is a single XLA program (lax.fori_loop), so exchange, stencil
+        and apply fuse with no host round-trips. This is the TPU answer
+        to the reference's start/solve-inner/wait/solve-outer overlap
+        (dccrg.hpp:5046-5413, tests/advection/2d.cpp:327-343): XLA
+        overlaps the collective with independent compute inside one
+        program instead of split-phase host calls.
+
+        ``exchange_fields`` must be a subset of ``fields_out`` (fields
+        that change per step); static fields' ghosts are assumed valid
+        for the whole epoch. Returns ``fn(n_steps, *in, *out, *extra)
+        -> out arrays`` where ``n_steps`` is dynamic (no recompile per
+        step count). Use :meth:`run_steps` for the stateful wrapper.
+        """
+        fields_in = tuple(fields_in)
+        fields_out = tuple(fields_out)
+        if exchange_fields is None:
+            exchange_fields = fields_out
+        exchange_fields = tuple(exchange_fields)
+        if not set(exchange_fields) <= set(fields_out):
+            raise ValueError(
+                "exchange_fields must be a subset of fields_out; static "
+                "fields' ghosts are refreshed once per structure epoch"
+            )
+        hood = self.plan.hoods[neighborhood_id]
+        L, R = self.plan.L, self.plan.R
+        sh = self._sharding()
+        uniform_offs = hood.offs_const is not None
+        nbr_rows = jax.device_put(jnp.asarray(hood.nbr_rows), sh)
+        if uniform_offs:
+            nbr_offs = jnp.asarray(hood.offs_const)  # [S, 3] replicated
+        else:
+            nbr_offs = jax.device_put(jnp.asarray(hood.nbr_offs), sh)
+        nbr_mask = jax.device_put(jnp.asarray(hood.nbr_mask), sh)
+        send = jax.device_put(jnp.asarray(hood.send_rows), sh)
+        recv = jax.device_put(jnp.asarray(hood.recv_rows), sh)
+        static_in = tuple(n for n in fields_in if n not in fields_out)
+        n_static, n_out = len(static_in), len(fields_out)
+        exch_idx = tuple(fields_out.index(n) for n in exchange_fields)
+        axis, mesh, n_dev = self.axis, self.mesh, self.n_dev
+
+        def body(n_steps, send_r, recv_r, nrows, noffs, nmask, *args):
+            send_r, recv_r = send_r[0], recv_r[0]
+            nrows, nmask = nrows[0], nmask[0]
+            if uniform_offs:
+                noffs = nmask[:, :, None] * noffs[None, :, :]
+            else:
+                noffs = noffs[0]
+            rr = jnp.where(recv_r >= 0, recv_r, R - 1).reshape(-1)
+            statics = {n: a[0] for n, a in zip(static_in, args[:n_static])}
+            state0 = tuple(a[0] for a in args[n_static:n_static + n_out])
+            extra = args[n_static + n_out:]
+
+            def step(_, state):
+                state = list(state)
+                if n_dev > 1:
+                    for j in exch_idx:
+                        fl = state[j]
+                        buf = fl[jnp.clip(send_r, 0)]
+                        rbuf = jax.lax.all_to_all(
+                            buf, axis, split_axis=0, concat_axis=0, tiled=True
+                        )
+                        fl = fl.at[rr].set(
+                            rbuf.reshape((-1,) + fl.shape[1:]), mode="drop"
+                        )
+                        fl = fl.at[R - 1].set(0)
+                        state[j] = fl
+                full = dict(statics)
+                full.update(zip(fields_out, state))
+                cell_fields = {n: full[n][:L] for n in fields_in}
+                nbr_fields = {n: full[n][nrows] for n in fields_in}
+                result = kernel(cell_fields, nbr_fields, noffs, nmask, *extra)
+                for j, n in enumerate(fields_out):
+                    state[j] = state[j].at[:L].set(result[n].astype(state[j].dtype))
+                return tuple(state)
+
+            out = jax.lax.fori_loop(0, n_steps, step, state0)
+            return tuple(o[None] for o in out)
+
+        mapped = _shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis), P(axis),
+                      P() if uniform_offs else P(axis), P(axis))
+            + (P(axis),) * (n_static + n_out) + (P(),) * n_extra,
+            out_specs=(P(axis),) * n_out,
+            check_vma=False,
+        )
+
+        @jax.jit
+        def run(n_steps, *args):
+            return mapped(n_steps, send, recv, nbr_rows, nbr_offs, nbr_mask, *args)
+
+        return run, static_in
+
+    def run_steps(
+        self,
+        kernel,
+        fields_in,
+        fields_out,
+        n_steps,
+        exchange_fields=None,
+        neighborhood_id=DEFAULT_NEIGHBORHOOD_ID,
+        extra_args=(),
+    ) -> None:
+        """Run ``n_steps`` fused exchange+stencil steps and install the
+        results (see compile_step_loop)."""
+        fields_in = tuple(fields_in)
+        fields_out = tuple(fields_out)
+        key = (
+            self.plan.epoch, "steploop", neighborhood_id, fields_in, fields_out,
+            tuple(exchange_fields) if exchange_fields is not None else None,
+            kernel, len(extra_args),
+        )
+        entry = self._stencil_cache.get(key)
+        if entry is None:
+            entry = self.compile_step_loop(
+                kernel, fields_in, fields_out, exchange_fields,
+                neighborhood_id, n_extra=len(extra_args),
+            )
+            self._stencil_cache[key] = entry
+        fn, static_in = entry
+        out = fn(
+            jnp.int32(n_steps),
+            *(self.data[n] for n in static_in),
+            *(self.data[n] for n in fields_out),
+            *extra_args,
+        )
+        for n, arr in zip(fields_out, out):
+            self.data[n] = arr
 
     # -- load balancing (dccrg.hpp:1046-1064, 3770-4182, 8482-8720) ----
 

@@ -84,6 +84,20 @@ def make_flux_kernel():
     return kernel
 
 
+def make_fused_step_kernel():
+    """Flux + apply in one kernel for the fused multi-step loop:
+    returns the post-step density directly (solve.hpp:272-279 folded
+    into the flux gather), so exchange+flux+apply is one XLA program
+    per step under Grid.run_steps."""
+    base = make_flux_kernel()
+
+    def kernel(cell, nbr, offs, mask, dt):
+        r = base(cell, nbr, offs, mask, dt)
+        return {"density": cell["density"] + r["flux"]}
+
+    return kernel
+
+
 def make_diff_kernel(diff_threshold: float):
     """Max relative density difference over face neighbors
     (adapter.hpp:110-131)."""
@@ -129,6 +143,7 @@ class AmrAdvection:
             .initialize(mesh, partition=partition)
         )
         self._flux_kernel = make_flux_kernel()
+        self._fused_kernel = make_fused_step_kernel()
         self._diff_kernel = make_diff_kernel(diff_threshold)
         self._refresh_static()
         cells = self.grid.get_cells()
@@ -185,6 +200,24 @@ class AmrAdvection:
         self.time += dt
         return dt
 
+    def run_fused(self, n_steps: int, dt: float | None = None) -> float:
+        """``n_steps`` advection steps as ONE jitted device program
+        (exchange + flux + apply per step inside lax.fori_loop) — the
+        hot path between structure events. dt is constant across the
+        segment: the CFL limit depends only on the static per-epoch
+        velocity/length fields (solve.hpp:289-333)."""
+        if dt is None:
+            dt = self.cfl * self.max_time_step()
+        self.grid.run_steps(
+            self._fused_kernel,
+            ["density", "vx", "vy", "vz", "lx", "ly", "lz", "ilen"],
+            ["density"],
+            n_steps,
+            extra_args=(jnp.float32(dt),),
+        )
+        self.time += n_steps * dt
+        return dt
+
     # -- adaptation (adapter.hpp:47-311) -------------------------------
 
     def adapt(self) -> tuple:
@@ -238,10 +271,26 @@ class AmrAdvection:
         vol = np.prod(g.geometry.get_length(cells), axis=1)
         return float(np.sum(rho * vol))
 
-    def run(self, steps: int, adapt_n: int = 0, balance_n: int = 0) -> None:
-        """The main loop (2d.cpp:321-442)."""
-        for i in range(1, steps + 1):
-            self.step()
+    def run(self, steps: int, adapt_n: int = 0, balance_n: int = 0,
+            fused: bool = True) -> None:
+        """The main loop (2d.cpp:321-442). With ``fused`` (default) the
+        steps between structure events run as one device program each
+        (run_fused); otherwise one dispatch pair per step."""
+        i = 0
+        while i < steps:
+            # next structure event bounds the fused segment
+            nexts = [steps - i]
+            if adapt_n:
+                nexts.append(adapt_n - i % adapt_n)
+            if balance_n:
+                nexts.append(balance_n - i % balance_n)
+            seg = min(nexts)
+            if fused:
+                self.run_fused(seg)
+                i += seg
+            else:
+                self.step()
+                i += 1
             if adapt_n and i % adapt_n == 0:
                 self.adapt()
             if balance_n and i % balance_n == 0:

@@ -119,6 +119,15 @@ def _load():
     dll.dn_table_fill.argtypes = [i32p, i32p, i32p, i64p, ctypes.c_int64,
                                   ctypes.c_int64, ctypes.c_int64,
                                   ctypes.c_int64, i64p, i32p, i32p, u8p]
+    dll.dn_uniform_tables.restype = None
+    dll.dn_uniform_tables.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,   # nx, ny, nz
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,   # periodic
+        i64p, ctypes.c_int64,                             # offs, k
+        i32p, i32p,                                       # row_of_pos, owner
+        ctypes.c_int32,                                   # pad_row
+        i32p, u8p,                                        # rows_out, mask_out
+    ]
     return dll
 
 
@@ -236,6 +245,36 @@ def build_stencil_table(entry_dev, src_rows, nbr_rows, offs, n_dev, L, pad_row):
         out_offs.reshape(n_dev, L, S, 3),
         mask.reshape(n_dev, L, S).astype(bool),
     )
+
+
+def uniform_tables(dims, periodic, offs, row_of_pos, owner, pad_row):
+    """One-pass uniform (level-0-only) gather tables: rows [n0, k] and
+    mask [n0, k] in grid-index order. Cross-device entries carry the
+    sentinel ``-2 - neighbor_gidx`` (caller fixes up ghost rows);
+    ``owner=None`` skips cross detection. Returns None when the native
+    lib is unavailable."""
+    if lib is None:
+        return None
+    nx, ny, nz = (int(v) for v in dims)
+    k = len(offs)
+    offs = np.ascontiguousarray(offs, dtype=np.int64)
+    row_of_pos = np.ascontiguousarray(row_of_pos, dtype=np.int32)
+    n0 = nx * ny * nz
+    rows = np.empty((n0, k), dtype=np.int32)
+    mask = np.empty((n0, k), dtype=bool)
+    own_arr = (np.ascontiguousarray(owner, dtype=np.int32)
+               if owner is not None else None)
+    own_ptr = (_ptr(own_arr, ctypes.c_int32) if own_arr is not None
+               else ctypes.cast(None, ctypes.POINTER(ctypes.c_int32)))
+    lib.dn_uniform_tables(
+        nx, ny, nz,
+        int(bool(periodic[0])), int(bool(periodic[1])), int(bool(periodic[2])),
+        _ptr(offs, ctypes.c_int64), k,
+        _ptr(row_of_pos, ctypes.c_int32), own_ptr,
+        np.int32(pad_row),
+        _ptr(rows, ctypes.c_int32), _ptr(mask, ctypes.c_uint8),
+    )
+    return rows, mask
 
 
 def geometry_min_len(mapping, boundaries, cells):

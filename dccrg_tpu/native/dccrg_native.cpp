@@ -530,6 +530,106 @@ void dn_table_fill(const int32_t *entry_dev, const int32_t *src_rows,
   }
 }
 
+// Uniform (all-level-0) gather tables in ONE pass (the fast path of
+// plan construction, uniform.py): for every cell and neighborhood item
+// write the neighbor's row on the reader's device into rows_out[i*k+j]
+// and its existence into mask_out. Interior cells — the overwhelming
+// majority — resolve through a precomputed flat-index delta per item;
+// only boundary cells take the wrap/validity math. Cross-device
+// neighbors are emitted as the sentinel ``-2 - neighbor_gidx`` for the
+// (small) host-side ghost-row fixup. owner == NULL means one device
+// (no cross edges possible).
+void dn_uniform_tables(int64_t nx, int64_t ny, int64_t nz, int32_t px,
+                       int32_t py, int32_t pz,
+                       const int64_t *offs /* [k, 3] cell units */, int64_t k,
+                       const int32_t *row_of_pos /* [n0] */,
+                       const int32_t *owner /* [n0] or NULL */,
+                       int32_t pad_row,
+                       int32_t *rows_out /* [n0, k] */,
+                       uint8_t *mask_out /* [n0, k] */) {
+  const int64_t nxy = nx * ny;
+  std::vector<int64_t> dflat(k), lo(3, 0), hi(3);
+  hi[0] = nx;
+  hi[1] = ny;
+  hi[2] = nz;
+  for (int64_t j = 0; j < k; ++j) {
+    dflat[j] = offs[3 * j] + offs[3 * j + 1] * nx + offs[3 * j + 2] * nxy;
+    // interior box: cells whose every neighbor is in-bounds unwrapped
+    lo[0] = std::max(lo[0], -offs[3 * j]);
+    hi[0] = std::min(hi[0], nx - offs[3 * j]);
+    lo[1] = std::max(lo[1], -offs[3 * j + 1]);
+    hi[1] = std::min(hi[1], ny - offs[3 * j + 1]);
+    lo[2] = std::max(lo[2], -offs[3 * j + 2]);
+    hi[2] = std::min(hi[2], nz - offs[3 * j + 2]);
+  }
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t z = 0; z < nz; ++z) {
+    for (int64_t y = 0; y < ny; ++y) {
+      const int64_t rowbase = y * nx + z * nxy;
+      const bool yz_interior =
+          y >= lo[1] && y < hi[1] && z >= lo[2] && z < hi[2];
+      for (int64_t x = 0; x < nx; ++x) {
+        const int64_t i = rowbase + x;
+        int32_t *rout = rows_out + i * k;
+        uint8_t *mout = mask_out + i * k;
+        if (yz_interior && x >= lo[0] && x < hi[0]) {
+          if (owner == nullptr) {
+            for (int64_t j = 0; j < k; ++j) {
+              rout[j] = row_of_pos[i + dflat[j]];
+              mout[j] = 1;
+            }
+          } else {
+            const int32_t own = owner[i];
+            for (int64_t j = 0; j < k; ++j) {
+              const int64_t ng = i + dflat[j];
+              rout[j] = owner[ng] == own ? row_of_pos[ng]
+                                         : (int32_t)(-2 - ng);
+              mout[j] = 1;
+            }
+          }
+          continue;
+        }
+        for (int64_t j = 0; j < k; ++j) {
+          int64_t xx = x + offs[3 * j], yy = y + offs[3 * j + 1],
+                  zz = z + offs[3 * j + 2];
+          bool valid = true;
+          if (xx < 0 || xx >= nx) {
+            if (px)
+              xx = ((xx % nx) + nx) % nx;
+            else
+              valid = false;
+          }
+          if (yy < 0 || yy >= ny) {
+            if (py)
+              yy = ((yy % ny) + ny) % ny;
+            else
+              valid = false;
+          }
+          if (zz < 0 || zz >= nz) {
+            if (pz)
+              zz = ((zz % nz) + nz) % nz;
+            else
+              valid = false;
+          }
+          if (!valid) {
+            rout[j] = pad_row;
+            mout[j] = 0;
+            continue;
+          }
+          const int64_t ng = xx + yy * nx + zz * nxy;
+          if (owner != nullptr && owner[ng] != owner[i])
+            rout[j] = (int32_t)(-2 - ng);
+          else
+            rout[j] = row_of_pos[ng];
+          mout[j] = 1;
+        }
+      }
+    }
+  }
+}
+
 int32_t dn_abi_version(void) { return 1; }
 
 } // extern "C"

@@ -74,12 +74,11 @@ def is_consistent(grid) -> None:
         if len(gids) and np.any(owner[pos] == d):
             _fail(f"device {d}: ghost row holds a locally-owned cell")
         # row lookup agrees with the row arrays
-        for r, cid in enumerate(plan.local_ids[d]):
-            if plan.local_row_of[d][int(cid)] != r:
-                _fail(f"device {d}: row lookup mismatch for cell {cid}")
-        for r, cid in enumerate(gids):
-            if plan.local_row_of[d][int(cid)] != plan.L + r:
-                _fail(f"device {d}: ghost row lookup mismatch for cell {cid}")
+        lpos = np.searchsorted(cells, plan.local_ids[d])
+        if len(lpos) and not np.array_equal(
+            plan.row_of_pos[lpos], np.arange(len(lpos), dtype=plan.row_of_pos.dtype)
+        ):
+            _fail(f"device {d}: row lookup mismatch in local rows")
 
 
 def verify_neighbors(grid) -> None:

@@ -64,6 +64,40 @@ def test_adapt_refines_hump_edge():
     assert len(far) > 0
 
 
+def test_fused_loop_matches_stepwise():
+    """run_fused(n) (one device program, exchange+flux+apply inside
+    lax.fori_loop) must reproduce n individual step() calls — on a
+    refined grid so the fused exchange covers AMR gather tables."""
+    a = AmrAdvection((8, 8, 1), max_refinement_level=1, mesh=mesh_of(4))
+    b = AmrAdvection((8, 8, 1), max_refinement_level=1, mesh=mesh_of(4))
+    a.adapt()
+    b.adapt()
+    dt = 0.4 * a.max_time_step()
+    for _ in range(5):
+        a.step(dt)
+    b.run_fused(5, dt)
+    cells = a.grid.get_cells()
+    np.testing.assert_allclose(
+        a.grid.get("density", cells), b.grid.get("density", cells),
+        rtol=1e-6, atol=1e-7,
+    )
+    assert a.time == pytest.approx(b.time)
+
+
+def test_run_fused_segments_match_run_stepwise():
+    """run(fused=True) with adaptation events must match fused=False."""
+    a = AmrAdvection((8, 8, 1), max_refinement_level=1, mesh=mesh_of(2))
+    b = AmrAdvection((8, 8, 1), max_refinement_level=1, mesh=mesh_of(2))
+    a.run(6, adapt_n=3, fused=False)
+    b.run(6, adapt_n=3, fused=True)
+    ca, cb = a.grid.get_cells(), b.grid.get_cells()
+    np.testing.assert_array_equal(ca, cb)
+    np.testing.assert_allclose(
+        a.grid.get("density", ca), b.grid.get("density", cb),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
 def test_mass_conserved_across_adaptation():
     """Refinement copies, unrefinement averages — both preserve total
     mass exactly (children have 1/8 the volume)."""
