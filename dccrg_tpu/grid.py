@@ -61,6 +61,7 @@ from . import uniform as uniform_mod
 DEFAULT_NEIGHBORHOOD_ID = -0xDCC
 
 _allocator_tuned = False
+_libc = None  # set by _tune_allocator; None = opted out / unavailable
 
 
 def _tune_allocator():
@@ -70,7 +71,7 @@ def _tune_allocator():
     builds on a quiet host). Applied lazily so merely importing the
     package leaves process-global malloc behavior untouched; opt out
     entirely with DCCRG_NO_MALLOPT=1."""
-    global _allocator_tuned
+    global _allocator_tuned, _libc
     if _allocator_tuned:
         return
     _allocator_tuned = True
@@ -84,6 +85,23 @@ def _tune_allocator():
         libc = ctypes.CDLL("libc.so.6")
         libc.mallopt(-3, 1 << 30)  # M_MMAP_THRESHOLD
         libc.mallopt(-1, 1 << 30)  # M_TRIM_THRESHOLD
+        _libc = libc
+    except Exception:
+        pass
+
+
+def _trim_allocator():
+    """Return freed heap to the OS after a large plan build: the raised
+    M_TRIM_THRESHOLD means free() alone never trims, so long-running
+    host applications embedding the library would otherwise keep the
+    build's peak RSS. One explicit malloc_trim after each large rebuild
+    keeps the build-speed win without the RSS cost. Must run after the
+    build's temporaries are actually dead (i.e. after _build_plan
+    returns), not inside it."""
+    if _libc is None:
+        return
+    try:
+        _libc.malloc_trim(0)
     except Exception:
         pass
 
@@ -389,6 +407,14 @@ class Grid:
         reference's initialize_neighbors + update_remote_neighbor_info +
         recalculate_neighbor_update_send_receive_lists +
         update_cell_pointers pipeline (dccrg.hpp:8371-8420)."""
+        self._build_plan_impl(cells, owner)
+        # the builder's large temporaries are dead only once the impl
+        # frame is gone; trim here so malloc_trim can actually return
+        # the build's peak to the OS
+        if len(cells) > 1 << 20:
+            _trim_allocator()
+
+    def _build_plan_impl(self, cells: np.ndarray, owner: np.ndarray):
         _tune_allocator()
         n_dev = self.n_dev
         order = np.argsort(cells, kind="stable")
@@ -396,8 +422,12 @@ class Grid:
         owner = np.asarray(owner, dtype=np.int32)[order]
 
         # all-level-0 grids take the closed-form fast path (uniform.py):
-        # identical tables, no entry stream, bounded temporaries
-        if uniform_mod.is_uniform(cells, self.mapping.length.total_level0_cells):
+        # identical tables, no entry stream, bounded temporaries. Both
+        # its native and numpy builders index cells with int32, so the
+        # fast path is gated at 2^31 cells (the generic path below and
+        # the reference's uint64 ids have no such bound).
+        n0 = self.mapping.length.total_level0_cells
+        if uniform_mod.is_uniform(cells, n0) and n0 < 2**31 - 2:
             self._build_plan_uniform(cells, owner)
             return
 
@@ -872,22 +902,65 @@ class Grid:
             return None
         return pos
 
+    def _cell_neighbors_of(self, pos, hood):
+        """(neighbor ids, offsets) of one cell. When the flat entry
+        stream is already materialized it is the fastest lookup; on the
+        uniform fast path (lazy stream) a single-cell find_neighbors_of
+        answers in O(K log n) instead of forcing the multi-GB stream
+        build the fast path exists to avoid."""
+        if callable(hood._lists):
+            src, nbr, off, _item = find_neighbors_of(
+                self.mapping, self.topology, self.plan.cells,
+                self.plan.cells[pos : pos + 1], hood.offsets,
+            )
+            return nbr, off
+        nl = hood.lists
+        m = nl.of_source == pos
+        return nl.of_neighbor[m], nl.of_offset[m]
+
+    def _cell_neighbors_to(self, pos, hood):
+        """(ids, offsets) of cells that consider this cell a neighbor.
+        Closed-form on the uniform fast path (all cells level 0: the
+        to-neighbor at item offset ``o`` is the cell at ``ijk - o``,
+        recorded offset ``-o`` in index units), entry stream otherwise."""
+        if callable(hood._lists):
+            cell = self.plan.cells[pos]
+            offs = np.asarray(hood.offsets, dtype=np.int64).reshape(-1, 3)
+            size = np.int64(1) << self.mapping.max_refinement_level
+            ijk = self.mapping.get_indices(np.uint64(cell)).astype(np.int64)
+            il = self.mapping.get_index_length().astype(np.int64)
+            cand = ijk[None, :] - offs * size
+            valid = np.ones(len(offs), dtype=bool)
+            for d in range(3):
+                if self.topology.is_periodic(d):
+                    cand[:, d] %= il[d]
+                else:
+                    valid &= (cand[:, d] >= 0) & (cand[:, d] < il[d])
+            item = np.nonzero(valid)[0]
+            ids = self.mapping.get_cell_from_indices(
+                cand[valid].astype(np.uint64), np.zeros(len(item), dtype=np.int64)
+            )
+            # stream parity: entries ordered by (source position, item)
+            order = np.lexsort((item, np.searchsorted(self.plan.cells, ids)))
+            return ids[order], (-offs[item[order]] * size)
+        nl = hood.lists
+        m = nl.to_source == pos
+        return nl.to_neighbor[m], nl.to_offset[m]
+
     def get_neighbors_of(self, cell, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
         """[(neighbor id, (dx, dy, dz))] in neighborhood-item order."""
-        nl = self.plan.hoods[neighborhood_id].lists
         pos = self._cell_pos(cell)
         if pos is None:
             raise ValueError(f"unknown cell {cell}")
-        m = nl.of_source == pos
-        return list(zip(nl.of_neighbor[m].tolist(), map(tuple, nl.of_offset[m])))
+        nbrs, offs = self._cell_neighbors_of(pos, self.plan.hoods[neighborhood_id])
+        return list(zip(nbrs.tolist(), map(tuple, offs)))
 
     def get_neighbors_to(self, cell, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
-        nl = self.plan.hoods[neighborhood_id].lists
         pos = self._cell_pos(cell)
         if pos is None:
             raise ValueError(f"unknown cell {cell}")
-        m = nl.to_source == pos
-        return list(zip(nl.to_neighbor[m].tolist(), map(tuple, nl.to_offset[m])))
+        nbrs, offs = self._cell_neighbors_to(pos, self.plan.hoods[neighborhood_id])
+        return list(zip(nbrs.tolist(), map(tuple, offs)))
 
     def get_face_neighbors_of(self, cell):
         """[(neighbor id, direction)] with directions +-1/2/3 as in the
@@ -927,9 +1000,7 @@ class Grid:
         pos = self._cell_pos(cell)
         if pos is None:
             return []
-        nl = hood.lists
-        m = nl.of_source == pos
-        nbrs, offs = nl.of_neighbor[m], nl.of_offset[m]
+        nbrs, offs = self._cell_neighbors_of(pos, hood)
         if len(nbrs) == 0:
             return []
         size = int(self.mapping.get_cell_length_in_indices(np.uint64(cell)))
@@ -979,11 +1050,10 @@ class Grid:
         pos = self._cell_pos(cell)
         if pos is None:
             return np.empty(0, np.uint64)
-        nl = hood.lists
         if to:
-            nbrs = nl.to_neighbor[nl.to_source == pos]
+            nbrs, _ = self._cell_neighbors_to(pos, hood)
         else:
-            nbrs = nl.of_neighbor[nl.of_source == pos]
+            nbrs, _ = self._cell_neighbors_of(pos, hood)
         own = int(self.plan.owner[pos])
         nbr_owner = self.plan.owner[np.searchsorted(self.plan.cells, nbrs)]
         out = nbrs[nbr_owner != own]
@@ -1834,6 +1904,19 @@ class Grid:
         return 1
 
     def is_local(self, cell, device=None) -> bool:
+        """Whether ``cell`` is owned by ``device``.
+
+        The reference's ``is_local`` means "owned by *this* process"
+        (its cell_process lookup against its own rank). Here host code
+        is a single controller that sees every device, so there is no
+        implicit "this device": with ``device=None`` the host-global
+        view applies and every *existing* cell is local (False only for
+        unknown ids). That is deliberate — the reference uses is_local
+        to gate per-rank request APIs (refine_completely, pin, ...); on
+        the single-controller model the host is allowed to request
+        changes to any cell, so those guards only reject unknown ids.
+        Pass an explicit ``device`` for the reference's owned-by-rank
+        meaning."""
         pos = self._cell_pos(cell)
         if pos is None:
             return False

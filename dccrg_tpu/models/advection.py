@@ -51,7 +51,7 @@ class PallasRotationAdvection:
     throughput."""
 
     def __init__(self, n=512, nz=None, dtype=jnp.float32, cfl=0.5, steps_per_pass=7,
-                 tile=(32, 128)):
+                 tile=(32, 128), interpret=False):
         from ..ops.advection_kernel import make_rotation_step
 
         nz = nz if nz is not None else n
@@ -70,7 +70,7 @@ class PallasRotationAdvection:
         self.vy_face = jnp.asarray(np.concatenate([vy[-8:], vy, vy[:8]])[:, None])
         self._step = make_rotation_step(
             (n, n, nz), dtype=dtype, tile=tile, steps_per_pass=steps_per_pass,
-            cell_length=(dx, dx, 1.0 / nz),
+            cell_length=(dx, dx, 1.0 / nz), interpret=interpret,
         )
         self.time = 0.0
 

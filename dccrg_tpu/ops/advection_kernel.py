@@ -36,7 +36,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def make_rotation_step(
-    shape, dtype=jnp.float32, tile=(8, 128), cell_length=None, steps_per_pass=1
+    shape, dtype=jnp.float32, tile=(8, 128), cell_length=None, steps_per_pass=1,
+    interpret=False,
 ):
     """Compile the 512^3-class benchmark step.
 
@@ -55,6 +56,10 @@ def make_rotation_step(
     ``vy_face`` is [X + 16, 1]: vy at cells (x - 8) % X, i.e. the cell
     values pre-extended by an 8-row wrap margin on each side so every
     dynamic slice offset stays sublane-aligned.
+
+    ``interpret=True`` runs the kernel under Pallas's TPU interpret
+    mode (pltpu.InterpretParams) so the DMA/semaphore logic and flux
+    math execute on CPU — used by CI, which has no TPU.
     """
     X, Y, Z = shape
     tx, tz = tile
@@ -200,6 +205,7 @@ def make_rotation_step(
     call = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
+        interpret=pltpu.InterpretParams() if interpret else False,
         out_shape=jax.ShapeDtypeStruct((X, Y, Z), dtype),
         compiler_params=pltpu.CompilerParams(
             # deep temporal blocking holds several flux temporaries live;

@@ -82,6 +82,10 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev):
 
     dims = tuple(int(v) for v in mapping.length.get())
     n0 = dims[0] * dims[1] * dims[2]
+    if n0 >= 2**31 - 2:
+        # int32 grid indices throughout (native AND numpy builders):
+        # callers must use the generic builder beyond 2^31 cells
+        raise ValueError(f"uniform fast path limited to < 2^31 cells, got {n0}")
     size = 1 << mapping.max_refinement_level  # index units per cell
     periodic = tuple(topology.is_periodic(d) for d in range(3))
     owner = np.asarray(owner, dtype=np.int32)

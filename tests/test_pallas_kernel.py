@@ -1,10 +1,12 @@
 """Pallas advection kernel tests.
 
-On the CPU test mesh the kernel runs in interpreter-equivalent CPU
-lowering only if supported; these tests therefore run the kernel in
-``interpret=True``-free form only when a TPU is present, and always
-cross-check the *math* via the pure-numpy reference implementation that
-mirrors tests/advection/solve.hpp.
+The kernel always runs: on a TPU it runs natively; on the CPU test
+mesh it runs under Pallas's TPU interpret mode
+(``pltpu.InterpretParams`` — DMA copies, semaphores and the grid
+pipeline are emulated on host), so CI exercises the real kernel body,
+not just the pure-numpy mirror of tests/advection/solve.hpp it is
+checked against. Interpret mode is slow, so CPU runs use a smaller
+grid than the TPU runs.
 """
 
 import numpy as np
@@ -42,12 +44,16 @@ def on_tpu():
         return False
 
 
-@pytest.mark.skipif(not on_tpu(), reason="pallas TPU kernel needs a TPU device")
+# native on TPU; interpreted (smaller grid) on the CPU test mesh
+INTERPRET = not on_tpu()
+
+
 @pytest.mark.parametrize("steps_per_pass", [1, 2, 4, 7])
 def test_pallas_matches_reference_math(steps_per_pass):
     from dccrg_tpu.ops.advection_kernel import make_rotation_step
 
-    N = Z = 128
+    N = 32 if INTERPRET else 128
+    Z = 128
     dx = 1.0 / N
     x = (np.arange(N) + 0.5) * dx
     rho = np.random.default_rng(0).random((N, N, Z)).astype(np.float32)
@@ -55,7 +61,10 @@ def test_pallas_matches_reference_math(steps_per_pass):
     vxf = (0.5 - x).astype(np.float32)[None, :]
     vy = (x - 0.5).astype(np.float32)
     vyx = np.concatenate([vy[-8:], vy, vy[:8]])[:, None]
-    step = make_rotation_step((N, N, Z), steps_per_pass=steps_per_pass)
+    step = make_rotation_step(
+        (N, N, Z), steps_per_pass=steps_per_pass, tile=(8, 128),
+        interpret=INTERPRET,
+    )
     got = np.asarray(step(jnp.asarray(rho), jnp.asarray(vxf), jnp.asarray(vyx), dt))
     want = rho
     for _ in range(steps_per_pass):
@@ -63,19 +72,20 @@ def test_pallas_matches_reference_math(steps_per_pass):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.skipif(not on_tpu(), reason="pallas TPU kernel needs a TPU device")
 def test_pallas_solver_l2_parity():
     """The fast path must match the general dense path's physics: same
     L2 error vs the analytic rotated hump."""
     from dccrg_tpu.models.advection import PallasRotationAdvection, analytic_density
 
-    s = PallasRotationAdvection(n=64, nz=128, steps_per_pass=4)
+    n, nz, passes = (64, 128, 16) if not INTERPRET else (32, 128, 4)
+    s = PallasRotationAdvection(n=n, nz=nz, steps_per_pass=4, interpret=INTERPRET)
     dt = 0.5 * s.max_time_step()
-    for _ in range(16):
+    for _ in range(passes):
         s.step(dt)
-    x = (np.arange(64) + 0.5) / 64
+    x = (np.arange(n) + 0.5) / n
     exact = np.asarray(
         analytic_density(x[:, None, None], x[None, :, None], s.time)
-    ) * np.ones((1, 1, 128))
+    ) * np.ones((1, 1, nz))
     err = float(np.sqrt(np.mean((np.asarray(s.rho, dtype=np.float64) - exact) ** 2)))
-    assert err < 0.03, err
+    # the coarser interpret config (n=32, 4 passes) is more diffusive
+    assert err < (0.05 if INTERPRET else 0.03), err

@@ -120,3 +120,25 @@ def test_amr_falls_back_to_generic():
     created = g.stop_refining()
     assert len(created) == 8
     assert len(g.plan.cells) == 4 * 4 + 8 - 1
+
+
+@pytest.mark.parametrize("periodic", [(False, True, False), (True, True, True)])
+def test_lazy_single_cell_queries_match_stream(periodic):
+    """Single-cell neighbor queries on the fast path answer closed-form
+    (without forcing the lazy entry stream) and must equal the
+    stream-backed answers entry for entry."""
+    g = make_grid(length=(5, 4, 3), periodic=periodic, n_dev=2,
+                  user_hood=[[1, 0, 0], [0, -1, 0], [1, 1, 1]])
+    for hid in (DEFAULT_NEIGHBORHOOD_ID, 42):
+        hood = g.plan.hoods[hid]
+        assert callable(hood._lists), "fast path should keep lists lazy"
+        lazy_of = {int(c): g.get_neighbors_of(c, hid) for c in g.plan.cells}
+        lazy_to = {int(c): g.get_neighbors_to(c, hid) for c in g.plan.cells}
+        lazy_rof = {int(c): g.get_remote_neighbors_of(c, hid).tolist()
+                    for c in g.plan.cells}
+        assert callable(hood._lists), "queries must not force the stream"
+        hood.lists  # materialize
+        for c in g.plan.cells:
+            assert lazy_of[int(c)] == g.get_neighbors_of(c, hid), int(c)
+            assert lazy_to[int(c)] == g.get_neighbors_to(c, hid), int(c)
+            assert lazy_rof[int(c)] == g.get_remote_neighbors_of(c, hid).tolist()
