@@ -20,6 +20,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 
 import dccrg_tpu as dt  # noqa: E402
 
@@ -39,9 +40,40 @@ def time_init(n, partition):
     return dt_s, n_cells
 
 
+def time_amr_commit(n):
+    """One AMR commit on an n^3 grid: refine a z-slab of 1/64 of the
+    level-0 cells (the hybrid builder's hard set is the slab surface),
+    then a second commit on the already-refined grid."""
+    g = (
+        dt.Grid(cell_data={"density": jnp.float32})
+        .set_initial_length((n, n, n))
+        .set_maximum_refinement_level(1)
+        .set_neighborhood_length(1)
+        .initialize()
+    )
+    cells = g.plan.cells
+    nref = len(cells) // 64
+    for c in cells[:nref]:
+        g.refine_completely(c)
+    t0 = time.time()
+    g.stop_refining()
+    first = time.time() - t0
+    cells = g.plan.cells
+    lvl0 = cells[cells <= np.uint64(n) ** 3]
+    for c in lvl0[-nref:]:
+        g.refine_completely(c)
+    t0 = time.time()
+    g.stop_refining()
+    second = time.time() - t0
+    n_cells = len(g.plan.cells)
+    del g
+    return first, second, n_cells
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--max", type=int, default=256)
+    ap.add_argument("--amr-max", type=int, default=128)
     args = ap.parse_args()
     sizes = [s for s in (64, 128, 256, 512) if s <= args.max]
     results = []
@@ -56,6 +88,13 @@ def main():
                 "cells_per_s": round(n_cells / secs),
             })
             print(json.dumps(results[-1]))
+    for n in (s for s in (64, 128, 256) if s <= args.amr_max):
+        first, second, n_cells = time_amr_commit(n)
+        results.append({
+            "size": f"{n}^3 + 1/64 refined", "amr_commit_s": round(first, 2),
+            "amr_recommit_s": round(second, 2), "cells": n_cells,
+        })
+        print(json.dumps(results[-1]))
     return results
 
 

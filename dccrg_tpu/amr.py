@@ -31,7 +31,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .mapping import Mapping
-from .neighbors import NeighborLists
+from .topology import GridTopology
 
 
 @dataclass
@@ -46,18 +46,98 @@ class AmrResult:
     unrefined_parents: np.ndarray  # cells created by unrefinement
 
 
-def _neighbor_pairs(lists: NeighborLists, n_cells: int):
-    """Symmetric (a, b) neighbor index pairs from the of/to lists."""
-    a = np.concatenate([lists.of_source, lists.to_source])
-    b_ids = np.concatenate([lists.of_neighbor, lists.to_neighbor])
-    return a, b_ids
+# bins above which the vectorized-lattice unrefine check falls back to
+# the per-parent loop (deeply refined grids have huge fine lattices)
+_LATTICE_MAX_BINS = 1 << 24
+
+
+def _shift_bool(a: np.ndarray, shift: int, axis: int, periodic: bool) -> np.ndarray:
+    """Boolean array shifted along ``axis``; wraps when periodic, else
+    shifts in zeros."""
+    if periodic:
+        return np.roll(a, shift, axis=axis)
+    out = np.zeros_like(a)
+    n = a.shape[axis]
+    if abs(shift) >= n:
+        return out
+    src = [slice(None)] * 3
+    dst = [slice(None)] * 3
+    if shift > 0:
+        src[axis] = slice(0, n - shift)
+        dst[axis] = slice(shift, n)
+    else:
+        src[axis] = slice(-shift, n)
+        dst[axis] = slice(0, n + shift)
+    out[tuple(dst)] = a[tuple(src)]
+    return out
+
+
+def _box_dilate(a: np.ndarray, radius, periodic) -> np.ndarray:
+    """Chebyshev-ball (box) dilation of a 3-D bool lattice, separable
+    per axis. ``radius`` is a scalar or a per-axis sequence; ``periodic``
+    a per-axis sequence (both in the array's axis order)."""
+    if np.isscalar(radius):
+        radius = (radius,) * 3
+    for d in range(3):
+        acc = a.copy()
+        for s in range(1, int(radius[d]) + 1):
+            acc |= _shift_bool(a, s, d, periodic[d])
+            acc |= _shift_bool(a, -s, d, periodic[d])
+        a = acc
+    return a
+
+
+class _FrontierEdges:
+    """Incrementally discovered neighbor edges for the commit fixpoints.
+
+    The reference's override/induce phases propagate flags along
+    neighbor links, iterated to a global fixpoint (dccrg.hpp:9730-10233).
+    Propagation only ever leaves *flagged* cells, so instead of building
+    the full O(all cells) of/to streams, edges are fetched on demand for
+    the flagged frontier: neighbors_of via the generic engine,
+    neighbors_to via the direct subset query — O(touched cells), not
+    O(grid)."""
+
+    def __init__(self, mapping, topology, cells, offsets):
+        self.mapping = mapping
+        self.topology = topology
+        self.cells = cells
+        self.offsets = offsets
+        n = len(cells)
+        self._expanded = np.zeros(n, dtype=bool)
+        self.src = np.empty(0, dtype=np.int64)
+        self.nbr = np.empty(0, dtype=np.int64)
+
+    def expand(self, flag: np.ndarray) -> None:
+        """Ensure edges of every flagged position are loaded."""
+        from .neighbors import find_neighbors_of, find_neighbors_to_subset
+
+        new = np.nonzero(flag & ~self._expanded)[0]
+        if len(new) == 0:
+            return
+        self._expanded[new] = True
+        q = self.cells[new]
+        src, nbr, _off, _item = find_neighbors_of(
+            self.mapping, self.topology, self.cells, q, self.offsets
+        )
+        qi, to_src, _off2 = find_neighbors_to_subset(
+            self.mapping, self.topology, self.cells, q, self.offsets
+        )
+        self.src = np.concatenate([
+            self.src, new[src], new[qi]
+        ])
+        self.nbr = np.concatenate([
+            self.nbr,
+            np.searchsorted(self.cells, nbr),
+            np.searchsorted(self.cells, to_src),
+        ])
 
 
 def resolve_adaptation(
     mapping: Mapping,
     cells: np.ndarray,
     owner: np.ndarray,
-    lists: NeighborLists,
+    offsets: np.ndarray,
     refines: set,
     unrefines: set,
     dont_refines: set,
@@ -67,33 +147,42 @@ def resolve_adaptation(
     topology=None,
     hood_len: int = 1,
 ) -> AmrResult:
-    """Run the full commit pipeline on the replicated structure."""
+    """Run the full commit pipeline on the replicated structure.
+
+    ``offsets`` is the default neighborhood's offset list (the
+    reference's commit propagates along the default neighborhood,
+    dccrg.hpp:9730-9906)."""
     n = len(cells)
     lvl = mapping.get_refinement_level(cells)
-    pos_of = {int(c): i for i, c in enumerate(cells)}
+    if topology is None:
+        topology = GridTopology((False, False, False))
 
-    pair_src, pair_nbr_ids = _neighbor_pairs(lists, n)
-    pair_nbr = np.searchsorted(cells, pair_nbr_ids)
+    def positions(id_set):
+        """Positions of the ids that exist in the cell list."""
+        if not id_set:
+            return np.empty(0, dtype=np.int64)
+        ids = np.fromiter((int(c) for c in id_set), dtype=np.uint64,
+                          count=len(id_set))
+        pos = np.minimum(np.searchsorted(cells, ids), n - 1)
+        return pos[cells[pos] == ids].astype(np.int64)
+
+    edges = _FrontierEdges(mapping, topology, cells, offsets)
 
     refine_flag = np.zeros(n, dtype=bool)
-    for c in refines:
-        i = pos_of.get(int(c))
-        if i is not None and lvl[i] < mapping.max_refinement_level:
-            refine_flag[i] = True
+    rp = positions(refines)
+    refine_flag[rp[lvl[rp] < mapping.max_refinement_level]] = True
 
     # --- override_refines: spread dont_refine to finer neighbors ------
     # (dccrg.hpp:10130-10233) a blocked cell also blocks the refinement
     # of any strictly finer neighbor, recursively.
     blocked = np.zeros(n, dtype=bool)
-    for c in dont_refines:
-        i = pos_of.get(int(c))
-        if i is not None:
-            blocked[i] = True
+    blocked[positions(dont_refines)] = True
     while True:
+        edges.expand(blocked)
         # finer neighbors of blocked cells become blocked
-        m = blocked[pair_src] & (lvl[pair_nbr] > lvl[pair_src])
+        m = blocked[edges.src] & (lvl[edges.nbr] > lvl[edges.src])
         new = np.zeros(n, dtype=bool)
-        new[pair_nbr[m]] = True
+        new[edges.nbr[m]] = True
         new &= ~blocked
         if not new.any():
             break
@@ -103,9 +192,10 @@ def resolve_adaptation(
     # --- induce_refines (dccrg.hpp:9730-9906) --------------------------
     # refining a cell forces every coarser neighbor to refine
     while True:
-        m = refine_flag[pair_src] & (lvl[pair_nbr] < lvl[pair_src])
+        edges.expand(refine_flag)
+        m = refine_flag[edges.src] & (lvl[edges.nbr] < lvl[edges.src])
         cand = np.zeros(n, dtype=bool)
-        cand[pair_nbr[m]] = True
+        cand[edges.nbr[m]] = True
         cand &= ~refine_flag & ~blocked & (lvl < mapping.max_refinement_level)
         # note: a coarser cell that is blocked cannot be forced; the
         # reference guarantees this cannot happen because the spread
@@ -118,18 +208,15 @@ def resolve_adaptation(
     final_lvl = lvl + refine_flag.astype(np.int64)
 
     # --- unrefines: expand to sibling groups ---------------------------
-    unref_parent = {}  # parent id -> True (candidate sibling group)
-    for c in unrefines:
-        i = pos_of.get(int(c))
-        if i is None or lvl[i] == 0:
-            continue
-        unref_parent[int(mapping.get_parent(np.uint64(c)))] = True
+    up = positions(unrefines)
+    up = up[lvl[up] > 0]
+    unref_parent = (
+        np.unique(mapping.get_parent(cells[up])) if len(up)
+        else np.empty(0, np.uint64)
+    )
 
     dont_unref = np.zeros(n, dtype=bool)
-    for c in dont_unrefines:
-        i = pos_of.get(int(c))
-        if i is not None:
-            dont_unref[i] = True
+    dont_unref[positions(dont_unrefines)] = True
 
     # --- override_unrefines (dccrg.hpp:9935-10124) ---------------------
     # The reference walks the neighborhood AROUND THE PARENT (BFS over
@@ -137,69 +224,76 @@ def resolve_adaptation(
     # the parent's own edge length as its radius unit — twice the
     # children's — so a cell just outside the children's windows can
     # still violate the <=1-level rule against the new parent. Check
-    # cells intersecting the parent's would-be window directly.
-    accepted_parents = []
+    # cells intersecting the parent's would-be window directly: the
+    # window is exactly the (2r+1)^3 parent-size-aligned bins around
+    # the parent, so the check vectorizes as a box-dilated occupancy
+    # lattice of too-fine cells (per-parent interval loop as fallback
+    # for deeply refined grids whose bin lattice would be huge).
+    accepted_parents = np.empty(0, np.uint64)
+    cand_parents = np.empty(0, np.uint64)
+    cand_kpos = np.empty((0, 8), np.int64)
     if len(unref_parent):
-        # geometry of potential violators: anything whose
-        # post-refinement level exceeds the candidate's children
         idx_all = mapping.get_indices(cells).astype(np.int64)
         size_all = (1 << (mapping.max_refinement_level - lvl)).astype(np.int64)
         index_length = mapping.get_index_length().astype(np.int64)
         radius = max(int(hood_len), 1)
-        periodic = np.array(
-            [topology.is_periodic(d) if topology is not None else False
-             for d in range(3)]
-        )
-        # per child level, the (indices, sizes) of all finer-than-child
-        # cells — shared by every candidate at that level
-        fine_by_lvl = {}
+        periodic = np.array([topology.is_periodic(d) for d in range(3)])
 
-        def fine_cells_at(child_lvl):
-            if child_lvl not in fine_by_lvl:
-                fine = final_lvl > child_lvl
-                fine_by_lvl[child_lvl] = (idx_all[fine], size_all[fine])
-            return fine_by_lvl[child_lvl]
+        # sibling-group screening, vectorized over candidates: all 8
+        # children must be leaves, none refining or marked dont_unrefine
+        kids = mapping.get_all_children(unref_parent)  # [P, 8]
+        kpos = np.minimum(np.searchsorted(cells, kids), n - 1)
+        kid_ok = cells[kpos] == kids
+        group_ok = kid_ok.all(axis=1)
+        group_ok &= ~(refine_flag[kpos] & kid_ok).any(axis=1)
+        group_ok &= ~(dont_unref[kpos] & kid_ok).any(axis=1)
+        cand_parents = unref_parent[group_ok]
+        cand_kpos = kpos[group_ok].astype(np.int64)
 
-    for parent in sorted(unref_parent):
-        kids = mapping.get_all_children(np.uint64(parent))
-        kid_idx = []
-        ok = True
-        for k in kids:
-            i = pos_of.get(int(k))
-            if i is None:  # a sibling is not a leaf (refined deeper)
-                ok = False
-                break
-            kid_idx.append(i)
-        if not ok:
-            continue
-        kid_idx = np.array(kid_idx)
-        if refine_flag[kid_idx].any() or dont_unref[kid_idx].any():
-            continue
-        # parent (level child-1) must stay within 1 level of everything
-        # in ITS neighborhood: no cell with final level > child level
-        # may intersect the parent's window
-        child_lvl = lvl[kid_idx[0]]
-        fi, fs = fine_cells_at(child_lvl)
-        if len(fi) == 0:
-            accepted_parents.append(parent)
-            continue
-        s_p = 2 * size_all[kid_idx[0]]
-        base = idx_all[kid_idx[0]]  # parent min corner = first child's
-        lo = base - radius * s_p
-        hi = base + (radius + 1) * s_p  # exclusive
-        hit = np.ones(len(fi), dtype=bool)
-        for d in range(3):
-            if periodic[d]:
-                span = index_length[d]
-                h = np.zeros(len(fi), dtype=bool)
-                for shift in (-span, 0, span):
-                    h |= (fi[:, d] + shift < hi[d]) & (fi[:, d] + fs + shift > lo[d])
-                hit &= h
+    if len(cand_parents):
+        child_lvls = lvl[cand_kpos[:, 0]]
+        accepted = np.zeros(len(cand_parents), dtype=bool)
+        for child_lvl in np.unique(child_lvls):
+            sel = np.nonzero(child_lvls == child_lvl)[0]
+            s_c = 1 << (mapping.max_refinement_level - int(child_lvl))
+            s_p = 2 * s_c  # parent size; divides the extent (child_lvl >= 1)
+            fine = final_lvl > child_lvl
+            # parent min corner = first child's
+            parent_base = idx_all[cand_kpos[sel, 0]]
+            if not fine.any():
+                accepted[sel] = True
+                continue
+            bins = index_length // s_p
+            if float(np.prod(bins.astype(np.float64))) <= _LATTICE_MAX_BINS:
+                # too-fine cells (size < s_p, aligned) occupy exactly
+                # one s_p bin each; a parent is rejected iff any lies
+                # within Chebyshev radius of its window
+                occ = np.zeros(tuple(bins), dtype=bool)
+                fb = idx_all[fine] // s_p
+                occ[fb[:, 0], fb[:, 1], fb[:, 2]] = True
+                occ = _box_dilate(occ, radius, periodic)
+                pb = parent_base // s_p
+                accepted[sel] = ~occ[pb[:, 0], pb[:, 1], pb[:, 2]]
             else:
-                hit &= (fi[:, d] < hi[d]) & (fi[:, d] + fs > lo[d])
-        if hit.any():
-            continue
-        accepted_parents.append(parent)
+                fi, fs = idx_all[fine], size_all[fine]
+                for k, base in zip(sel, parent_base):
+                    lo = base - radius * s_p
+                    hi = base + (radius + 1) * s_p  # exclusive
+                    hit = np.ones(len(fi), dtype=bool)
+                    for d in range(3):
+                        if periodic[d]:
+                            span = index_length[d]
+                            h = np.zeros(len(fi), dtype=bool)
+                            for shift in (-span, 0, span):
+                                h |= (fi[:, d] + shift < hi[d]) & (
+                                    fi[:, d] + fs + shift > lo[d]
+                                )
+                            hit &= h
+                        else:
+                            hit &= (fi[:, d] < hi[d]) & (fi[:, d] + fs > lo[d])
+                    accepted[k] = not hit.any()
+        accepted_parents = cand_parents[accepted]
+        accepted_kpos = cand_kpos[accepted]
 
     # --- execute (dccrg.hpp:10243-10693) -------------------------------
     refined_idx = np.nonzero(refine_flag)[0]
@@ -211,21 +305,15 @@ def resolve_adaptation(
     )
     child_owner = np.repeat(owner[refined_idx], 8) if len(refined_idx) else np.empty(0, np.int32)
 
-    removed = []
-    removed_owner = []
-    new_parents = []
-    new_parent_owner = []
-    for parent in accepted_parents:
-        kids = mapping.get_all_children(np.uint64(parent))
-        idx = np.array([pos_of[int(k)] for k in kids])
-        removed.append(kids)
-        removed_owner.append(owner[idx])
-        new_parents.append(parent)
+    if len(accepted_parents):
+        removed = mapping.get_all_children(accepted_parents).reshape(-1)
+        new_parents = accepted_parents
         # parent owned by owner of first child (dccrg.hpp:10437)
-        new_parent_owner.append(owner[idx[0]])
-    removed = np.concatenate(removed) if removed else np.empty(0, np.uint64)
-    new_parents = np.array(new_parents, dtype=np.uint64)
-    new_parent_owner = np.array(new_parent_owner, dtype=np.int32)
+        new_parent_owner = owner[accepted_kpos[:, 0]].astype(np.int32)
+    else:
+        removed = np.empty(0, np.uint64)
+        new_parents = np.empty(0, np.uint64)
+        new_parent_owner = np.empty(0, np.int32)
 
     # assemble the new cell list
     drop = np.zeros(n, dtype=bool)

@@ -44,6 +44,7 @@ from .mapping import Mapping
 from .neighbors import (
     build_neighbor_lists,
     find_neighbors_of,
+    find_neighbors_to_subset,
     make_neighborhood,
     validate_neighborhood,
     verify_tiling,
@@ -140,7 +141,9 @@ class _HoodPlan:
 
     def __init__(self, offsets, nbr_rows, nbr_offs, nbr_mask,
                  send_rows, recv_rows, n_inner, lists=None, to_tables=None,
-                 to_rows=None, to_offs=None, to_mask=None, offs_const=None):
+                 to_rows=None, to_offs=None, to_mask=None, offs_const=None,
+                 hard_rows=None, hard_nbr_rows=None, hard_offs=None,
+                 hard_mask=None, scale_rows=None):
         self.offsets = offsets  # [K, 3] neighborhood items
         # stencil gather tables, per device, padded:
         self.nbr_rows = nbr_rows  # [n_dev, L, S] int32 row (pad: zero row)
@@ -150,6 +153,18 @@ class _HoodPlan:
         # stencils synthesize noffs = mask * offs_const on device and
         # the full nbr_offs array is only built if a host query asks
         self.offs_const = offs_const  # [S, 3] int32 or None
+        # hybrid plans (split tables): cells near refinement hold up to
+        # ~8x more neighbor entries than the uniform bulk, so they get
+        # their own compact tables and stencils run a second gather
+        # over just those rows instead of padding every row to the
+        # hard width
+        self.hard_rows = hard_rows  # [n_dev, H] int32 (pad: L) or None
+        self.hard_nbr_rows = hard_nbr_rows  # [n_dev, H, Sh] int32
+        self.hard_offs = hard_offs  # [n_dev, H, Sh, 3] int32
+        self.hard_mask = hard_mask  # [n_dev, H, Sh] bool
+        # hybrid plans: offs_const is in CELL units; per-row cell size
+        # (index units) scales it on device (far/easy rows only)
+        self.scale_rows = scale_rows  # [n_dev, L] int32 or None
         # halo exchange tables:
         self.send_rows = send_rows  # [n_dev(src), n_dev(dst), M] int32 or -1
         self.recv_rows = recv_rows  # [n_dev(dst), n_dev(src), M] int32 or -1
@@ -175,6 +190,36 @@ class _HoodPlan:
         if callable(self._to):
             self._to = self._to()
         return self._to
+
+    def merged_of_tables(self, pad_row):
+        """Dense [n_dev, L, S] (rows, offs, mask) merging the far and
+        hard pieces of a split-table plan — the include_to fallback and
+        table-introspection view. Plain plans return their own arrays.
+        ``pad_row`` is the zero pad row index (plan.R - 1)."""
+        if self.hard_nbr_rows is None:
+            return np.asarray(self.nbr_rows), np.asarray(self.nbr_offs), np.asarray(self.nbr_mask)
+        n_dev, L, k = self.nbr_rows.shape
+        Sh = self.hard_nbr_rows.shape[2]
+        S = max(k, Sh)
+        rows = np.full((n_dev, L, S), pad_row, dtype=np.int32)
+        offs = np.zeros((n_dev, L, S, 3), dtype=np.int32)
+        mask = np.zeros((n_dev, L, S), dtype=bool)
+        rows[:, :, :k] = self.nbr_rows
+        mask[:, :, :k] = self.nbr_mask
+        offs[:, :, :k] = self.nbr_mask[..., None] * np.asarray(self.offs_const)[None, None, :, :]
+        if self.scale_rows is not None:
+            offs[:, :, :k] *= np.asarray(self.scale_rows)[:, :, None, None]
+        for d in range(n_dev):
+            hr = np.asarray(self.hard_rows[d])
+            real = hr < L
+            # hard rows have no far entries: overwrite the full row
+            rows[d, hr[real]] = pad_row
+            mask[d, hr[real]] = False
+            offs[d, hr[real]] = 0
+            rows[d, hr[real], :Sh] = self.hard_nbr_rows[d, real]
+            mask[d, hr[real], :Sh] = self.hard_mask[d, real]
+            offs[d, hr[real], :Sh] = self.hard_offs[d, real]
+        return rows, offs, mask
 
     @property
     def to_rows(self):  # [n_dev, L, T] int32 neighbors_to gather table
@@ -431,6 +476,15 @@ class Grid:
             self._build_plan_uniform(cells, owner)
             return
 
+        # refined grids take the hybrid path (hybrid.py): closed-form
+        # tables away from refinement, generic engine for the hard
+        # subset near it — O(refinement surface), not O(grid)
+        import os as _os
+
+        if n0 < 2**31 - 2 and _os.environ.get("DCCRG_FORCE_GENERIC") != "1":
+            self._build_plan_hybrid(cells, owner)
+            return
+
         # per-hood neighbor lists (host), with neighbor positions in the
         # sorted cell array resolved once per hood (reused everywhere)
         hood_lists = {
@@ -551,6 +605,55 @@ class Grid:
                 nbr_offs=hd["nbr_offs"],
                 nbr_mask=hd["nbr_mask"],
                 offs_const=hd["offs_const"],
+                to_tables=hd["to_thunk"],
+                send_rows=hd["send_rows"],
+                recv_rows=hd["recv_rows"],
+                n_inner=(layout["n_inner"]
+                         if hid == DEFAULT_NEIGHBORHOOD_ID else None),
+                lists=lists_thunk,
+            )
+        self._finish_plan(plan)
+
+    def _build_plan_hybrid(self, cells: np.ndarray, owner: np.ndarray):
+        """Plan construction for refined grids (hybrid.py): closed-form
+        lattice tables for level-0 cells away from refinement, generic
+        engine only for the hard subset near it. Same layout and
+        semantics as the generic builder."""
+        from . import hybrid as hybrid_mod
+
+        layout, hood_data = hybrid_mod.build_hybrid_plan(
+            self.mapping, self.topology, self.neighborhoods, cells, owner,
+            self.n_dev,
+        )
+        plan = _Plan(
+            cells=cells,
+            owner=owner,
+            n_dev=self.n_dev,
+            L=layout["L"],
+            R=layout["R"],
+            n_local=layout["n_local"],
+            local_ids=layout["local_ids"],
+            row_of_pos=layout["row_of_pos"],
+            ghost_ids=layout["ghost_ids"],
+        )
+        mapping, topology = self.mapping, self.topology
+        for hid, offs in self.neighborhoods.items():
+            hd = hood_data[hid]
+
+            def lists_thunk(offs=offs):
+                return build_neighbor_lists(mapping, topology, cells, offs)
+
+            plan.hoods[hid] = _HoodPlan(
+                offsets=offs,
+                nbr_rows=hd["nbr_rows"],
+                nbr_offs=hd["nbr_offs"],
+                nbr_mask=hd["nbr_mask"],
+                offs_const=hd["offs_const"],
+                hard_rows=hd["hard_rows"],
+                hard_nbr_rows=hd["hard_nbr_rows"],
+                hard_offs=hd["hard_offs"],
+                hard_mask=hd["hard_mask"],
+                scale_rows=layout["scale_rows"],
                 to_tables=hd["to_thunk"],
                 send_rows=hd["send_rows"],
                 recv_rows=hd["recv_rows"],
@@ -920,29 +1023,14 @@ class Grid:
 
     def _cell_neighbors_to(self, pos, hood):
         """(ids, offsets) of cells that consider this cell a neighbor.
-        Closed-form on the uniform fast path (all cells level 0: the
-        to-neighbor at item offset ``o`` is the cell at ``ijk - o``,
-        recorded offset ``-o`` in index units), entry stream otherwise."""
+        Direct subset query when the entry stream is lazy (uniform and
+        hybrid fast paths), entry stream otherwise."""
         if callable(hood._lists):
-            cell = self.plan.cells[pos]
-            offs = np.asarray(hood.offsets, dtype=np.int64).reshape(-1, 3)
-            size = np.int64(1) << self.mapping.max_refinement_level
-            ijk = self.mapping.get_indices(np.uint64(cell)).astype(np.int64)
-            il = self.mapping.get_index_length().astype(np.int64)
-            cand = ijk[None, :] - offs * size
-            valid = np.ones(len(offs), dtype=bool)
-            for d in range(3):
-                if self.topology.is_periodic(d):
-                    cand[:, d] %= il[d]
-                else:
-                    valid &= (cand[:, d] >= 0) & (cand[:, d] < il[d])
-            item = np.nonzero(valid)[0]
-            ids = self.mapping.get_cell_from_indices(
-                cand[valid].astype(np.uint64), np.zeros(len(item), dtype=np.int64)
+            _qi, src, off = find_neighbors_to_subset(
+                self.mapping, self.topology, self.plan.cells,
+                self.plan.cells[pos : pos + 1], hood.offsets,
             )
-            # stream parity: entries ordered by (source position, item)
-            order = np.lexsort((item, np.searchsorted(self.plan.cells, ids)))
-            return ids[order], (-offs[item[order]] * size)
+            return src, off
         nl = hood.lists
         m = nl.to_source == pos
         return nl.to_neighbor[m], nl.to_offset[m]
@@ -1308,15 +1396,33 @@ class Grid:
         hood = self.plan.hoods[neighborhood_id]
         L, R = self.plan.L, self.plan.R
         sh = self._sharding()
-        uniform_offs = hood.offs_const is not None
-        nbr_rows = jax.device_put(jnp.asarray(hood.nbr_rows), sh)
-        if uniform_offs:
-            # per-slot constant offsets: synthesized in-body from the
-            # mask instead of storing [n_dev, L, S, 3] in HBM
-            nbr_offs = jnp.asarray(hood.offs_const)  # [S, 3] replicated
+        split = hood.hard_nbr_rows is not None and not include_to
+        if include_to and hood.hard_nbr_rows is not None:
+            # include_to on a split-table plan: rare API-parity path,
+            # served by the merged dense tables
+            m_rows, m_offs, m_mask = hood.merged_of_tables(R - 1)
+            uniform_offs = False
+            nbr_rows = jax.device_put(jnp.asarray(m_rows), sh)
+            nbr_offs = jax.device_put(jnp.asarray(m_offs), sh)
+            nbr_mask = jax.device_put(jnp.asarray(m_mask), sh)
         else:
-            nbr_offs = jax.device_put(jnp.asarray(hood.nbr_offs), sh)
-        nbr_mask = jax.device_put(jnp.asarray(hood.nbr_mask), sh)
+            uniform_offs = hood.offs_const is not None
+            nbr_rows = jax.device_put(jnp.asarray(hood.nbr_rows), sh)
+            if uniform_offs:
+                # per-slot constant offsets: synthesized in-body from the
+                # mask instead of storing [n_dev, L, S, 3] in HBM
+                nbr_offs = jnp.asarray(hood.offs_const)  # [S, 3] replicated
+            else:
+                nbr_offs = jax.device_put(jnp.asarray(hood.nbr_offs), sh)
+            nbr_mask = jax.device_put(jnp.asarray(hood.nbr_mask), sh)
+        scaled = uniform_offs and hood.scale_rows is not None
+        if scaled:
+            scale_arr = jax.device_put(jnp.asarray(hood.scale_rows), sh)
+        if split:
+            h_rows = jax.device_put(jnp.asarray(hood.hard_rows), sh)
+            h_nrows = jax.device_put(jnp.asarray(hood.hard_nbr_rows), sh)
+            h_offs = jax.device_put(jnp.asarray(hood.hard_offs), sh)
+            h_mask = jax.device_put(jnp.asarray(hood.hard_mask), sh)
         if include_to:
             to_rows = jax.device_put(jnp.asarray(hood.to_rows), sh)
             to_offs = jax.device_put(jnp.asarray(hood.to_offs), sh)
@@ -1326,25 +1432,46 @@ class Grid:
 
         def body(nrows, noffs, nmask, *args):
             nrows, nmask = nrows[0], nmask[0]
+            if scaled:
+                sc, *args = args
             if uniform_offs:
                 noffs = nmask[:, :, None] * noffs[None, :, :]
+                if scaled:
+                    # offs_const is in cell units; scale by per-row size
+                    noffs = noffs * sc[0][:, None, None]
             else:
                 noffs = noffs[0]
+            if split:
+                hr, hnr, hof, hm, *args = args
+                hr, hnr, hof, hm = hr[0], hnr[0], hof[0], hm[0]
             if include_to:
                 trows, toffs, tmask, *args = args
                 trows, toffs, tmask = trows[0], toffs[0], tmask[0]
             ins = args[:n_in]
             outs_cur = args[n_in: n_in + n_out]
+            extra = args[n_in + n_out:]
             cell_fields = {n: f[0][:L] for n, f in zip(fields_in, ins)}
             nbr_fields = {n: f[0][nrows] for n, f in zip(fields_in, ins)}
             if include_to:
                 to_fields = {n: f[0][trows] for n, f in zip(fields_in, ins)}
                 result = kernel(
                     cell_fields, nbr_fields, noffs, nmask, to_fields, toffs, tmask,
-                    *args[n_in + n_out:],
+                    *extra,
                 )
             else:
-                result = kernel(cell_fields, nbr_fields, noffs, nmask, *args[n_in + n_out:])
+                result = kernel(cell_fields, nbr_fields, noffs, nmask, *extra)
+            if split:
+                # second pass over the hard rows (near refinement) with
+                # their own, wider gather tables; results scattered over
+                # the far pass's output (pad index L drops)
+                hrc = jnp.minimum(hr, L - 1)
+                h_cell = {n: cell_fields[n][hrc] for n in fields_in}
+                h_nbr = {n: f[0][hnr] for n, f in zip(fields_in, ins)}
+                h_result = kernel(h_cell, h_nbr, hof, hm, *extra)
+                for n in fields_out:
+                    result[n] = result[n].at[hr].set(
+                        h_result[n].astype(result[n].dtype), mode="drop"
+                    )
             outs = []
             for n, cur in zip(fields_out, outs_cur):
                 fl = cur[0]
@@ -1352,11 +1479,14 @@ class Grid:
                 outs.append(fl[None])
             return tuple(outs)
 
+        split_specs = (P(axis),) * 4 if split else ()
         to_specs = (P(axis), P(axis), P(axis)) if include_to else ()
         mapped = _shard_map(
             body,
             mesh=mesh,
             in_specs=(P(axis), P() if uniform_offs else P(axis), P(axis))
+            + ((P(axis),) if scaled else ())
+            + split_specs
             + to_specs
             + (P(axis),) * (n_in + n_out) + (P(),) * n_extra,
             out_specs=(P(axis),) * n_out,
@@ -1365,9 +1495,11 @@ class Grid:
 
         @jax.jit
         def run(*args):
+            pre = (scale_arr,) if scaled else ()
+            pre += (h_rows, h_nrows, h_offs, h_mask) if split else ()
             if include_to:
-                return mapped(nbr_rows, nbr_offs, nbr_mask, to_rows, to_offs, to_mask, *args)
-            return mapped(nbr_rows, nbr_offs, nbr_mask, *args)
+                return mapped(nbr_rows, nbr_offs, nbr_mask, *pre, to_rows, to_offs, to_mask, *args)
+            return mapped(nbr_rows, nbr_offs, nbr_mask, *pre, *args)
 
         return run
 
@@ -1415,12 +1547,21 @@ class Grid:
         L, R = self.plan.L, self.plan.R
         sh = self._sharding()
         uniform_offs = hood.offs_const is not None
+        split = hood.hard_nbr_rows is not None
         nbr_rows = jax.device_put(jnp.asarray(hood.nbr_rows), sh)
         if uniform_offs:
             nbr_offs = jnp.asarray(hood.offs_const)  # [S, 3] replicated
         else:
             nbr_offs = jax.device_put(jnp.asarray(hood.nbr_offs), sh)
         nbr_mask = jax.device_put(jnp.asarray(hood.nbr_mask), sh)
+        scaled = uniform_offs and hood.scale_rows is not None
+        if scaled:
+            scale_arr = jax.device_put(jnp.asarray(hood.scale_rows), sh)
+        if split:
+            h_rows = jax.device_put(jnp.asarray(hood.hard_rows), sh)
+            h_nrows = jax.device_put(jnp.asarray(hood.hard_nbr_rows), sh)
+            h_offs = jax.device_put(jnp.asarray(hood.hard_offs), sh)
+            h_mask = jax.device_put(jnp.asarray(hood.hard_mask), sh)
         send = jax.device_put(jnp.asarray(hood.send_rows), sh)
         recv = jax.device_put(jnp.asarray(hood.recv_rows), sh)
         static_in = tuple(n for n in fields_in if n not in fields_out)
@@ -1431,10 +1572,18 @@ class Grid:
         def body(n_steps, send_r, recv_r, nrows, noffs, nmask, *args):
             send_r, recv_r = send_r[0], recv_r[0]
             nrows, nmask = nrows[0], nmask[0]
+            if scaled:
+                sc, *args = args
             if uniform_offs:
                 noffs = nmask[:, :, None] * noffs[None, :, :]
+                if scaled:
+                    noffs = noffs * sc[0][:, None, None]
             else:
                 noffs = noffs[0]
+            if split:
+                hr, hnr, hof, hm, *args = args
+                hr, hnr, hof, hm = hr[0], hnr[0], hof[0], hm[0]
+                hrc = jnp.minimum(hr, L - 1)
             rr = jnp.where(recv_r >= 0, recv_r, R - 1).reshape(-1)
             statics = {n: a[0] for n, a in zip(static_in, args[:n_static])}
             state0 = tuple(a[0] for a in args[n_static:n_static + n_out])
@@ -1459,6 +1608,14 @@ class Grid:
                 cell_fields = {n: full[n][:L] for n in fields_in}
                 nbr_fields = {n: full[n][nrows] for n in fields_in}
                 result = kernel(cell_fields, nbr_fields, noffs, nmask, *extra)
+                if split:
+                    h_cell = {n: cell_fields[n][hrc] for n in fields_in}
+                    h_nbr = {n: full[n][hnr] for n in fields_in}
+                    h_result = kernel(h_cell, h_nbr, hof, hm, *extra)
+                    for n in fields_out:
+                        result[n] = result[n].at[hr].set(
+                            h_result[n].astype(result[n].dtype), mode="drop"
+                        )
                 for j, n in enumerate(fields_out):
                     state[j] = state[j].at[:L].set(result[n].astype(state[j].dtype))
                 return tuple(state)
@@ -1471,6 +1628,8 @@ class Grid:
             mesh=mesh,
             in_specs=(P(), P(axis), P(axis), P(axis),
                       P() if uniform_offs else P(axis), P(axis))
+            + ((P(axis),) if scaled else ())
+            + ((P(axis),) * 4 if split else ())
             + (P(axis),) * (n_static + n_out) + (P(),) * n_extra,
             out_specs=(P(axis),) * n_out,
             check_vma=False,
@@ -1478,7 +1637,10 @@ class Grid:
 
         @jax.jit
         def run(n_steps, *args):
-            return mapped(n_steps, send, recv, nbr_rows, nbr_offs, nbr_mask, *args)
+            pre = (scale_arr,) if scaled else ()
+            pre += (h_rows, h_nrows, h_offs, h_mask) if split else ()
+            return mapped(n_steps, send, recv, nbr_rows, nbr_offs, nbr_mask,
+                          *pre, *args)
 
         return run, static_in
 
@@ -1753,7 +1915,7 @@ class Grid:
             self.mapping,
             self.plan.cells,
             self.plan.owner,
-            self.plan.hoods[DEFAULT_NEIGHBORHOOD_ID].lists,
+            self.neighborhoods[DEFAULT_NEIGHBORHOOD_ID],
             self._refines,
             self._unrefines,
             self._dont_refines,

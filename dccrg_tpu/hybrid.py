@@ -1,0 +1,609 @@
+"""Hybrid structure plan for refined (AMR-carrying) grids.
+
+The generic plan builder is O(total cells) with a large constant: it
+streams ~26 neighbor entries per cell through the engine, dedups,
+inverts and argsorts them even when 99% of the grid sits in uniform
+same-level blocks. The reference's own rebuild is incremental per-cell
+work (dccrg.hpp:10642-10690); this module is the vectorized
+counterpart, built on one observation: **a cell whose whole (symmetric)
+neighborhood consists of same-level leaves resolves closed-form** — at
+any level, not just level 0 — because level-l ids are linear in the
+level-l lattice coordinates (dccrg_mapping.hpp:154-209). Cells are
+classified per level:
+
+- level-0 cells away from any refined slot (box-dilated refined-root
+  lattice) are *far*: tables come from the uniform lattice builder
+  (native dn_uniform_tables / np.roll maps);
+- level-l (l >= 1) cells whose neighbors at every symmetrized offset
+  exist as level-l leaves are *easy*: neighbor positions come from
+  level-l index arithmetic + one binary search per offset;
+- everything else — the shell of cells near a level transition — is
+  *hard* and runs through the generic engine
+  (neighbors.find_neighbors_of), so engine cost scales with the
+  refinement *surface*, not the refined volume, and not the grid.
+
+All three classes merge into the same row layout, ghost sets and
+send/receive lists the generic builder produces. Stencil tables are
+split: far/easy rows share a dense [n_dev, L, k] table whose offsets
+are per-slot constants scaled by a per-row cell size (synthesized on
+device), hard rows get their own compact [n_dev, H, S_hard] tables
+with explicit offsets — a hard cell can hold ~8x more entries (up to 8
+children per refined window) than a uniform-bulk cell, so padding
+every row to the hard width would waste ~8x HBM and gather bandwidth.
+Stencils run the kernel over both tables and merge (grid.py).
+
+The flat host-side entry stream (NeighborLists) and the neighbors_to
+tables are built lazily on first use, as on the uniform fast path.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+
+def _phase_timer():
+    """Phase-boundary logger, enabled with DCCRG_TIMING=1."""
+    if os.environ.get("DCCRG_TIMING") != "1":
+        return lambda label: None
+    state = {"t": time.perf_counter()}
+
+    def mark(label):
+        now = time.perf_counter()
+        print(f"[hybrid] {label}: {now - state['t']:.3f}s", flush=True)
+        state["t"] = now
+
+    return mark
+
+
+def _per_dim_radius(neighborhoods) -> np.ndarray:
+    """Per-dimension max |offset| over all neighborhoods (x, y, z)."""
+    rho = np.zeros(3, dtype=np.int64)
+    for offs in neighborhoods.values():
+        o = np.asarray(offs, dtype=np.int64).reshape(-1, 3)
+        rho = np.maximum(rho, np.abs(o).max(axis=0))
+    return rho
+
+
+def _check_offsets(neighborhoods) -> np.ndarray:
+    """The symmetrized union offset set {+-o} over all neighborhoods.
+
+    Easiness must be symmetric: a cell's to-sources sit at the negated
+    offsets, and a same-level to-source is what lets the lazy
+    neighbors_to tables stay closed-form."""
+    alls = [np.asarray(o, dtype=np.int64).reshape(-1, 3)
+            for o in neighborhoods.values()]
+    cat = np.concatenate(alls + [-a for a in alls])
+    return np.unique(cat, axis=0)
+
+
+class _LevelBlock:
+    """Per-(refinement level >= 1) neighbor-position cache.
+
+    For the contiguous block of level-l cells in the sorted cell list,
+    ``lookup(offset)`` returns ``(pos, valid, exist)``: the position in
+    the cell list of each cell's same-level neighbor at the given
+    cell-unit offset, whether that neighbor slot is inside the grid,
+    and whether it exists as a level-l leaf."""
+
+    def __init__(self, mapping, periodic, cells, level, a, b):
+        self.a, self.b = a, b
+        self.level = level
+        self.cells = cells
+        nx, ny, nz = (int(v) for v in mapping.length.get())
+        self.dims = (nx << level, ny << level, nz << level)
+        self.first = np.int64(mapping._level_first[level])
+        self.size = 1 << (mapping.max_refinement_level - level)
+        self.periodic = periodic
+        lin = (cells[a:b] - np.uint64(self.first)).astype(np.int64)
+        nxl, nyl, nzl = self.dims
+        self.x = lin % nxl
+        self.y = (lin // nxl) % nyl
+        self.z = lin // (nxl * nyl)
+        self._cache = {}
+
+    def lookup(self, off):
+        key = (int(off[0]), int(off[1]), int(off[2]))
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        nxl, nyl, nzl = self.dims
+        xs = self.x + key[0]
+        ys = self.y + key[1]
+        zs = self.z + key[2]
+        valid = np.ones(len(xs), dtype=bool)
+        for arr, nl, per in ((xs, nxl, self.periodic[0]),
+                             (ys, nyl, self.periodic[1]),
+                             (zs, nzl, self.periodic[2])):
+            if per:
+                arr %= nl
+            else:
+                valid &= (arr >= 0) & (arr < nl)
+        nid = (self.first + np.where(valid, xs + nxl * (ys + nyl * zs), 0)
+               ).astype(np.uint64)
+        pos = np.minimum(np.searchsorted(self.cells, nid), len(self.cells) - 1)
+        exist = (self.cells[pos] == nid) & valid
+        out = (pos.astype(np.int64), valid, exist)
+        self._cache[key] = out
+        return out
+
+
+def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev):
+    """All plan pieces for a refined grid.
+
+    Returns ``(layout, hood_data)`` like uniform.build_uniform_plan:
+    layout holds local_ids / ghost_ids / n_local / n_inner / L / R /
+    row_of_pos / scale_rows; hood_data maps hood id -> dict with the
+    split gather tables, a lazy neighbors_to thunk, and the
+    send/receive lists.
+    """
+    from .grid import DEFAULT_NEIGHBORHOOD_ID
+    from .neighbors import find_neighbors_of
+    from .amr import _box_dilate
+    from .uniform import _NeighborMaps
+    from . import native
+
+    mark = _phase_timer()
+
+    dims = tuple(int(v) for v in mapping.length.get())
+    nx, ny, nz = dims
+    n0 = nx * ny * nz
+    if n0 >= 2**31 - 2:
+        raise ValueError(f"hybrid fast path limited to < 2^31 level-0 cells, got {n0}")
+    size0 = 1 << mapping.max_refinement_level
+    periodic = tuple(topology.is_periodic(d) for d in range(3))
+    owner = np.asarray(owner, dtype=np.int32)
+    cells = np.asarray(cells, dtype=np.uint64)
+    n = len(cells)
+
+    # level-major ids: the level-0 subset is exactly the sorted prefix
+    # of ids <= n0 (dccrg_mapping.hpp:154-209)
+    n_lvl0 = int(np.searchsorted(cells, np.uint64(n0), side="right"))
+    lvl0_gidx = cells[:n_lvl0].astype(np.int64) - 1
+    present = np.zeros(n0, dtype=bool)
+    present[lvl0_gidx] = True
+    pos0 = np.full(n0, -1, dtype=np.int64)  # slot -> position in `cells`
+    pos0[lvl0_gidx] = np.arange(n_lvl0)
+
+    # --- level-0 classification: refined slots box-dilated ------------
+    rho = _per_dim_radius(neighborhoods)
+    lat = _box_dilate(
+        (~present).reshape(nz, ny, nx),  # axis0=z, axis1=y, axis2=x
+        (rho[2], rho[1], rho[0]),
+        (periodic[2], periodic[1], periodic[0]),
+    )
+    hard_lat = lat.reshape(-1)
+    far = present & ~hard_lat
+    far_slots = np.nonzero(far)[0]
+    hard0_slots = np.nonzero(present & hard_lat)[0]
+
+    # owner per level-0 slot (refined slots hold garbage, only ever
+    # indexed through far sources whose windows are always present)
+    owner0 = np.zeros(n0, dtype=np.int32)
+    owner0[lvl0_gidx] = owner[:n_lvl0]
+
+    maps = _NeighborMaps(dims, periodic)
+
+    # --- per-level (>= 1) classification: easy vs hard ----------------
+    check_offs = _check_offsets(neighborhoods)
+    blocks = []  # (_LevelBlock, easy bool array over the block)
+    hard_parts = [pos0[hard0_slots]]
+    max_lvl = mapping.max_refinement_level
+    for l in range(1, max_lvl + 1):
+        first = np.uint64(mapping._level_first[l])
+        last = (np.uint64(mapping._level_first[l + 1]) if l < max_lvl
+                else np.uint64(mapping.last_cell) + np.uint64(1))
+        a = int(np.searchsorted(cells, first))
+        b = int(np.searchsorted(cells, last))
+        if a == b:
+            continue
+        blk = _LevelBlock(mapping, periodic, cells, l, a, b)
+        easy = np.ones(b - a, dtype=bool)
+        for off in check_offs:
+            _pos, valid, exist = blk.lookup(off)
+            easy &= exist | ~valid
+        blocks.append((blk, easy))
+        hard_parts.append(a + np.nonzero(~easy)[0])
+
+    hard_pos = np.concatenate(hard_parts)
+    hard_pos.sort(kind="stable")
+    hard_cells = cells[hard_pos]
+    mark(f"classify (hard {len(hard_pos)}/{n})")
+
+    # --- hard streams (generic engine on the hard shell) --------------
+    streams = {}
+    for hid, offs in neighborhoods.items():
+        src, nbr, off, item = find_neighbors_of(
+            mapping, topology, cells, hard_cells, offs
+        )
+        streams[hid] = (
+            hard_pos[src],
+            np.searchsorted(cells, nbr),
+            off.astype(np.int64),
+            item,
+        )
+    mark("hard streams")
+
+    # --- boundary classification + ghost sets -------------------------
+    # every cross-device of-edge (c -> v) makes both endpoints outer
+    # (c via its of-list, v via its to-list) and creates two ghost
+    # reads: device(c) reads v, device(v) reads c. Edges are enumerated
+    # once, at their source's class (far lattice / easy block / hard
+    # stream), which covers the full edge set.
+    outer = np.zeros(n, dtype=bool)
+    ghost_reader = [np.empty(0, np.int32)]
+    ghost_pos = [np.empty(0, np.int64)]
+
+    def note_cross(sp, npos, default):
+        if default:
+            outer[sp] = True
+            outer[npos] = True
+        ghost_reader.append(owner[sp])
+        ghost_pos.append(npos)
+        ghost_reader.append(owner[npos])
+        ghost_pos.append(sp)
+
+    if n_dev > 1:
+        for hid, offs in neighborhoods.items():
+            default = hid == DEFAULT_NEIGHBORHOOD_ID
+            for o in np.asarray(offs, dtype=np.int64).reshape(-1, 3):
+                ng, valid = maps.shift(o)
+                m = far & valid
+                cross = np.nonzero(m & (owner0[ng] != owner0))[0]
+                if len(cross):
+                    note_cross(pos0[cross], pos0[ng[cross]], default)
+                for blk, easy in blocks:
+                    pos_n, _valid, exist = blk.lookup(o)
+                    sel = np.nonzero(
+                        easy & exist & (owner[pos_n] != owner[blk.a:blk.b])
+                    )[0]
+                    if len(sel):
+                        note_cross(blk.a + sel, pos_n[sel], default)
+            s_p, s_n, _, _ = streams[hid]
+            cm = np.nonzero(owner[s_p] != owner[s_n])[0]
+            if len(cm):
+                note_cross(s_p[cm], s_n[cm], default)
+    mark("classification")
+    g_r = np.concatenate(ghost_reader)
+    g_p = np.concatenate(ghost_pos)
+
+    # --- row layout ----------------------------------------------------
+    local_ids, ghost_ids, ghost_pos_sorted = [], [], []
+    n_inner = np.zeros(n_dev, np.int64)
+    for d in range(n_dev):
+        mine = owner == d
+        inner = cells[mine & ~outer]
+        outerc = cells[mine & outer]
+        local_ids.append(np.concatenate([inner, outerc]))
+        n_inner[d] = len(inner)
+        gp = np.unique(g_p[g_r == d])
+        ghost_pos_sorted.append(gp)
+        ghost_ids.append(cells[gp])
+
+    n_local = np.array([len(x) for x in local_ids], dtype=np.int64)
+    n_ghost = np.array([len(x) for x in ghost_ids], dtype=np.int64)
+    L = max(1, int(n_local.max()))
+    G = int(n_ghost.max()) if n_dev > 1 else 0
+    R = L + G + 1  # final row = permanent zero pad
+
+    row_of_pos = np.full(n, -1, dtype=np.int32)
+    for d in range(n_dev):
+        lpos = np.searchsorted(cells, local_ids[d])
+        row_of_pos[lpos] = np.arange(len(local_ids[d]), dtype=np.int32)
+
+    def resolve_rows(pos_arr, dev_arr):
+        """Row of each cell (by position) on the given reader device:
+        local row when the reader owns it, ghost row otherwise."""
+        pos_arr = np.asarray(pos_arr, dtype=np.int64)
+        dev_arr = np.asarray(dev_arr)
+        rows = np.empty(len(pos_arr), dtype=np.int32)
+        loc = owner[pos_arr] == dev_arr
+        rows[loc] = row_of_pos[pos_arr[loc]]
+        rm = np.nonzero(~loc)[0]
+        for d in np.unique(dev_arr[rm]):
+            mm = rm[dev_arr[rm] == d]
+            gps = ghost_pos_sorted[d]
+            gi = np.minimum(np.searchsorted(gps, pos_arr[mm]), max(len(gps) - 1, 0))
+            if len(mm) and (len(gps) == 0 or np.any(gps[gi] != pos_arr[mm])):
+                raise AssertionError(
+                    "ghost coverage bug: neighbor without a row on its "
+                    "reader's device"
+                )
+            rows[mm] = (L + gi).astype(np.int32)
+        return rows
+
+    far_pos = pos0[far_slots]
+    far_dev = owner[far_pos].astype(np.int64)
+    far_rowidx = far_dev * L + row_of_pos[far_pos]
+
+    row_of_pos0 = np.zeros(n0, dtype=np.int32)
+    row_of_pos0[lvl0_gidx] = row_of_pos[:n_lvl0]
+
+    # per-row cell size in index units (far/easy rows; hard rows get
+    # explicit offsets, pad rows never pass a mask)
+    scale_rows = np.zeros(n_dev * L, dtype=np.int32)
+    scale_rows[far_rowidx] = size0
+    easy_rowidx = {}
+    for blk, easy in blocks:
+        ei = np.nonzero(easy)[0]
+        ridx = owner[blk.a + ei].astype(np.int64) * L + row_of_pos[blk.a + ei]
+        easy_rowidx[blk.level] = (ei, ridx)
+        scale_rows[ridx] = blk.size
+    mark("row layout")
+
+    # --- gather tables per hood (split far+easy / hard) ---------------
+    hood_data = {}
+    for hid, offs_in in neighborhoods.items():
+        offs = np.asarray(offs_in, dtype=np.int64).reshape(-1, 3)
+        k = len(offs)
+        s_p, s_n, s_off, s_item = streams[hid]
+        nE = len(s_p)
+
+        rows_t = np.full((n_dev * L, k), R - 1, dtype=np.int32)
+        mask_t = np.zeros((n_dev * L, k), dtype=bool)
+
+        # far rows: closed-form lattice tables (native one-pass builder
+        # when available)
+        nat = native.uniform_tables(
+            dims, periodic, offs, row_of_pos0,
+            owner0 if n_dev > 1 else None, R - 1,
+        )
+        if nat is not None:
+            grows, gmask = nat  # [n0, k] grid order
+            fr = grows[far_slots]
+            fm = gmask[far_slots]
+            ci, cj = np.nonzero(fr < -1)
+            if len(ci):
+                nslot = (-2 - fr[ci, cj]).astype(np.int64)
+                fr[ci, cj] = resolve_rows(pos0[nslot], far_dev[ci])
+            del grows, gmask
+        else:
+            fr = np.empty((len(far_slots), k), dtype=np.int32)
+            fm = np.empty((len(far_slots), k), dtype=bool)
+            for j, o in enumerate(offs):
+                ng, valid = maps.shift(o)
+                vf = valid[far_slots]
+                rows = np.full(len(far_slots), R - 1, dtype=np.int32)
+                vv = np.nonzero(vf)[0]
+                rows[vv] = resolve_rows(
+                    pos0[ng[far_slots][vv]], far_dev[vv]
+                )
+                fr[:, j] = rows
+                fm[:, j] = vf
+        rows_t[far_rowidx] = fr
+        mask_t[far_rowidx] = fm
+        del fr, fm
+
+        # easy rows: level-l index arithmetic, all offsets batched
+        for blk, easy in blocks:
+            ei, ridx = easy_rowidx[blk.level]
+            E = len(ei)
+            if E == 0:
+                continue
+            edev = owner[blk.a + ei].astype(np.int64)
+            posm = np.empty((E, k), dtype=np.int64)
+            validm = np.empty((E, k), dtype=bool)
+            for j, o in enumerate(offs):
+                pos_n, valid, _exist = blk.lookup(o)
+                posm[:, j] = pos_n[ei]
+                validm[:, j] = valid[ei]
+            rows = np.full(E * k, R - 1, dtype=np.int32)
+            vv = np.nonzero(validm.reshape(-1))[0]
+            if len(vv):
+                rows[vv] = resolve_rows(
+                    posm.reshape(-1)[vv], np.repeat(edev, k)[vv]
+                )
+            rows_t[ridx] = rows.reshape(E, k)
+            mask_t[ridx] = validm
+
+        # hard rows: compact per-device tables from the stream
+        hard_rows_dev = hard_nbr_dev = hard_offs_dev = hard_mask_dev = None
+        if nE:
+            # slot = rank within the (contiguous, source-sorted) group
+            changed = np.empty(nE, dtype=bool)
+            changed[0] = True
+            changed[1:] = s_p[1:] != s_p[:-1]
+            gstart = np.maximum.accumulate(np.where(changed, np.arange(nE), 0))
+            slot = np.arange(nE) - gstart
+            S_hard = max(1, int(slot.max()) + 1)
+            hdev = owner[s_p].astype(np.int64)
+            hrow = hdev * L + row_of_pos[s_p]
+            urow, uinv = np.unique(hrow, return_inverse=True)
+            ud = urow // L
+            dev_start = np.searchsorted(ud, np.arange(n_dev))
+            dense_idx = np.arange(len(urow)) - dev_start[ud]
+            counts = np.bincount(ud, minlength=n_dev)
+            Hmax = max(1, int(counts.max()))
+            hard_rows_dev = np.full((n_dev, Hmax), L, dtype=np.int32)  # pad=L: dropped
+            hard_nbr_dev = np.full((n_dev, Hmax, S_hard), R - 1, dtype=np.int32)
+            hard_offs_dev = np.zeros((n_dev, Hmax, S_hard, 3), dtype=np.int32)
+            hard_mask_dev = np.zeros((n_dev, Hmax, S_hard), dtype=bool)
+            hard_rows_dev[ud, dense_idx] = (urow - ud * L).astype(np.int32)
+            e_dev = ud[uinv]
+            e_pos = dense_idx[uinv]
+            hard_nbr_dev[e_dev, e_pos, slot] = resolve_rows(s_n, owner[s_p])
+            hard_offs_dev[e_dev, e_pos, slot] = s_off.astype(np.int32)
+            hard_mask_dev[e_dev, e_pos, slot] = True
+
+        offs_const = offs.astype(np.int32)  # [k, 3], CELL units (x scale_rows)
+
+        def offs_thunk(mask_t=mask_t, offs_const=offs_const, k=k):
+            # far/easy per-slot offsets (hard rows carry theirs in the
+            # compact hard tables; host queries use the engine)
+            out = (mask_t[:, :, None] * offs_const[None, :, :]).astype(np.int32)
+            out *= scale_rows[:, None, None]
+            return out.reshape(n_dev, L, k, 3)
+
+        hood_data[hid] = {
+            "nbr_rows": rows_t.reshape(n_dev, L, k),
+            "nbr_offs": offs_thunk,
+            "offs_const": offs_const,
+            "nbr_mask": mask_t.reshape(n_dev, L, k),
+            "hard_rows": hard_rows_dev,
+            "hard_nbr_rows": hard_nbr_dev,
+            "hard_offs": hard_offs_dev,
+            "hard_mask": hard_mask_dev,
+        }
+        mark(f"tables hood {hid}")
+
+    # --- send / receive lists -----------------------------------------
+    M = 1
+    pair_pos = [[np.empty(0, np.int64)] * n_dev for _ in range(n_dev)]
+    for q in range(n_dev):
+        gp = ghost_pos_sorted[q]
+        if len(gp) == 0:
+            continue
+        gowner = owner[gp]
+        for p in range(n_dev):
+            pair_pos[p][q] = gp[gowner == p]
+            M = max(M, len(pair_pos[p][q]))
+    send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+    recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+    for p in range(n_dev):
+        for q in range(n_dev):
+            pp = pair_pos[p][q]
+            if len(pp) == 0:
+                continue
+            send_rows[p, q, : len(pp)] = row_of_pos[pp]
+            recv_rows[q, p, : len(pp)] = L + np.searchsorted(ghost_pos_sorted[q], pp)
+    for hid in neighborhoods:
+        hood_data[hid]["send_rows"] = send_rows
+        hood_data[hid]["recv_rows"] = recv_rows
+    mark("send/recv lists")
+
+    # --- lazy neighbors_to tables -------------------------------------
+    is_hard_target = np.zeros(n, dtype=bool)
+    is_hard_target[hard_pos] = True
+    lvl_of_pos = np.zeros(n, dtype=np.int64)
+    for blk, _easy in blocks:
+        lvl_of_pos[blk.a:blk.b] = blk.level
+
+    def make_to_thunk(hid, offs_in):
+        offs = np.asarray(offs_in, dtype=np.int64).reshape(-1, 3)
+        k = len(offs)
+
+        def thunk():
+            s_p, s_n, s_off, s_item = streams[hid]
+            # inverted hard entries: keep when the TARGET is hard, or
+            # when source and target levels differ (a same-level source
+            # of a far/easy target is covered closed-form below; a
+            # cross-level source never is)
+            keep = is_hard_target[s_n] | (lvl_of_pos[s_p] != lvl_of_pos[s_n])
+            tv, tc = s_n[keep], s_p[keep]
+            toff = -s_off[keep]
+            titem = s_item[keep]
+            # same-level sources of hard targets that are far/easy
+            # (enumerated from the target side: source at -o exists,
+            # same level, and is not itself hard)
+            ex_v, ex_c, ex_off, ex_item = [], [], [], []
+            if len(hard0_slots):
+                for j, o in enumerate(offs):
+                    ng, valid = maps.shift((-int(o[0]), -int(o[1]), -int(o[2])))
+                    cslot = ng[hard0_slots]
+                    ok = valid[hard0_slots] & far[cslot]
+                    if ok.any():
+                        hs = hard0_slots[ok]
+                        ex_v.append(pos0[hs])
+                        ex_c.append(pos0[cslot[ok]])
+                        ex_off.append(
+                            np.broadcast_to(
+                                (-o * size0).astype(np.int64), (int(ok.sum()), 3)
+                            )
+                        )
+                        ex_item.append(np.full(int(ok.sum()), j, dtype=np.int64))
+            for blk, easy in blocks:
+                hi = np.nonzero(~easy)[0]  # hard level-l targets
+                if len(hi) == 0:
+                    continue
+                src_is_easy = np.zeros(len(cells), dtype=bool)
+                src_is_easy[blk.a + np.nonzero(easy)[0]] = True
+                for j, o in enumerate(offs):
+                    pos_n, valid, exist = blk.lookup((-int(o[0]), -int(o[1]), -int(o[2])))
+                    # source must exist as an easy level-l leaf
+                    src_pos = pos_n[hi]
+                    ok = exist[hi] & src_is_easy[src_pos]
+                    if ok.any():
+                        ex_v.append(blk.a + hi[ok])
+                        ex_c.append(src_pos[ok])
+                        ex_off.append(
+                            np.broadcast_to(
+                                (-o * blk.size).astype(np.int64), (int(ok.sum()), 3)
+                            )
+                        )
+                        ex_item.append(np.full(int(ok.sum()), j, dtype=np.int64))
+            if ex_v:
+                tv = np.concatenate([tv] + ex_v)
+                tc = np.concatenate([tc] + ex_c)
+                toff = np.concatenate([toff] + ex_off)
+                titem = np.concatenate([titem] + ex_item)
+            # compact per target row, ordered by (source pos, item).
+            # Hard target rows have no closed-form slots, so their
+            # entries start at slot 0; far/easy target rows already
+            # hold closed-form same-level entries in slots [0, k), so
+            # their (cross-level) entries start at slot k.
+            order = np.lexsort((titem, tc, tv))
+            tv, tc, toff = tv[order], tc[order], toff[order]
+            nT = len(tv)
+            if nT:
+                changed = np.empty(nT, dtype=bool)
+                changed[0] = True
+                changed[1:] = tv[1:] != tv[:-1]
+                gstart = np.maximum.accumulate(np.where(changed, np.arange(nT), 0))
+                tslot = np.arange(nT) - gstart
+                tslot += np.where(is_hard_target[tv], 0, k)
+                T_hard = int(tslot.max()) + 1
+            else:
+                tslot = np.empty(0, dtype=np.int64)
+                T_hard = 0
+            T = max(k, T_hard, 1)
+            to_rows = np.full((n_dev * L, T), R - 1, dtype=np.int32)
+            to_offs = np.zeros((n_dev * L, T, 3), dtype=np.int32)
+            to_mask = np.zeros((n_dev * L, T), dtype=bool)
+            # far rows: to-neighbor at slot j is the level-0 cell at -o
+            for j, o in enumerate(offs):
+                ng, valid = maps.shift((-int(o[0]), -int(o[1]), -int(o[2])))
+                vf = valid[far_slots]
+                vv = np.nonzero(vf)[0]
+                if len(vv):
+                    rw = resolve_rows(pos0[ng[far_slots][vv]], far_dev[vv])
+                    to_rows[far_rowidx[vv], j] = rw
+                    to_mask[far_rowidx[vv], j] = True
+                    to_offs[far_rowidx[vv], j] = (-o * size0).astype(np.int32)
+            # easy rows: to-neighbor at slot j is the level-l cell at -o
+            for blk, easy in blocks:
+                ei, ridx = easy_rowidx[blk.level]
+                edev = owner[blk.a + ei].astype(np.int64)
+                for j, o in enumerate(offs):
+                    pos_n, valid, exist = blk.lookup((-int(o[0]), -int(o[1]), -int(o[2])))
+                    v = valid[ei]
+                    vv = np.nonzero(v)[0]
+                    if len(vv):
+                        rw = resolve_rows(pos_n[ei[vv]], edev[vv])
+                        to_rows[ridx[vv], j] = rw
+                        to_mask[ridx[vv], j] = True
+                        to_offs[ridx[vv], j] = (-o * blk.size).astype(np.int32)
+            if nT:
+                vdev = owner[tv].astype(np.int64)
+                vrow = vdev * L + row_of_pos[tv]
+                to_rows[vrow, tslot] = resolve_rows(tc, owner[tv])
+                to_mask[vrow, tslot] = True
+                to_offs[vrow, tslot] = toff.astype(np.int32)
+            return (
+                to_rows.reshape(n_dev, L, T),
+                to_offs.reshape(n_dev, L, T, 3),
+                to_mask.reshape(n_dev, L, T),
+            )
+
+        return thunk
+
+    for hid, offs_in in neighborhoods.items():
+        hood_data[hid]["to_thunk"] = make_to_thunk(hid, offs_in)
+
+    layout = dict(
+        local_ids=local_ids, ghost_ids=ghost_ids, n_local=n_local,
+        n_inner=n_inner, L=L, R=R, row_of_pos=row_of_pos,
+        scale_rows=scale_rows.reshape(n_dev, L),
+    )
+    return layout, hood_data

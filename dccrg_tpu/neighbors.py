@@ -324,6 +324,221 @@ def _find_neighbors_of_numpy(
     return src[order], nbr[order], off[order], item[order]
 
 
+def find_neighbors_to_subset(
+    mapping: Mapping,
+    topology: GridTopology,
+    all_cells_sorted: np.ndarray,
+    query_cells: np.ndarray,
+    neighborhood: np.ndarray,
+):
+    """neighbors_to for a SUBSET of cells without building (and
+    inverting) the full neighbors_of stream: for each query cell ``v``,
+    the cells ``c`` with ``v`` in their neighbors_of.
+
+    Direct enumeration: ``v`` is in c's window at item ``o`` iff
+    ``c`` exists as a leaf, levels differ by <= 1, and v's box
+    intersects the window ``[c.base + o*size_c, +size_c)``.
+    (Intersection is sufficient: window resolution — same-level cell,
+    containing coarser cell, or contained finer cells,
+    dccrg.hpp:4744-4897 — then necessarily yields ``v`` because boxes
+    at these sizes are aligned and ``v`` is a leaf.) Candidate window
+    bases are the <= 3-per-dimension size_c-aligned positions
+    overlapping v's box, enumerated per (item, source level).
+
+    Returns ``(src_index, source_id, offset)`` flat arrays where
+    ``src_index`` indexes ``query_cells``, ``offset`` is the recorded
+    to-offset (``-of_offset``), ordered per query cell by (source
+    position, item) — the order produced by inverting the full stream.
+    Exact (source, offset) duplicates from a coarser source covering
+    several windows are collapsed to the lowest item, mirroring
+    _dedup_entries.
+    """
+    query_cells = np.atleast_1d(np.asarray(query_cells, dtype=np.uint64))
+    neighborhood = np.asarray(neighborhood, dtype=np.int64).reshape(-1, 3)
+    m = len(query_cells)
+    empty = (np.empty(0, np.int64), np.empty(0, np.uint64),
+             np.empty((0, 3), np.int64))
+    if m == 0 or len(neighborhood) == 0 or len(all_cells_sorted) == 0:
+        return empty
+
+    index_length = mapping.get_index_length().astype(np.int64)
+    if np.any(index_length >= _MAX_INDEX):
+        raise StructureError("grid index space too large for the vectorized engine")
+    periodic = np.array([topology.is_periodic(d) for d in range(3)])
+
+    v_lvl = mapping.get_refinement_level(query_cells)
+    if np.any(v_lvl < 0):
+        raise ValueError("invalid cell id in query")
+    v_size = (1 << (mapping.max_refinement_level - v_lvl)).astype(np.int64)
+    v_base = mapping.get_indices(query_cells).astype(np.int64)
+
+    exists = lambda ids: all_cells_sorted[
+        np.minimum(np.searchsorted(all_cells_sorted, ids), len(all_cells_sorted) - 1)
+    ] == ids
+
+    # fast path: a query cell is "easy" when every possible to-source
+    # is provably same-level; its to-list is then closed-form (the cell
+    # at -o per item, offset -o*size). Finer sources reach at most the
+    # +-hood slots, so a level-0 cell (no coarser cells exist) is easy
+    # when its same-level neighbor exists at every valid +-offset. A
+    # deeper cell can additionally have a COARSER source out to twice
+    # the hood radius (the source's windows scale with ITS edge
+    # length), so it must pass the same test over the doubled box —
+    # any coarser leaf in that box would cover one of its slots.
+    def same_level_at(off_arr):
+        """(ids, valid, exist) of the same-level cells at v + off*size."""
+        tgt = v_base + off_arr * v_size[:, None]
+        ok = np.ones(m, dtype=bool)
+        wrapped = tgt.copy()
+        for d in range(3):
+            if periodic[d]:
+                wrapped[:, d] = np.mod(tgt[:, d], index_length[d])
+            else:
+                ok &= (tgt[:, d] >= 0) & (tgt[:, d] < index_length[d])
+        ids = mapping.get_cell_from_indices(
+            np.where(ok[:, None], wrapped, 0).astype(np.uint64), v_lvl
+        )
+        return ids, ok, exists(ids) & ok
+
+    # the probe must cover every slot a source's window can originate
+    # from — the FULL box of per-dim radius rho, not just the listed
+    # offsets: for a sparse hood like [[2,0,0]] a finer source's
+    # half-size windows reach the query from the unprobed +-1 slot.
+    rho = np.abs(neighborhood).max(axis=0)
+
+    def box_test(radius_scale, restrict):
+        nonlocal easy
+        box = [np.arange(-radius_scale * r, radius_scale * r + 1, dtype=np.int64)
+               for r in rho]
+        if np.prod([float(len(b)) for b in box]) > 360:
+            easy &= ~restrict  # huge hood: fall back to full enumeration
+            return
+        for ox in box[0]:
+            for oy in box[1]:
+                for oz in box[2]:
+                    if ox == oy == oz == 0:
+                        continue
+                    if not easy[restrict].any():
+                        return
+                    _ids, ok, ex = same_level_at(
+                        np.array([[ox, oy, oz]], dtype=np.int64)
+                    )
+                    easy &= ~(restrict & ~(ex | ~ok))
+
+    easy = np.ones(m, dtype=bool)
+    box_test(1, np.ones(m, dtype=bool))
+    deep = v_lvl > 0
+    if deep.any():
+        # deeper cells: a COARSER source's windows scale with its own
+        # (doubled) edge length, reaching out to twice the hood radius
+        box_test(2, deep)
+    out_q, out_src, out_off, out_item = [], [], [], []
+    if easy.any():
+        for j, o in enumerate(neighborhood):
+            ids, ok, ex = same_level_at(-o[None, :])
+            sel = np.nonzero(easy & ex)[0]
+            if len(sel):
+                out_q.append(sel)
+                out_src.append(ids[sel])
+                out_off.append(-o[None, :] * v_size[sel, None])
+                out_item.append(np.full(len(sel), j, dtype=np.int64))
+    if easy.all():
+        if not out_q:
+            return empty
+        q = np.concatenate(out_q)
+        src = np.concatenate(out_src)
+        off = np.concatenate(out_off)
+        item = np.concatenate(out_item)
+        src_pos = np.searchsorted(all_cells_sorted, src)
+        order = np.lexsort((item, src_pos, q))
+        return q[order], src[order], off[order]
+    for j, o in enumerate(neighborhood):
+        for dlvl in (-1, 0, 1):
+            c_lvl = v_lvl + dlvl
+            # easy queries were answered closed-form above
+            sel = (c_lvl >= 0) & (c_lvl <= mapping.max_refinement_level) & ~easy
+            if not sel.any():
+                continue
+            qi = np.nonzero(sel)[0]
+            sc = (1 << (mapping.max_refinement_level - c_lvl[qi])).astype(np.int64)
+            vb, sv = v_base[qi], v_size[qi]
+            # per-dim aligned window bases overlapping [vb, vb+sv):
+            # w in [vb - sc + 1, vb + sv - 1], w % sc == 0
+            w_lo = -(-(vb - sc[:, None] + 1) // sc[:, None]) * sc[:, None]
+            counts = (vb + sv[:, None] - 1 - w_lo) // sc[:, None] + 1  # [q,3] >= 0
+            cmax = int(counts.max(initial=0))
+            if cmax <= 0:
+                continue
+            # expand the per-dim candidate grids
+            steps = np.arange(cmax, dtype=np.int64)
+            w_d = [w_lo[:, d, None] + steps[None, :] * sc[:, None] for d in range(3)]
+            ok_d = [steps[None, :] < counts[:, d, None] for d in range(3)]
+            # cartesian product via broadcasting: [q, cx, cy, cz]
+            wx = w_d[0][:, :, None, None]
+            wy = w_d[1][:, None, :, None]
+            wz = w_d[2][:, None, None, :]
+            okm = (ok_d[0][:, :, None, None] & ok_d[1][:, None, :, None]
+                   & ok_d[2][:, None, None, :])
+            qq, ix, iy, iz = np.nonzero(okm)
+            if len(qq) == 0:
+                continue
+            w = np.stack([w_d[0][qq, ix], w_d[1][qq, iy], w_d[2][qq, iz]], axis=1)
+            scq = sc[qq]
+            c_base = w - o[None, :] * scq[:, None]  # logical
+            # wrap / validity of the SOURCE cell position
+            ok = np.ones(len(qq), dtype=bool)
+            c_wrapped = c_base.copy()
+            for d in range(3):
+                if periodic[d]:
+                    c_wrapped[:, d] = np.mod(c_base[:, d], index_length[d])
+                else:
+                    ok &= (c_base[:, d] >= 0) & (c_base[:, d] + scq < index_length[d] + 1)
+            # the window itself must be inside the grid for non-periodic
+            for d in range(3):
+                if not periodic[d]:
+                    ok &= (w[:, d] >= 0) & (w[:, d] < index_length[d])
+            if not ok.any():
+                continue
+            qq, w, scq, c_wrapped = qq[ok], w[ok], scq[ok], c_wrapped[ok]
+            cl = c_lvl[qi][qq]
+            c_ids = mapping.get_cell_from_indices(
+                c_wrapped.astype(np.uint64), cl
+            )
+            # source must exist as a leaf (a wrap-around source CAN be
+            # the query cell itself: the stream keeps self entries on
+            # tiny periodic dims)
+            ex = exists(c_ids)
+            if not ex.any():
+                continue
+            qq, w, scq, c_ids = qq[ex], w[ex], scq[ex], c_ids[ex]
+            # recorded of_offset = v.min - c.min in c's logical frame:
+            # v.base - c_base_logical = v.base - (w - o*sc)
+            of_off = v_base[qi][qq] - w + o[None, :] * scq[:, None]
+            out_q.append(qi[qq])
+            out_src.append(c_ids)
+            out_off.append(-of_off)
+            out_item.append(np.full(len(qq), j, dtype=np.int64))
+
+    if not out_q:
+        return empty
+    q = np.concatenate(out_q)
+    src = np.concatenate(out_src)
+    off = np.concatenate(out_off)
+    item = np.concatenate(out_item)
+    # dedup exact (query, source, offset) repeats, keep lowest item
+    key = np.stack([q, src.astype(np.int64), off[:, 0], off[:, 1], off[:, 2]], axis=1)
+    order0 = np.lexsort((item, key[:, 4], key[:, 3], key[:, 2], key[:, 1], key[:, 0]))
+    ks = key[order0]
+    first = np.ones(len(ks), dtype=bool)
+    first[1:] = np.any(ks[1:] != ks[:-1], axis=1)
+    keep = order0[first]
+    q, src, off, item = q[keep], src[keep], off[keep], item[keep]
+    # order per query cell by (source position, item) — stream parity
+    src_pos = np.searchsorted(all_cells_sorted, src)
+    order = np.lexsort((item, src_pos, q))
+    return q[order], src[order], off[order]
+
+
 def build_neighbor_lists(
     mapping: Mapping,
     topology: GridTopology,

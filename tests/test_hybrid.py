@@ -1,0 +1,190 @@
+"""Hybrid (refined-grid) plan construction vs the generic builder:
+same row layout, semantically identical gather tables, identical
+stencil results."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dccrg_tpu import Grid
+from dccrg_tpu.grid import DEFAULT_NEIGHBORHOOD_ID
+
+
+def mesh_of(n):
+    return Mesh(np.array(jax.devices()[:n]), ("dev",))
+
+
+def make_grid(length=(6, 5, 4), periodic=(False, True, False), hood_len=1,
+              n_dev=4, max_ref=2, partition="block", user_hood=None,
+              refine=(1, 2, 3), unrefine=()):
+    g = (
+        Grid(cell_data={"v": jnp.float32})
+        .set_initial_length(length)
+        .set_periodic(*periodic)
+        .set_maximum_refinement_level(max_ref)
+        .set_neighborhood_length(hood_len)
+        .initialize(mesh_of(n_dev), partition=partition)
+    )
+    if user_hood is not None:
+        g.add_neighborhood(42, user_hood)
+    for c in refine:
+        g.refine_completely(c)
+    g.stop_refining()
+    for c in unrefine:
+        g.unrefine_completely(c)
+    if unrefine:
+        g.stop_refining()
+    return g
+
+
+def build_pair(monkeypatch, **kw):
+    """Same refined grid via the hybrid path and the forced generic
+    path."""
+    hybrid = make_grid(**kw)
+    monkeypatch.setenv("DCCRG_FORCE_GENERIC", "1")
+    generic = make_grid(**kw)
+    monkeypatch.delenv("DCCRG_FORCE_GENERIC")
+    return hybrid, generic
+
+
+def entry_sets(g, hid, table="of"):
+    """Per-cell sets of (neighbor id, offset) from the gather tables —
+    the padding-independent content."""
+    plan = g.plan
+    hood = plan.hoods[hid]
+    if table == "of":
+        rows, offs, mask = hood.merged_of_tables(plan.R - 1)
+    else:
+        rows, offs, mask = hood.to_rows, hood.to_offs, hood.to_mask
+    out = {}
+    for d in range(plan.n_dev):
+        ids = np.concatenate([plan.local_ids[d], plan.ghost_ids[d]])
+        for r, cid in enumerate(plan.local_ids[d]):
+            entries = []
+            for s in range(rows.shape[2]):
+                if not mask[d, r, s]:
+                    continue
+                row = rows[d, r, s]
+                nid = ids[row] if row < plan.L else ids[len(plan.local_ids[d]) + row - plan.L]
+                entries.append((int(nid), tuple(int(x) for x in offs[d, r, s])))
+            out[int(cid)] = sorted(entries)
+    return out
+
+
+CONFIGS = [
+    dict(),
+    dict(periodic=(True, True, True), length=(4, 4, 4), refine=(1, 64)),
+    dict(hood_len=0),
+    dict(hood_len=2, length=(5, 5, 5), n_dev=2, refine=(1, 62)),
+    dict(n_dev=1),
+    dict(partition="morton", refine=(1, 2, 9, 17)),
+    dict(user_hood=[[1, 0, 0], [0, -1, 0], [1, 1, 1]]),
+    dict(refine=(1,), unrefine=()),
+    dict(length=(4, 4, 2), refine=(1, 2, 5), unrefine=(33,)),
+]
+
+
+@pytest.mark.parametrize("kw", CONFIGS)
+def test_hybrid_matches_generic(monkeypatch, kw):
+    hybrid, generic = build_pair(monkeypatch, **kw)
+    np.testing.assert_array_equal(hybrid.plan.cells, generic.plan.cells)
+    assert hybrid.plan.L == generic.plan.L
+    assert hybrid.plan.R == generic.plan.R
+    for d in range(hybrid.n_dev):
+        np.testing.assert_array_equal(
+            hybrid.plan.local_ids[d], generic.plan.local_ids[d]
+        )
+        np.testing.assert_array_equal(
+            hybrid.plan.ghost_ids[d], generic.plan.ghost_ids[d]
+        )
+    for hid in hybrid.plan.hoods:
+        hh, hg = hybrid.plan.hoods[hid], generic.plan.hoods[hid]
+        assert entry_sets(hybrid, hid, "of") == entry_sets(generic, hid, "of")
+        assert entry_sets(hybrid, hid, "to") == entry_sets(generic, hid, "to")
+        np.testing.assert_array_equal(hh.send_rows, hg.send_rows)
+        np.testing.assert_array_equal(hh.recv_rows, hg.recv_rows)
+        if hid == DEFAULT_NEIGHBORHOOD_ID:
+            np.testing.assert_array_equal(hh.n_inner, hg.n_inner)
+
+
+def test_hybrid_deep_refinement(monkeypatch):
+    """Two levels of refinement: easy level-1 cells inside the refined
+    block, hard shells at both transitions."""
+    kw = dict(length=(6, 6, 6), max_ref=2,
+              refine=(1, 2, 3, 8, 9, 43, 44))
+    hybrid, generic = build_pair(monkeypatch, **kw)
+    # refine some children too (level-1 -> level-2)
+    for g in (hybrid, generic):
+        lvl1 = g.plan.cells[g.mapping.get_refinement_level(g.plan.cells) == 1]
+        for c in lvl1[:8]:
+            g.refine_completely(c)
+        g.stop_refining()
+    np.testing.assert_array_equal(hybrid.plan.cells, generic.plan.cells)
+    hid = DEFAULT_NEIGHBORHOOD_ID
+    assert entry_sets(hybrid, hid, "of") == entry_sets(generic, hid, "of")
+    assert entry_sets(hybrid, hid, "to") == entry_sets(generic, hid, "to")
+
+
+def test_hybrid_stencil_matches_generic(monkeypatch):
+    """The split-table stencil (far pass + hard pass) must produce the
+    same field values as the generic dense-table stencil."""
+    from dccrg_tpu.models.advection_amr import AmrAdvection
+
+    def run(force_generic):
+        if force_generic:
+            monkeypatch.setenv("DCCRG_FORCE_GENERIC", "1")
+        else:
+            monkeypatch.delenv("DCCRG_FORCE_GENERIC", raising=False)
+        rng = np.random.default_rng(3)
+        app = AmrAdvection(length=(8, 8, 1), max_refinement_level=1,
+                           mesh=mesh_of(4))
+        g = app.grid
+        cells = g.get_cells()
+        for c in cells[:6]:
+            g.refine_completely(c)
+        g.stop_refining()
+        g.assign_children_from_parents(fields=["density"])
+        g.clear_refined_unrefined_data()
+        app._refresh_static()
+        cells = g.get_cells()
+        g.set("density", cells,
+              rng.random(len(cells)).astype(np.float32))
+        g.update_copies_of_remote_neighbors(fields=list(
+            ("vx", "vy", "vz", "lx", "ly", "lz", "ilen", "density")))
+        dt_s = 0.4 * app.max_time_step()
+        app.step(dt_s)
+        app.run_fused(3, dt_s)
+        return g.get("density", g.get_cells())
+
+    got = run(False)
+    want = run(True)
+    np.testing.assert_allclose(got, want, rtol=2e-6, atol=1e-7)
+
+
+def test_to_tables_easy_cell_with_coarser_source(monkeypatch):
+    """A 3x3x3 refined block makes its interior level-1 cells easy while
+    edge cells keep coarser to-sources; the lazy to-tables must carry
+    both the closed-form same-level entries and the cross-level ones
+    (regression: cross-level entries used to overwrite slots [0, k))."""
+    kw = dict(length=(8, 8, 8), max_ref=1, n_dev=2,
+              refine=[1 + x + 8 * y + 64 * z
+                      for x in range(3) for y in range(3) for z in range(3)])
+    hybrid, generic = build_pair(monkeypatch, **kw)
+    hid = DEFAULT_NEIGHBORHOOD_ID
+    assert entry_sets(hybrid, hid, "to") == entry_sets(generic, hid, "to")
+
+
+def test_sparse_user_hood_to_queries(monkeypatch):
+    """Sparse user neighborhood [[2,0,0]]: finer to-sources originate
+    from the unprobed +-1 slot (regression for the subset to-query's
+    easy fast path)."""
+    kw = dict(length=(8, 4, 4), max_ref=1, hood_len=2, n_dev=2,
+              user_hood=[[2, 0, 0]], refine=(4,))
+    hybrid, generic = build_pair(monkeypatch, **kw)
+    for c in hybrid.plan.cells:
+        assert hybrid.get_neighbors_to(c, 42) == generic.get_neighbors_to(c, 42), int(c)
+    assert entry_sets(hybrid, 42, "to") == entry_sets(generic, 42, "to")
+    assert entry_sets(hybrid, 42, "of") == entry_sets(generic, 42, "of")
