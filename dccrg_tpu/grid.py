@@ -810,10 +810,26 @@ class Grid:
 
     def set(self, field: str, ids, values) -> None:
         """Host write of per-cell data (init / tests / boundary setup)."""
+        self.set_many(ids, {field: values})
+
+    def set_many(self, ids, values_by_field, preserve_ghosts=True) -> None:
+        """Host write of several fields for the same cell set in one
+        pass (the row resolution happens once). With
+        ``preserve_ghosts=False`` and ``ids`` covering every cell, the
+        old device arrays are not read back at all — ghost rows read
+        zero until the next halo exchange refreshes them (the pattern
+        of per-epoch static-field initialization)."""
         dev, rows = self._host_rows(ids)
-        host = np.asarray(self.data[field]).copy()
-        host[dev, rows] = values
-        self.data[field] = jnp.asarray(host, device=self._sharding())
+        fresh = (not preserve_ghosts
+                 and len(np.atleast_1d(np.asarray(ids))) == len(self.plan.cells))
+        for name, values in values_by_field.items():
+            shape, dtype = self.fields[name]
+            if fresh:
+                host = np.zeros((self.n_dev, self.plan.R) + shape, dtype=dtype)
+            else:
+                host = np.asarray(self.data[name]).copy()
+            host[dev, rows] = values
+            self.data[name] = jnp.asarray(host, device=self._sharding())
 
     # -- iteration views (dccrg.hpp:7594-7718) -------------------------
 
@@ -1948,20 +1964,59 @@ class Grid:
     def _restructure(self, new_cells, new_owner):
         """Rebuild the plan for a new cell set, carrying over the data
         of surviving cells (the reference's rebuild at
-        dccrg.hpp:10642-10690, with data movement folded in)."""
+        dccrg.hpp:10642-10690, with data movement folded in).
+
+        Data moves entirely on device: each surviving cell's (old dev,
+        old row) -> (new dev, new row) relocation is ONE sharded gather
+        per field (XLA inserts the cross-device collective), instead of
+        pulling every field to host and re-uploading."""
         old_plan = self.plan
-        host = {name: np.asarray(arr) for name, arr in self.data.items()}
-        # old (dev,row) per surviving cell
+        old_R = old_plan.R
         surviving = new_cells[np.isin(new_cells, old_plan.cells)]
         old_dev, old_rows = self._host_rows(surviving)
+        old_flat = old_dev.astype(np.int64) * old_R + old_rows
 
         self._build_plan(new_cells, new_owner)
         new_dev, new_rows = self._host_rows(surviving)
+        new_flat = new_dev.astype(np.int64) * self.plan.R + new_rows
 
-        for name, (shape, dtype) in self.fields.items():
-            arr = np.zeros((self.n_dev, self.plan.R) + shape, dtype=dtype)
-            arr[new_dev, new_rows] = host[name][old_dev, old_rows]
-            self.data[name] = jnp.asarray(arr, device=self._sharding())
+        src = np.full(self.n_dev * self.plan.R, -1, dtype=np.int64)
+        src[new_flat] = old_flat
+        sh = self._sharding()
+        # On accelerators every host round-trip crosses the interconnect
+        # — move data with an on-device gather. On the CPU backend the
+        # "transfer" is a memcpy and the host scatter is cheaper than
+        # compiling a per-epoch-shape gather program.
+        on_accel = self.mesh.devices.flat[0].platform not in ("cpu",)
+        import os as _os
+
+        if on_accel or _os.environ.get("DCCRG_DEVICE_RESTRUCTURE") == "1":
+            src2 = src.reshape(self.n_dev, self.plan.R)
+            src_dev = jax.device_put(jnp.asarray(src2), sh)
+            mask_dev = jax.device_put(jnp.asarray(src2 >= 0), sh)
+            n_dev, R_old = self.n_dev, old_R
+
+            @partial(jax.jit, static_argnums=(3,), out_shardings=sh)
+            def move(old, srcs, mask, n_extra_dims):
+                flat = old.reshape((n_dev * R_old,) + old.shape[2:])
+                g = flat[jnp.clip(srcs, 0)]
+                return jnp.where(mask.reshape(mask.shape + (1,) * n_extra_dims), g, 0)
+
+            for name, (shape, dtype) in self.fields.items():
+                self.data[name] = move(self.data[name], src_dev, mask_dev, len(shape))
+        else:
+            keep = src >= 0
+            srcc = np.clip(src, 0, None)
+            for name, (shape, dtype) in self.fields.items():
+                old_host = np.asarray(self.data[name]).reshape(
+                    (self.n_dev * old_R,) + shape
+                )
+                arr = np.where(
+                    keep.reshape((-1,) + (1,) * len(shape)), old_host[srcc], 0
+                ).astype(dtype, copy=False)
+                self.data[name] = jnp.asarray(
+                    arr.reshape((self.n_dev, self.plan.R) + shape), device=sh
+                )
 
         if self._debug:
             from . import verify as _verify

@@ -159,13 +159,18 @@ class AmrAdvection:
         centers = g.geometry.get_center(cells)
         lengths = g.geometry.get_length(cells)
         v = velocity(centers)
-        for d, name in enumerate(("vx", "vy", "vz")):
-            g.set(name, cells, v[:, d].astype(np.float32))
-        for d, name in enumerate(("lx", "ly", "lz")):
-            g.set(name, cells, lengths[:, d].astype(np.float32))
-        g.set("ilen", cells,
-              g.mapping.get_cell_length_in_indices(cells).astype(np.int32))
-        # ghosts of static fields are valid for the whole epoch
+        # one batched upload: static fields cover every cell, so the
+        # old device arrays are never read back; the exchange below
+        # re-fills the ghost rows for the whole epoch
+        g.set_many(cells, {
+            "vx": v[:, 0].astype(np.float32),
+            "vy": v[:, 1].astype(np.float32),
+            "vz": v[:, 2].astype(np.float32),
+            "lx": lengths[:, 0].astype(np.float32),
+            "ly": lengths[:, 1].astype(np.float32),
+            "lz": lengths[:, 2].astype(np.float32),
+            "ilen": g.mapping.get_cell_length_in_indices(cells).astype(np.int32),
+        }, preserve_ghosts=False)
         g.update_copies_of_remote_neighbors(fields=list(STATIC_FIELDS))
 
     # -- time stepping (2d.cpp:321-343) --------------------------------
