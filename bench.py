@@ -56,41 +56,107 @@ def measure_baseline() -> float:
     return result["single_node_cell_updates_per_sec"]
 
 
-def main() -> None:
-    baseline = measure_baseline()
+GRID_N = int(os.environ.get("BENCH_GRID_N", "256"))
+GRID_STEPS = int(os.environ.get("BENCH_GRID_STEPS", "20"))
 
+
+def bench_pallas(baseline):
+    """The Pallas temporal-blocked fast path at the north-star size."""
     import jax
-    from dccrg_tpu.models.advection import PallasRotationAdvection
+    import jax.numpy as jnp
+    from dccrg_tpu.models.advection import PallasRotationAdvection, analytic_density
+    import numpy as np
 
     solver = PallasRotationAdvection(n=N, nz=NZ)
     dt = 0.5 * solver.max_time_step()
 
-    # warmup / compile
+    # warmup / compile, synced by a forced scalar readback (a device
+    # reduction pulled to host cannot under-report through the tunnel
+    # the way block_until_ready can)
     solver.step(dt)
-    jax.block_until_ready(solver.rho)
+    float(jnp.sum(solver.rho))
 
     t0 = time.perf_counter()
     for _ in range(STEPS):
         solver.step(dt)
-    jax.block_until_ready(solver.rho)
+    checksum = float(jnp.sum(solver.rho))
     elapsed = time.perf_counter() - t0
+    assert np.isfinite(checksum)
 
     n_cells = N * N * NZ
     updates_per_sec = n_cells * STEPS * solver.steps_per_pass / elapsed
+    x = (np.arange(N) + 0.5) / N
+    exact = np.asarray(
+        analytic_density(x[:, None, None], x[None, :, None], solver.time)
+    ) * np.ones((1, 1, NZ))
+    diff = np.asarray(solver.rho, dtype=np.float64) - exact
+    l2 = float(np.sqrt(np.sum(diff**2) * (1.0 / N) ** 2 * (1.0 / NZ)))
+    print(
+        f"pallas: elapsed {elapsed:.3f}s for {STEPS} passes x "
+        f"{solver.steps_per_pass} steps; l2 {l2:.2e}",
+        file=sys.stderr,
+    )
+    return updates_per_sec, l2
+
+
+def bench_grid_path(baseline):
+    """The general Grid runtime (gather tables + fused run_steps) on
+    the same physics — the framework path an AMR user exercises, at
+    max_refinement_level 0 (tests/advection/2d.cpp:327-343)."""
+    from dccrg_tpu.models.advection import GridAdvection
+    import numpy as np
+
+    solver = GridAdvection(n=GRID_N, nz=GRID_N)
+    dt = 0.5 * solver.max_time_step()
+
+    solver.run(1, dt)  # warmup / compile
+    solver.checksum()  # forced scalar readback
+
+    t0 = time.perf_counter()
+    solver.run(GRID_STEPS, dt)
+    checksum = solver.checksum()
+    elapsed = time.perf_counter() - t0
+    assert np.isfinite(checksum)
+
+    n_cells = GRID_N * GRID_N * GRID_N
+    updates_per_sec = n_cells * GRID_STEPS / elapsed
+    l2 = solver.l2_error()
+    print(
+        f"grid path: elapsed {elapsed:.3f}s for {GRID_STEPS} fused steps at "
+        f"{GRID_N}^3; l2 {l2:.2e}",
+        file=sys.stderr,
+    )
+    return updates_per_sec, l2
+
+
+def main() -> None:
+    baseline = measure_baseline()
+
+    import jax
+
+    pallas_ups, pallas_l2 = bench_pallas(baseline)
+    grid_ups, grid_l2 = bench_grid_path(baseline)
+
     print(
         json.dumps(
             {
                 "metric": f"advection 3D {N}^2x{NZ} cell-updates/sec/chip",
-                "value": updates_per_sec,
+                "value": pallas_ups,
                 "unit": "cell-updates/s",
-                "vs_baseline": updates_per_sec / baseline,
+                "vs_baseline": pallas_ups / baseline,
+                "pallas_updates_per_sec": pallas_ups,
+                "pallas_l2_error": pallas_l2,
+                "grid_path_updates_per_sec": grid_ups,
+                "grid_path_size": f"{GRID_N}^3",
+                "grid_path_vs_baseline": grid_ups / baseline,
+                "l2_error": grid_l2,
             }
         )
     )
     # diagnostics on stderr only
     print(
-        f"elapsed {elapsed:.3f}s for {STEPS} steps; baseline {baseline:.3g}/s "
-        f"(single-core x {NODE_CORES}); devices {jax.devices()}",
+        f"baseline {baseline:.3g}/s (single-core x {NODE_CORES}); "
+        f"devices {jax.devices()}",
         file=sys.stderr,
     )
 

@@ -14,16 +14,30 @@ Logical equivalent of the reference's .dc file format
     per-cell payloads
 
 The reference writes with collective MPI-IO file views; here the host
-owns the replicated structure and device data is pulled once and
-written with buffered file I/O (payloads are a single contiguous
-vectorized write, not a per-cell loop). The per-cell payload is the
-concatenation of the grid's fields in sorted-name order — the same
-role as the user's ``get_mpi_datatype()`` serialization boundary
-(sender/receiver = -1 during save/load, dccrg.hpp:1106-1107).
+owns the replicated structure and payloads stream through bounded
+chunks: each chunk is gathered ON DEVICE for the chunk's cells and only
+that slice crosses to the host (save), or is scattered from a memory
+map that pages in on demand (load) — a >=64^3 multi-field grid never
+materializes the full interleaved payload matrix. The per-cell payload
+is the grid's fields in sorted-name order — the same role as the
+user's ``get_mpi_datatype()`` serialization boundary (sender/receiver
+= -1 during save/load, dccrg.hpp:1106-1107).
 
-Restart rebuilds the grid structure with ``load_cells`` (the
-reference's refinement-sweep reconstruction, dccrg.hpp:3669-3738) and
-scatters payloads back to the devices.
+**Restart needs nothing but the file**: :func:`load_grid` (and
+``Grid.from_file``) reconstructs mapping, topology, geometry and the
+AMR cell set from the metadata — the reference's
+``start_loading_grid_data`` (dccrg.hpp:1815-2105: read metadata,
+create_level_0_cells, load_cells refinement sweeps) — then streams the
+payloads in. The legacy :func:`load_grid_data` keeps the
+load-into-prepared-grid API, validating the file against the grid.
+
+**Variable-size payloads** (two-pass, dccrg.hpp:2108-2123 and
+tests/particles/cell.hpp:50-84): a field may be declared variable with
+a count field: ``variable={"pos": "count"}`` stores only the first
+``count`` rows of each cell's ``pos`` buffer. Loading reads the
+fixed-size parts (including the counts) in pass one and the ragged
+payloads in pass two, exactly the reference's size-fields-first
+contract.
 """
 
 from __future__ import annotations
@@ -33,32 +47,51 @@ import struct
 import numpy as np
 
 ENDIAN_MAGIC = 0x1234567890ABCDEF
+CHUNK = 1 << 19  # cells per streamed payload chunk
 
 
-def _payload_spec_of(fields):
-    """(names, itemsize per cell, per-field (name, shape, dtype, nbytes))
-    for a ``{name: (shape, dtype)}`` field spec. The per-cell payload is
-    the fields in sorted-name order — the serialization contract shared
-    by save/load and the standalone dc2vtk converter."""
-    names = sorted(fields)
-    spec = []
+def _payload_spec_of(fields, variable=None):
+    """Split a ``{name: (shape, dtype)}`` field spec into fixed and
+    variable parts.
+
+    Returns ``(fixed_spec, fixed_bytes, var_spec)`` where fixed_spec is
+    [(name, shape, dtype, nbytes)] in sorted-name order, and var_spec
+    is [(name, count_field, row_shape, dtype, row_bytes, capacity)]
+    for fields declared variable (stored truncated to their per-cell
+    count)."""
+    variable = variable or {}
+    fixed, var = [], []
     total = 0
-    for n in names:
+    for n in sorted(fields):
         shape, dtype = fields[n]
-        nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize if shape else np.dtype(dtype).itemsize
-        spec.append((n, tuple(shape), np.dtype(dtype), nbytes))
-        total += nbytes
-    return names, total, spec
+        dtype = np.dtype(dtype)
+        if n in variable:
+            if not shape:
+                raise ValueError(f"variable field {n!r} must have a row axis")
+            row_shape = tuple(shape[1:])
+            row_bytes = int(np.prod(row_shape, dtype=np.int64)) * dtype.itemsize if row_shape else dtype.itemsize
+            var.append((n, variable[n], row_shape, dtype, row_bytes, int(shape[0])))
+        else:
+            nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if shape else dtype.itemsize
+            fixed.append((n, tuple(shape), dtype, nbytes))
+            total += nbytes
+    for n, cf, *_ in var:
+        if cf not in fields or fields[cf][0] != ():
+            raise ValueError(f"count field {cf!r} of {n!r} must be a scalar field")
+        if cf in variable:
+            raise ValueError(f"count field {cf!r} cannot itself be variable")
+    return fixed, total, var
 
 
-def _payload_spec(grid):
-    return _payload_spec_of(grid.fields)
+def _payload_spec(grid, variable=None):
+    return _payload_spec_of(grid.fields, variable)
 
 
-def parse_metadata(data: bytes, header_size: int = 0):
+def parse_metadata(data, header_size: int = 0):
     """Parse a .dc file's metadata block (the format documented above):
     returns (mapping, hood_len, topology, geometry, cells, offsets,
-    payload_start). Shared by load_grid_data and dc_to_vtk."""
+    payload_start). Shared by load paths and dc_to_vtk. ``data`` is a
+    bytes-like (a memory map works)."""
     from .geometry import geometry_from_bytes
     from .mapping import Mapping
     from .topology import GridTopology
@@ -71,15 +104,15 @@ def parse_metadata(data: bytes, header_size: int = 0):
             f"bad endianness magic {magic:#x}: file written on an "
             "incompatible architecture or wrong header_size"
         )
-    mapping = Mapping.from_bytes(data[pos : pos + 28])
+    mapping = Mapping.from_bytes(bytes(data[pos : pos + 28]))
     pos += 28
     (hood_len,) = struct.unpack_from("<I", data, pos)
     pos += 4
-    topology = GridTopology.from_bytes(data[pos : pos + 3])
+    topology = GridTopology.from_bytes(bytes(data[pos : pos + 3]))
     pos += 3
     (geom_len,) = struct.unpack_from("<I", data, pos)
     pos += 4
-    geometry = geometry_from_bytes(data[pos : pos + geom_len], mapping, topology)
+    geometry = geometry_from_bytes(bytes(data[pos : pos + geom_len]), mapping, topology)
     pos += geom_len
     (n_cells,) = struct.unpack_from("<Q", data, pos)
     pos += 8
@@ -89,10 +122,27 @@ def parse_metadata(data: bytes, header_size: int = 0):
     return mapping, hood_len, topology, geometry, cells, offsets, pos + 16 * n_cells
 
 
-def save_grid_data(grid, filename: str, header: bytes = b"") -> None:
-    """Write the grid and all cell data (dccrg.hpp:1109-1736)."""
+def _chunk_payload(grid, ids, fixed_spec, cell_bytes):
+    """The interleaved fixed-field payload for one chunk of cells,
+    gathered on device so only the chunk crosses to the host."""
+    dev, rows = grid._host_rows(ids)
+    payload = np.empty((len(ids), cell_bytes), dtype=np.uint8)
+    col = 0
+    for name, shape, dtype, nbytes in fixed_spec:
+        vals = np.ascontiguousarray(np.asarray(grid.data[name][dev, rows]))
+        payload[:, col : col + nbytes] = vals.reshape(len(ids), -1).view(np.uint8)
+        col += nbytes
+    return payload
+
+
+def save_grid_data(grid, filename: str, header: bytes = b"",
+                   variable=None) -> None:
+    """Write the grid and all cell data (dccrg.hpp:1109-1736), payloads
+    streamed in bounded chunks. ``variable={"field": "count_field"}``
+    stores that field truncated to each cell's count (two-pass loadable
+    ragged payloads, dccrg.hpp:2108-2123)."""
     cells = grid.get_cells()
-    names, cell_bytes, spec = _payload_spec(grid)
+    fixed_spec, fixed_bytes, var_spec = _payload_spec(grid, variable)
 
     meta = bytearray()
     meta += header
@@ -104,16 +154,20 @@ def save_grid_data(grid, filename: str, header: bytes = b"") -> None:
     meta += struct.pack("<I", len(geom)) + geom
     meta += struct.pack("<Q", len(cells))
 
-    offset0 = len(meta) + 16 * len(cells)
-    offsets = offset0 + np.arange(len(cells), dtype=np.uint64) * np.uint64(cell_bytes)
+    # per-cell byte sizes (variable fields contribute count * row)
+    sizes = np.full(len(cells), fixed_bytes, dtype=np.uint64)
+    counts = {}
+    for name, count_field, row_shape, dtype, row_bytes, cap in var_spec:
+        c = grid.get(count_field, cells).astype(np.int64)
+        if np.any(c < 0) or np.any(c > cap):
+            raise ValueError(f"count field {count_field!r} out of range for {name!r}")
+        counts[name] = c
+        sizes += (c * row_bytes).astype(np.uint64)
 
-    # payload matrix [n_cells, cell_bytes]: fields in sorted-name order
-    payload = np.empty((len(cells), cell_bytes), dtype=np.uint8)
-    col = 0
-    for name, shape, dtype, nbytes in spec:
-        vals = np.ascontiguousarray(grid.get(name, cells))
-        payload[:, col : col + nbytes] = vals.reshape(len(cells), -1).view(np.uint8)
-        col += nbytes
+    offset0 = len(meta) + 16 * len(cells)
+    offsets = offset0 + np.concatenate(
+        [[np.uint64(0)], np.cumsum(sizes)[:-1]]
+    ).astype(np.uint64)
 
     with open(filename, "wb") as f:
         f.write(bytes(meta))
@@ -121,25 +175,28 @@ def save_grid_data(grid, filename: str, header: bytes = b"") -> None:
         pairs[:, 0] = cells
         pairs[:, 1] = offsets
         f.write(pairs.tobytes())
-        f.write(payload.tobytes())
+        for start in range(0, len(cells), CHUNK):
+            ids = cells[start : start + CHUNK]
+            fixed = _chunk_payload(grid, ids, fixed_spec, fixed_bytes)
+            if not var_spec:
+                f.write(fixed.tobytes())
+                continue
+            # interleave fixed part and ragged variable rows per cell
+            dev, rows = grid._host_rows(ids)
+            var_host = {
+                name: np.ascontiguousarray(np.asarray(grid.data[name][dev, rows]))
+                for name, *_ in var_spec
+            }
+            out = bytearray()
+            for i in range(len(ids)):
+                out += fixed[i].tobytes()
+                for name, count_field, row_shape, dtype, row_bytes, cap in var_spec:
+                    c = int(counts[name][start + i])
+                    out += var_host[name][i, :c].tobytes()
+            f.write(bytes(out))
 
 
-def load_grid_data(grid, filename: str, header_size: int = 0) -> bytes:
-    """Rebuild structure and data from a file written by
-    save_grid_data (dccrg.hpp:1762-2426). Returns the user header.
-
-    The grid must be constructed with the same field spec; its length /
-    refinement / periodicity / geometry are validated against the file
-    (the reference re-creates them from the file; we assert parity so a
-    mismatched restart fails loudly rather than corrupting)."""
-    with open(filename, "rb") as f:
-        data = f.read()
-
-    header = data[:header_size]
-    mapping, hood_len, topology, geometry, cells, offsets, _ = parse_metadata(
-        data, header_size
-    )
-
+def _grid_skeleton_matches(grid, mapping, hood_len, topology, geometry):
     if mapping != grid.mapping:
         raise ValueError(f"file grid {mapping} does not match {grid.mapping}")
     if topology != grid.topology:
@@ -156,17 +213,114 @@ def load_grid_data(grid, filename: str, header_size: int = 0) -> bytes:
             "different start/cell lengths or coordinate arrays)"
         )
 
-    names, cell_bytes, spec = _payload_spec(grid)
-    grid.load_cells(cells)
 
-    # vectorized gather of all payloads (offsets are contiguous as
-    # written, but honor them individually for format fidelity)
-    raw = np.frombuffer(data, dtype=np.uint8)
-    idx = offsets[:, None].astype(np.int64) + np.arange(cell_bytes, dtype=np.int64)[None, :]
-    payload = raw[idx]
-    col = 0
-    for name, shape, dtype, nbytes in spec:
-        vals = payload[:, col : col + nbytes].copy().view(dtype).reshape((len(cells),) + shape)
-        grid.set(name, cells, vals)
-        col += nbytes
+def _scatter_payloads(grid, raw, cells, offsets, fixed_spec, fixed_bytes,
+                      var_spec):
+    """Stream payloads from ``raw`` (memory map) into fresh device
+    arrays. Two passes when variable fields exist: fixed parts (incl.
+    counts) first, then the ragged rows (dccrg.hpp:2108-2123)."""
+    import jax.numpy as jnp
+
+    hosts = {}
+    for name, (shape, dtype) in grid.fields.items():
+        hosts[name] = np.zeros((grid.n_dev, grid.plan.R) + shape, dtype=dtype)
+
+    # pass 1: fixed-size parts at each cell's offset
+    for start in range(0, len(cells), CHUNK):
+        ids = cells[start : start + CHUNK]
+        offs = offsets[start : start + CHUNK].astype(np.int64)
+        dev, rows = grid._host_rows(ids)
+        idx = offs[:, None] + np.arange(fixed_bytes, dtype=np.int64)[None, :]
+        payload = raw[idx]
+        col = 0
+        for name, shape, dtype, nbytes in fixed_spec:
+            vals = payload[:, col : col + nbytes].copy().view(dtype).reshape(
+                (len(ids),) + shape
+            )
+            hosts[name][dev, rows] = vals
+            col += nbytes
+
+    # pass 2: ragged rows, sized by the counts read in pass 1
+    for name, count_field, row_shape, dtype, row_bytes, cap in var_spec:
+        for start in range(0, len(cells), CHUNK):
+            ids = cells[start : start + CHUNK]
+            offs = offsets[start : start + CHUNK].astype(np.int64)
+            dev, rows = grid._host_rows(ids)
+            c = hosts[count_field][dev, rows].astype(np.int64)
+            if np.any(c < 0) or np.any(c > cap):
+                raise ValueError(
+                    f"corrupt counts for variable field {name!r} in file"
+                )
+            # variable fields follow the fixed block; earlier variable
+            # fields (sorted order) of the same cell come first
+            base = offs + fixed_bytes
+            for vn, vcf, _rs, _dt, vrb, _cap in var_spec:
+                if vn == name:
+                    break
+                base = base + hosts[vcf][dev, rows].astype(np.int64) * vrb
+            for i in range(len(ids)):
+                ci = int(c[i])
+                if ci == 0:
+                    continue
+                b = int(base[i])
+                vals = np.frombuffer(
+                    raw[b : b + ci * row_bytes], dtype=dtype
+                ).reshape((ci,) + row_shape)
+                hosts[name][dev[i], rows[i], :ci] = vals
+
+    for name in grid.fields:
+        grid.data[name] = jnp.asarray(hosts[name], device=grid._sharding())
+
+
+def load_grid_data(grid, filename: str, header_size: int = 0,
+                   variable=None) -> bytes:
+    """Rebuild structure and data from a file written by
+    save_grid_data into an ALREADY-CONSTRUCTED grid whose parameters
+    are validated against the file (a mismatched restart fails loudly
+    rather than corrupting). Returns the user header. For restart from
+    nothing but the file, use :func:`load_grid` / ``Grid.from_file``."""
+    raw = np.memmap(filename, dtype=np.uint8, mode="r")
+    header = bytes(raw[:header_size])
+    mapping, hood_len, topology, geometry, cells, offsets, _ = parse_metadata(
+        raw, header_size
+    )
+    _grid_skeleton_matches(grid, mapping, hood_len, topology, geometry)
+    fixed_spec, fixed_bytes, var_spec = _payload_spec(grid, variable)
+    grid.load_cells(cells)
+    _scatter_payloads(grid, raw, cells, offsets, fixed_spec, fixed_bytes, var_spec)
     return header
+
+
+def load_grid(filename: str, cell_data, mesh=None, header_size: int = 0,
+              variable=None, load_balancing_method: str | None = None):
+    """Restart from nothing but the file: reconstruct mapping,
+    topology, geometry, neighborhood length and the AMR cell set from
+    the metadata (the reference's start_loading_grid_data,
+    dccrg.hpp:1815-2105), partition the cells, stream the payloads in.
+
+    ``cell_data`` is the field spec (the user's side of the
+    serialization contract, as with the reference's Cell_Data type);
+    returns ``(grid, header)``."""
+    from .grid import Grid
+
+    raw = np.memmap(filename, dtype=np.uint8, mode="r")
+    header = bytes(raw[:header_size])
+    mapping, hood_len, topology, geometry, cells, offsets, _ = parse_metadata(
+        raw, header_size
+    )
+    kind, params = geometry.spec()
+    grid = (
+        Grid(cell_data=cell_data)
+        .set_initial_length(tuple(int(v) for v in mapping.length.get()))
+        .set_maximum_refinement_level(mapping.max_refinement_level)
+        .set_periodic(*(topology.is_periodic(d) for d in range(3)))
+        .set_neighborhood_length(hood_len)
+        .set_geometry(kind, **params)
+    )
+    if load_balancing_method is not None:
+        grid.set_load_balancing_method(load_balancing_method)
+    grid.initialize(mesh)
+    fixed_spec, fixed_bytes, var_spec = _payload_spec(grid, variable)
+    grid.load_cells(cells)
+    _scatter_payloads(grid, raw, cells, offsets, fixed_spec, fixed_bytes, var_spec)
+    return grid, header

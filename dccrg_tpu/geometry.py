@@ -244,6 +244,10 @@ class NoGeometry(_GeometryBase):
     def to_bytes(self) -> bytes:
         return struct.pack("<i", self.geometry_id)
 
+    def spec(self):
+        """(kind, params) for Grid.set_geometry / reconstruction."""
+        return "none", {}
+
 
 class CartesianGeometry(_GeometryBase):
     """Uniform cuboid cells: ``start`` corner + ``level_0_cell_length``.
@@ -314,6 +318,13 @@ class CartesianGeometry(_GeometryBase):
     def to_bytes(self) -> bytes:
         return struct.pack("<i", self.geometry_id) + self.start.tobytes() + self.level_0_cell_length.tobytes()
 
+    def spec(self):
+        """(kind, params) for Grid.set_geometry / reconstruction."""
+        return "cartesian", {
+            "start": tuple(float(v) for v in self.start),
+            "level_0_cell_length": tuple(float(v) for v in self.level_0_cell_length),
+        }
+
 
 class StretchedCartesianGeometry(_GeometryBase):
     """Per-dimension monotone coordinate arrays: dimension d has
@@ -364,6 +375,10 @@ class StretchedCartesianGeometry(_GeometryBase):
         for d in range(3):
             out.append(self.coordinates[d].tobytes())
         return b"".join(out)
+
+    def spec(self):
+        """(kind, params) for Grid.set_geometry / reconstruction."""
+        return "stretched", {"coordinates": [c.copy() for c in self.coordinates]}
 
 
 def geometry_from_bytes(data: bytes, mapping: Mapping, topology: GridTopology):

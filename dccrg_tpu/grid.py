@@ -2093,15 +2093,29 @@ class Grid:
 
     # -- checkpoint / restart (dccrg.hpp:1109-2426) --------------------
 
-    def save_grid_data(self, filename: str, header: bytes = b"") -> None:
+    def save_grid_data(self, filename: str, header: bytes = b"",
+                       variable=None) -> None:
         from .checkpoint import save_grid_data
 
-        save_grid_data(self, filename, header)
+        save_grid_data(self, filename, header, variable=variable)
 
-    def load_grid_data(self, filename: str, header_size: int = 0) -> bytes:
+    def load_grid_data(self, filename: str, header_size: int = 0,
+                       variable=None) -> bytes:
         from .checkpoint import load_grid_data
 
-        return load_grid_data(self, filename, header_size)
+        return load_grid_data(self, filename, header_size, variable=variable)
+
+    @classmethod
+    def from_file(cls, filename: str, cell_data, mesh: Mesh | None = None,
+                  header_size: int = 0, variable=None):
+        """Restart from nothing but a .dc file: reconstructs mapping,
+        topology, geometry and the AMR cell set from the file metadata
+        (the reference's load_grid_data, dccrg.hpp:1815-2105), then
+        streams the payloads. Returns ``(grid, header)``."""
+        from .checkpoint import load_grid
+
+        return load_grid(filename, cell_data, mesh=mesh,
+                         header_size=header_size, variable=variable)
 
     # -- misc parity ---------------------------------------------------
 

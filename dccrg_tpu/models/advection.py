@@ -88,6 +88,105 @@ class PallasRotationAdvection:
         return float(dt)
 
 
+def make_uniform_flux_kernel(cell_length):
+    """Upwind flux kernel for the general-Grid gather path on a uniform
+    (max_refinement_level=0) grid with in-plane velocities: same math
+    as AdvectionSolver._kernel (solve.hpp:44-279) expressed over
+    face-neighbor gather tables (offsets in index units, cell size 1)."""
+    inv = [1.0 / float(cell_length[d]) for d in range(3)]
+
+    def kernel(cell, nbr, offs, mask, dt):
+        rho_c = cell["density"][:, None]
+        rho_n = nbr["density"]
+        acc = jnp.zeros_like(rho_n)
+        for d, vname in ((0, "vx"), (1, "vy")):
+            v = 0.5 * (cell[vname][:, None] + nbr[vname])
+            up_pos = jnp.where(v >= 0, rho_c, rho_n)
+            up_neg = jnp.where(v >= 0, rho_n, rho_c)
+            face_pos = mask & (offs[..., d] == 1)
+            face_neg = mask & (offs[..., d] == -1)
+            m = v * (dt * inv[d])
+            acc = acc - jnp.where(face_pos, up_pos * m, 0.0)
+            acc = acc + jnp.where(face_neg, up_neg * m, 0.0)
+        return {"density": cell["density"] + jnp.sum(acc, axis=1)}
+
+    return kernel
+
+
+class GridAdvection:
+    """The north-star benchmark on the general ``Grid`` runtime: the
+    same solid-body-rotation advection as AdvectionSolver, but running
+    through the framework's gather tables and the fused
+    ``Grid.run_steps`` loop (exchange + stencil + apply per step inside
+    one XLA program) instead of the dense fast path. Face-neighbor
+    neighborhood (set_neighborhood_length(0), dccrg.hpp:8015-8076)."""
+
+    def __init__(self, n=256, nz=None, mesh=None, cfl=0.5):
+        from ..grid import Grid
+
+        nz = nz if nz is not None else n
+        self.n, self.nz, self.cfl = n, nz, cfl
+        dx = 1.0 / n
+        self.dx = dx
+        self.grid = (
+            Grid(cell_data={"density": jnp.float32, "vx": jnp.float32,
+                            "vy": jnp.float32})
+            .set_initial_length((n, n, nz))
+            .set_periodic(True, True, False)
+            .set_maximum_refinement_level(0)
+            .set_neighborhood_length(0)
+            .set_geometry("cartesian", start=(0.0, 0.0, 0.0),
+                          level_0_cell_length=(dx, dx, 1.0 / nz))
+            .initialize(mesh)
+        )
+        cells = self.grid.plan.cells
+        centers = self.grid.geometry.get_center(cells)
+        x, y = centers[:, 0], centers[:, 1]
+        self._xy = (x, y)
+        self.grid.set_many(cells, {
+            "density": np.asarray(hump_density(x, y), dtype=np.float32),
+            "vx": (0.5 - y).astype(np.float32),
+            "vy": (x - 0.5).astype(np.float32),
+        }, preserve_ghosts=False)
+        self.grid.update_copies_of_remote_neighbors()
+        self._kernel = make_uniform_flux_kernel((dx, dx, 1.0 / nz))
+        self.time = 0.0
+
+    def max_time_step(self) -> float:
+        x, y = self._xy
+        vmax = max(np.abs(0.5 - y).max(), np.abs(x - 0.5).max())
+        return self.dx / float(vmax)
+
+    def run(self, n_steps: int, dt: float | None = None) -> float:
+        if dt is None:
+            dt = self.cfl * self.max_time_step()
+        self.grid.run_steps(
+            self._kernel, ["density", "vx", "vy"], ["density"], n_steps,
+            extra_args=(jnp.float32(dt),),
+        )
+        self.time += n_steps * dt
+        return dt
+
+    def density(self) -> np.ndarray:
+        return self.grid.get("density", self.grid.plan.cells)
+
+    def checksum(self) -> float:
+        """Forced scalar readback: sums the sharded density on device
+        and pulls ONE scalar — a synchronization point that cannot
+        under-report elapsed time the way block_until_ready can when
+        dispatch is remote."""
+        return float(jnp.sum(self.grid.data["density"]))
+
+    def l2_error(self) -> float:
+        """L2 error vs the rotated analytic hump (BASELINE.json's
+        parity metric; same math as AdvectionSolver.l2_error)."""
+        x, y = self._xy
+        exact = np.asarray(analytic_density(x, y, self.time))
+        diff = self.density().astype(np.float64) - exact
+        vol = self.dx * self.dx * (1.0 / self.nz)
+        return float(np.sqrt(np.sum(diff**2) * vol))
+
+
 class AdvectionSolver:
     """Dense-path advection on [0,1]^3.
 

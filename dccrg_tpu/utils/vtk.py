@@ -86,7 +86,7 @@ def dc_to_vtk(dc_filename: str, vtk_filename: str, fields,
 
     _, _, _, geometry, cells, offsets, _ = parse_metadata(data, header_size)
     offsets = offsets.astype(np.int64)
-    _, _, spec = _payload_spec_of(fields)
+    spec, _, _ = _payload_spec_of(fields)
 
     # gather only the scalar columns (skip vector fields the converter
     # doesn't plot) — avoids materializing the full payload matrix
