@@ -290,6 +290,7 @@ class Grid:
         self._removed_data = {}
         self._new_cells = np.empty(0, np.uint64)
         # load balancing state
+        self._staged_balance = {}
         self._pins = {}
         self._weights = {}
         self._partitioning_options = {}
@@ -1714,6 +1715,7 @@ class Grid:
         pins with Zoltan output (dccrg.hpp:8552-8576)."""
         if getattr(self, "_pending_owner", None) is not None:
             raise RuntimeError("balance_load already initialized")
+        self._staged_balance = {}
         cells = self.plan.cells
         if use_zoltan:
             weights = None
@@ -1742,22 +1744,59 @@ class Grid:
                     new_owner[pos] = dest
         self._pending_owner = new_owner
 
-    def continue_balance_load(self) -> None:
-        """Stage 2: transfer cell data (dccrg.hpp:3932-3964). Callable
-        repeatedly, as the reference allows for multi-stage transfers
-        of ragged payloads; data movement is folded into the final
-        restructure, so this stage is a checkpointable no-op."""
+    def continue_balance_load(self, fields=None) -> None:
+        """Stage 2: transfer the data of cells that change owner, for
+        the given field group (dccrg.hpp:3932-3964). Callable
+        repeatedly with different ``fields`` — the reference's
+        multi-stage protocol for ragged payloads
+        (tests/load_balancing/multi_stage_load_balancing.cpp): a field
+        group captured here is what arrives at the destination at
+        finish_balance_load, even if the source data (or another
+        field's capacity) changes between stages. Fields never staged
+        by any continue call move atomically at finish."""
         if getattr(self, "_pending_owner", None) is None:
             raise RuntimeError("initialize_balance_load not called")
+        moving = self.plan.cells[self._pending_owner != self.plan.owner]
+        names = list(fields) if fields is not None else list(self.fields)
+        for n in names:
+            if n not in self.fields:
+                raise KeyError(f"unknown field {n!r}")
+            self._staged_balance[n] = (
+                moving.copy(), self.get(n, moving) if len(moving) else None
+            )
+
+    def staged_balance_data(self, field: str):
+        """(moving cell ids, values) captured by continue_balance_load
+        for a field — the receiver-side peek between stages (the
+        reference's receivers see arrived data in their cell_data
+        before finish)."""
+        ids, vals = self._staged_balance[field]
+        return ids.copy(), (None if vals is None else vals.copy())
 
     def finish_balance_load(self) -> None:
-        """Stage 3: install the new partition and rebuild all derived
-        structure (dccrg.hpp:3980-4182)."""
+        """Stage 3: install the new partition, rebuild all derived
+        structure (dccrg.hpp:3980-4182), and land the staged field
+        groups at their destinations."""
         new_owner = getattr(self, "_pending_owner", None)
         if new_owner is None:
             raise RuntimeError("initialize_balance_load not called")
         self._pending_owner = None
+        staged = self._staged_balance
+        self._staged_balance = {}
         self._restructure(self.plan.cells.copy(), new_owner)
+        for n, (ids, vals) in staged.items():
+            if vals is None or n not in self.fields:
+                continue
+            shape = self.fields[n][0]
+            if vals.shape[1:] != shape:
+                # a stage in between grew/shrank the field (the
+                # particles resize-by-count flow): pad or truncate the
+                # staged rows to the current capacity
+                fixed = np.zeros((len(ids),) + shape, dtype=vals.dtype)
+                sl = tuple(slice(0, min(a, b)) for a, b in zip(vals.shape[1:], shape))
+                fixed[(slice(None),) + sl] = vals[(slice(None),) + sl]
+                vals = fixed
+            self.set(n, ids, vals)
 
     # pinning (dccrg.hpp:5913-6139)
 

@@ -294,3 +294,56 @@ def test_variable_size_payload_roundtrip(tmp_path):
     for i, c in enumerate(counts):
         np.testing.assert_array_equal(got[i, :c], pos[i, :c])
         assert not got[i, c:].any()  # padding restored as zeros
+
+
+def test_multi_stage_balance_moves_staged_values():
+    """Data captured at continue_balance_load time is what lands at the
+    destination — later source mutations must NOT leak through (the
+    reference transfers at continue, dccrg.hpp:3932-3964)."""
+    g = make_grid((8, 1, 1), n_dev=4, cell_data={"a": jnp.float32,
+                                                 "b": jnp.float32})
+    cells = g.get_cells()
+    g.set("a", cells, np.arange(8, dtype=np.float32))
+    g.set("b", cells, 10 + np.arange(8, dtype=np.float32))
+    # force moves via pins
+    for c in cells:
+        g.pin(int(c), (g.get_process(int(c)) + 1) % 4)
+    g.initialize_balance_load(use_zoltan=False)
+    g.continue_balance_load(fields=["a"])
+    ids, vals = g.staged_balance_data("a")
+    assert len(ids) == 8 and vals is not None
+    # mutate the source AFTER staging: must not affect what arrives
+    g.set("a", cells, np.full(8, -99, dtype=np.float32))
+    g.set("b", cells, np.full(8, -77, dtype=np.float32))
+    g.continue_balance_load(fields=["b"])  # b staged with the new values
+    g.finish_balance_load()
+    np.testing.assert_array_equal(g.get("a", cells), np.arange(8, dtype=np.float32))
+    np.testing.assert_array_equal(g.get("b", cells), np.full(8, -77, np.float32))
+
+
+def test_multi_stage_balance_with_capacity_growth():
+    """The particles flow (tests/particles/cell.hpp:50-84): stage the
+    counts, grow the buffer capacity based on them, stage the payload
+    — the staged rows land padded to the new capacity."""
+    from dccrg_tpu.models.particles import ParticleModel
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dev",))
+    pm = ParticleModel(lambda p: np.zeros_like(p), length=(4, 1, 1),
+                       capacity=2, mesh=mesh)
+    g = pm.grid
+    # NoGeometry: unit cells, domain [0,4)x[0,1)x[0,1)
+    pts = np.array([[0.1, 0.5, 0.5], [0.15, 0.5, 0.5], [2.6, 0.5, 0.5]])
+    pm.add_particles(pts)
+    cells = g.get_cells()
+    for c in cells:
+        g.pin(int(c), (g.get_process(int(c)) + 1) % 4)
+    g.initialize_balance_load(use_zoltan=False)
+    g.continue_balance_load(fields=["count"])
+    ids, counts = g.staged_balance_data("count")
+    assert counts.sum() == 3
+    pm.ensure_capacity(8)  # receiver-driven resize between stages
+    g.continue_balance_load(fields=["pos"])
+    g.finish_balance_load()
+    assert pm.counts().sum() == 3
+    got = np.sort(pm.particles(), axis=0)
+    np.testing.assert_allclose(got, np.sort(pts, axis=0), atol=1e-6)
