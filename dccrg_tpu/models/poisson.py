@@ -53,15 +53,27 @@ POISSON_NEIGHBORHOOD_ID = 0xB01550
 # cell_type values (poisson_solve.hpp:143-149)
 SOLVE_CELL, BOUNDARY_CELL, SKIP_CELL = 1, 0, -1
 
-POISSON_FIELDS = {
-    "rhs": jnp.float32, "solution": jnp.float32,
-    "r0": jnp.float32, "r1": jnp.float32,
-    "p0": jnp.float32, "p1": jnp.float32, "Ap0": jnp.float32,
-    "fxp": jnp.float32, "fxn": jnp.float32,
-    "fyp": jnp.float32, "fyn": jnp.float32,
-    "fzp": jnp.float32, "fzn": jnp.float32,
-    "scale": jnp.float32, "ctype": jnp.int32, "ilen": jnp.int32,
-}
+def poisson_fields(dtype=jnp.float32):
+    """The solver's field spec at a given float width. The reference
+    solver is double-precision throughout (poisson_solve.hpp:47-141);
+    ``poisson_fields(jnp.float64)`` is the parity mode (CPU: tests run
+    with JAX_ENABLE_X64). TPU runs use float32: expect the residual
+    floor near 1e-6 relative instead of 1e-12 — see
+    tests/test_poisson.py::test_f64_parity_mode for the measured
+    budget."""
+    f = jnp.dtype(dtype)
+    return {
+        "rhs": f, "solution": f,
+        "r0": f, "r1": f,
+        "p0": f, "p1": f, "Ap0": f,
+        "fxp": f, "fxn": f,
+        "fyp": f, "fyn": f,
+        "fzp": f, "fzn": f,
+        "scale": f, "ctype": jnp.int32, "ilen": jnp.int32,
+    }
+
+
+POISSON_FIELDS = poisson_fields(jnp.float32)
 
 _F_NAMES = (("fxp", "fxn"), ("fyp", "fyn"), ("fzp", "fzn"))
 _GEOMETRY_FIELDS = [n for pair in _F_NAMES for n in pair] + ["scale", "ctype", "ilen"]
@@ -121,7 +133,7 @@ class PoissonSolver:
             self.grid = grid
         else:
             self.grid = (
-                Grid(cell_data=dict(POISSON_FIELDS))
+                Grid(cell_data=poisson_fields(dtype))
                 .set_initial_length(length)
                 .set_periodic(*periodic)
                 .set_maximum_refinement_level(max_refinement_level)
@@ -131,6 +143,8 @@ class PoissonSolver:
         missing = [n for n in POISSON_FIELDS if n not in self.grid.fields]
         if missing:
             raise ValueError(f"grid lacks Poisson fields {missing}")
+        self.dtype = self.grid.fields["solution"][1]
+        self._np_dtype = np.dtype(self.dtype)
         if POISSON_NEIGHBORHOOD_ID not in self.grid.neighborhoods:
             self.grid.add_neighborhood(POISSON_NEIGHBORHOOD_ID, make_neighborhood(0))
         self._fwd = _matvec_kernel(transpose=False)
@@ -151,7 +165,7 @@ class PoissonSolver:
 
     def set_rhs(self, values) -> None:
         cells = self.grid.get_cells()
-        self.grid.set("rhs", cells, np.asarray(values, dtype=np.float32))
+        self.grid.set("rhs", cells, np.asarray(values, dtype=self._np_dtype))
 
     def set_rhs_from(self, fn) -> None:
         """rhs from a function of cell centers."""
@@ -218,9 +232,9 @@ class PoissonSolver:
         scale = -(f_pos.sum(axis=1) + f_neg.sum(axis=1))
 
         for d in range(3):
-            g.set(_F_NAMES[d][0], cells, f_pos[:, d].astype(np.float32))
-            g.set(_F_NAMES[d][1], cells, f_neg[:, d].astype(np.float32))
-        g.set("scale", cells, scale.astype(np.float32))
+            g.set(_F_NAMES[d][0], cells, f_pos[:, d].astype(self._np_dtype))
+            g.set(_F_NAMES[d][1], cells, f_neg[:, d].astype(self._np_dtype))
+        g.set("scale", cells, scale.astype(self._np_dtype))
         g.set("ctype", cells, ctype)
         g.set("ilen", cells, ilen.astype(np.int32))
         # the GEOMETRY transfer: factors valid for the whole epoch
@@ -228,7 +242,7 @@ class PoissonSolver:
             neighborhood_id=POISSON_NEIGHBORHOOD_ID, fields=_GEOMETRY_FIELDS
         )
 
-        mask = np.zeros((g.n_dev, g.plan.R), dtype=np.float32)
+        mask = np.zeros((g.n_dev, g.plan.R), dtype=self._np_dtype)
         for d in range(g.n_dev):
             mask[d, : g.plan.n_local[d]] = 1.0
         self._solve_mask = jax.device_put(jnp.asarray(mask), g._sharding()) * (
@@ -340,7 +354,8 @@ class DensePoissonSolver:
             cell_length=tuple(1.0 / l for l in length),
         )
         self.periodic = tuple(periodic)
-        rdx2 = (1.0 / np.asarray(self.grid.cell_length) ** 2).astype(np.float32)
+        self.dtype = jnp.dtype(dtype)
+        rdx2 = (1.0 / np.asarray(self.grid.cell_length) ** 2).astype(self.dtype)
         grid = self.grid
 
         def lap_kernel(b):
@@ -376,7 +391,7 @@ class DensePoissonSolver:
 
     def solve(self, rhs, rtol=1e-5, max_iterations=1000):
         singular = all(self.periodic)
-        rhs = jnp.asarray(rhs, dtype=jnp.float32)
+        rhs = jnp.asarray(rhs, dtype=self.dtype)
         if singular:
             rhs = rhs - jnp.mean(rhs)
         x = jnp.zeros_like(rhs)

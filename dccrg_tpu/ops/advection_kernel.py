@@ -150,8 +150,9 @@ def make_rotation_step(
 
     def kernel(dt_ref, rho_hbm, vxf_ref, vyf_ref, out_ref, body, sems):
         n = pl.program_id(0)
-        slot = jax.lax.rem(n, 2)
-        nxt = jax.lax.rem(n + 1, 2)
+        two = jnp.int32(2)  # keep int32 under jax_enable_x64
+        slot = jax.lax.rem(n, two)
+        nxt = jax.lax.rem(n + jnp.int32(1), two)
 
         @pl.when(n == 0)
         def _():

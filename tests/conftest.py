@@ -27,6 +27,8 @@ if not _USE_TPU:
     # The image's axon site hook pre-sets JAX_PLATFORMS=axon; the config
     # update overrides it reliably even if jax was touched earlier.
     jax.config.update("jax_platforms", "cpu")
+    # the image may pre-set JAX_ENABLE_X64 before the setdefault above
+    jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
