@@ -295,6 +295,8 @@ class Grid:
         self._weights = {}
         self._partitioning_options = {}
         self._partitioning_levels = []  # hierarchical partitioning
+        # per-field transfer predicates (receiver-dependent payloads)
+        self._transfer_predicates = {}
         # jitted function caches
         self._exchange_cache = {}
         self._pending = {}
@@ -1209,6 +1211,60 @@ class Grid:
 
     # -- halo exchange (dccrg.hpp:978-1014, 5046-5413) -----------------
 
+    def set_transfer_predicate(self, field: str, fn) -> None:
+        """Per-peer, per-neighborhood selection of what a cell sends —
+        the TPU counterpart of the reference's 5-argument
+        ``get_mpi_datatype(cell, sender, receiver, receiving, hood)``
+        (dccrg_get_cell_datatype.hpp:48-213), where a cell may expose
+        different data to different peers.
+
+        ``fn(cell_ids, sender, receiver, neighborhood_id) -> bool
+        array`` is evaluated at plan time per device pair; a False
+        entry drops that cell's ``field`` payload for that pair (both
+        sides skip it — the symmetric equivalent of the reference's
+        requirement that sender and receiver datatypes agree). Pass
+        ``None`` to clear."""
+        if fn is None:
+            self._transfer_predicates.pop(field, None)
+        else:
+            if field not in self.fields:
+                raise KeyError(f"unknown field {field!r}")
+            self._transfer_predicates[field] = fn
+        # both caches bake the pair tables into jitted closures: the
+        # exchange functions AND the run_steps loops
+        self._exchange_cache.clear()
+        self._stencil_cache.clear()
+
+    def _field_pair_tables(self, neighborhood_id, field):
+        """(send_rows, recv_rows) for one field: the neighborhood's
+        tables, filtered by the field's transfer predicate if set."""
+        hood = self.plan.hoods[neighborhood_id]
+        fn = self._transfer_predicates.get(field)
+        if fn is None:
+            return hood.send_rows, hood.recv_rows
+        key = (self.plan.epoch, neighborhood_id, field, "pairpred")
+        cached = self._exchange_cache.get(key)
+        if cached is not None:
+            return cached
+        send = hood.send_rows.copy()
+        recv = hood.recv_rows.copy()
+        for p in range(self.n_dev):
+            for q in range(self.n_dev):
+                valid = np.nonzero(send[p, q] >= 0)[0]
+                if len(valid) == 0:
+                    continue
+                ids = self.plan.local_ids[p][send[p, q, valid]]
+                keep = np.asarray(fn(ids, p, q, neighborhood_id), dtype=bool)
+                if keep.shape != ids.shape:
+                    raise ValueError(
+                        "transfer predicate must return one bool per cell"
+                    )
+                drop = valid[~keep]
+                send[p, q, drop] = -1
+                recv[q, p, drop] = -1
+        self._exchange_cache[key] = (send, recv)
+        return send, recv
+
     def _exchange_fn(self, neighborhood_id, field_names):
         """Fused halo exchange: the split-phase start/finish programs
         composed under one jit (XLA fuses them into one program)."""
@@ -1238,31 +1294,35 @@ class Grid:
         fns = self._exchange_cache.get(key)
         if fns is not None:
             return fns
-        hood = self.plan.hoods[neighborhood_id]
         R = self.plan.R
         sh = self._sharding()
-        send = jax.device_put(jnp.asarray(hood.send_rows), sh)
-        recv = jax.device_put(jnp.asarray(hood.recv_rows), sh)
+        # per-field pair tables: a field with a transfer predicate
+        # moves a filtered subset of the neighborhood's list
+        tables = [self._field_pair_tables(neighborhood_id, n) for n in field_names]
+        sends = tuple(jax.device_put(jnp.asarray(s), sh) for s, _ in tables)
+        recvs = tuple(jax.device_put(jnp.asarray(r), sh) for _, r in tables)
         axis = self.axis
         mesh = self.mesh
         n_f = len(field_names)
 
-        def start_body(send_r, *fields):
-            send_r = send_r[0]  # [n_dev, M]
+        def start_body(*args):
+            send_rs, fields = args[:n_f], args[n_f:]
             outs = []
-            for f in fields:
+            for sr, f in zip(send_rs, fields):
+                sr = sr[0]  # [n_dev, M]
                 fl = f[0]  # [R, ...]
-                buf = fl[jnp.clip(send_r, 0)]  # [n_dev, M, ...]
+                buf = fl[jnp.clip(sr, 0)]  # [n_dev, M, ...]
                 rbuf = jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=True)
                 outs.append(rbuf[None])  # per-device [1, n_dev, M, ...]
             return tuple(outs)
 
-        def finish_body(recv_r, *bufs_and_fields):
-            recv_r = recv_r[0]  # [n_dev, M]
-            rr = jnp.where(recv_r >= 0, recv_r, R - 1).reshape(-1)
-            bufs, fields = bufs_and_fields[:n_f], bufs_and_fields[n_f:]
+        def finish_body(*args):
+            recv_rs = args[:n_f]
+            bufs = args[n_f : 2 * n_f]
+            fields = args[2 * n_f :]
             outs = []
-            for rbuf, f in zip(bufs, fields):
+            for rv, rbuf, f in zip(recv_rs, bufs, fields):
+                rr = jnp.where(rv[0] >= 0, rv[0], R - 1).reshape(-1)
                 fl = f[0]
                 fl = fl.at[rr].set(rbuf[0].reshape((-1,) + fl.shape[1:]), mode="drop")
                 fl = fl.at[R - 1].set(0)  # keep the zero pad row zero
@@ -1272,23 +1332,23 @@ class Grid:
         start_mapped = _shard_map(
             start_body,
             mesh=mesh,
-            in_specs=(P(axis),) + (P(axis),) * n_f,
+            in_specs=(P(axis),) * (2 * n_f),
             out_specs=(P(axis),) * n_f,
         )
         finish_mapped = _shard_map(
             finish_body,
             mesh=mesh,
-            in_specs=(P(axis),) + (P(axis),) * (2 * n_f),
+            in_specs=(P(axis),) * (3 * n_f),
             out_specs=(P(axis),) * n_f,
         )
 
         @jax.jit
         def start(*fields):
-            return start_mapped(send, *fields)
+            return start_mapped(*sends, *fields)
 
         @jax.jit
         def finish(*bufs_and_fields):
-            return finish_mapped(recv, *bufs_and_fields)
+            return finish_mapped(*recvs, *bufs_and_fields)
 
         fns = (start, finish)
         self._exchange_cache[key] = fns
@@ -1579,15 +1639,21 @@ class Grid:
             h_nrows = jax.device_put(jnp.asarray(hood.hard_nbr_rows), sh)
             h_offs = jax.device_put(jnp.asarray(hood.hard_offs), sh)
             h_mask = jax.device_put(jnp.asarray(hood.hard_mask), sh)
-        send = jax.device_put(jnp.asarray(hood.send_rows), sh)
-        recv = jax.device_put(jnp.asarray(hood.recv_rows), sh)
         static_in = tuple(n for n in fields_in if n not in fields_out)
         n_static, n_out = len(static_in), len(fields_out)
         exch_idx = tuple(fields_out.index(n) for n in exchange_fields)
+        # per-exchanged-field pair tables (transfer predicates filter)
+        pair = [self._field_pair_tables(neighborhood_id, fields_out[j])
+                for j in exch_idx]
+        sends = tuple(jax.device_put(jnp.asarray(s), sh) for s, _ in pair)
+        recvs = tuple(jax.device_put(jnp.asarray(r), sh) for _, r in pair)
+        n_x = len(exch_idx)
         axis, mesh, n_dev = self.axis, self.mesh, self.n_dev
 
-        def body(n_steps, send_r, recv_r, nrows, noffs, nmask, *args):
-            send_r, recv_r = send_r[0], recv_r[0]
+        def body(n_steps, nrows, noffs, nmask, *args):
+            send_rs = [a[0] for a in args[:n_x]]
+            recv_rs = [a[0] for a in args[n_x:2 * n_x]]
+            args = args[2 * n_x:]
             nrows, nmask = nrows[0], nmask[0]
             if scaled:
                 sc, *args = args
@@ -1601,7 +1667,7 @@ class Grid:
                 hr, hnr, hof, hm, *args = args
                 hr, hnr, hof, hm = hr[0], hnr[0], hof[0], hm[0]
                 hrc = jnp.minimum(hr, L - 1)
-            rr = jnp.where(recv_r >= 0, recv_r, R - 1).reshape(-1)
+            rrs = [jnp.where(rv >= 0, rv, R - 1).reshape(-1) for rv in recv_rs]
             statics = {n: a[0] for n, a in zip(static_in, args[:n_static])}
             state0 = tuple(a[0] for a in args[n_static:n_static + n_out])
             extra = args[n_static + n_out:]
@@ -1609,13 +1675,13 @@ class Grid:
             def step(_, state):
                 state = list(state)
                 if n_dev > 1:
-                    for j in exch_idx:
+                    for xi, j in enumerate(exch_idx):
                         fl = state[j]
-                        buf = fl[jnp.clip(send_r, 0)]
+                        buf = fl[jnp.clip(send_rs[xi], 0)]
                         rbuf = jax.lax.all_to_all(
                             buf, axis, split_axis=0, concat_axis=0, tiled=True
                         )
-                        fl = fl.at[rr].set(
+                        fl = fl.at[rrs[xi]].set(
                             rbuf.reshape((-1,) + fl.shape[1:]), mode="drop"
                         )
                         fl = fl.at[R - 1].set(0)
@@ -1643,8 +1709,9 @@ class Grid:
         mapped = _shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P(axis), P(axis), P(axis),
+            in_specs=(P(), P(axis),
                       P() if uniform_offs else P(axis), P(axis))
+            + (P(axis),) * (2 * n_x)
             + ((P(axis),) if scaled else ())
             + ((P(axis),) * 4 if split else ())
             + (P(axis),) * (n_static + n_out) + (P(),) * n_extra,
@@ -1656,8 +1723,8 @@ class Grid:
         def run(n_steps, *args):
             pre = (scale_arr,) if scaled else ()
             pre += (h_rows, h_nrows, h_offs, h_mask) if split else ()
-            return mapped(n_steps, send, recv, nbr_rows, nbr_offs, nbr_mask,
-                          *pre, *args)
+            return mapped(n_steps, nbr_rows, nbr_offs, nbr_mask,
+                          *sends, *recvs, *pre, *args)
 
         return run, static_in
 

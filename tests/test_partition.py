@@ -62,3 +62,63 @@ def test_partition_balance_on_refined_levels():
         owner = partition_cells(m, cells, 5, method)
         counts = np.bincount(owner, minlength=5)
         assert counts.max() - counts.min() <= 1, method
+
+
+def test_rcb_partition_balanced_and_compact():
+    """RCB (Zoltan's geometric default): near-equal part weights and
+    compact boxes — the cut surface must beat a block split."""
+    from dccrg_tpu.partition import partition_cells
+    from dccrg_tpu.mapping import Mapping
+
+    mp = Mapping((16, 16, 16))
+    cells = np.arange(1, 16**3 + 1, dtype=np.uint64)
+    owner = partition_cells(mp, cells, 8, "rcb")
+    counts = np.bincount(owner, minlength=8)
+    assert counts.min() >= 16**3 // 8 - 64 and counts.max() <= 16**3 // 8 + 64
+    # compactness: count faces crossing parts along x/y/z
+    def cut_faces(own3):
+        c = 0
+        for d in range(3):
+            a = np.swapaxes(own3, 0, d)
+            c += int((a[1:] != a[:-1]).sum())
+        return c
+    own3 = owner.reshape(16, 16, 16)  # z, y, x
+    block3 = partition_cells(mp, cells, 8, "block").reshape(16, 16, 16)
+    assert cut_faces(own3) <= cut_faces(block3)
+    # rcb boxes for 8 parts on a cube should be the 2x2x2 octants:
+    # surface = 3 internal planes = 3 * 16^2 faces
+    assert cut_faces(own3) == 3 * 16 * 16
+
+
+def test_rcb_respects_weights_and_pins():
+    from dccrg_tpu.partition import partition_cells
+    from dccrg_tpu.mapping import Mapping
+
+    mp = Mapping((8, 8, 1))
+    cells = np.arange(1, 65, dtype=np.uint64)
+    w = np.ones(64)
+    w[:8] = 50.0  # first x-row dominates
+    owner = partition_cells(mp, cells, 2, "rcb", weights=w, pins={64: 0})
+    loads = np.bincount(owner, weights=w, minlength=2)
+    assert abs(loads[0] - loads[1]) / loads.sum() < 0.2
+    assert owner[63] == 0  # pinned
+
+
+def test_rcb_on_refined_grid():
+    from dccrg_tpu.grid import Grid
+    import jax, jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dev",))
+    g = (Grid(cell_data={"v": jnp.float32})
+         .set_initial_length((6, 6, 2))
+         .set_maximum_refinement_level(1)
+         .set_load_balancing_method("rcb")
+         .initialize(mesh))
+    for c in (1, 2, 7):
+        g.refine_completely(c)
+    g.stop_refining()
+    g.balance_load()
+    counts = np.bincount(g.plan.owner, minlength=4)
+    assert counts.min() > 0
+    g.update_copies_of_remote_neighbors()
