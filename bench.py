@@ -163,7 +163,11 @@ def main() -> None:
     import jax
 
     pallas_ups, pallas_l2 = bench_pallas(baseline)
-    grid_ups, grid_l2 = bench_grid_path(baseline)
+    try:
+        grid_ups, grid_l2 = bench_grid_path(baseline)
+    except Exception as e:  # keep the JSON line flowing for the driver
+        print(f"grid path bench failed: {e!r}", file=sys.stderr)
+        grid_ups, grid_l2 = None, None
 
     print(
         json.dumps(
@@ -176,7 +180,8 @@ def main() -> None:
                 "pallas_l2_error": pallas_l2,
                 "grid_path_updates_per_sec": grid_ups,
                 "grid_path_size": f"{GRID_N}^3",
-                "grid_path_vs_baseline": grid_ups / baseline,
+                "grid_path_vs_baseline": (grid_ups / baseline
+                                          if grid_ups is not None else None),
                 "l2_error": grid_l2,
             }
         )
