@@ -173,6 +173,7 @@ class _HoodPlan:
         if to_tables is None and to_rows is not None:
             to_tables = (to_rows, to_offs, to_mask)
         self._to = to_tables  # (rows, offs, mask) or thunk
+        self._roll_plan = None  # computed on demand by roll_plan()
 
     @property
     def lists(self):
@@ -190,6 +191,52 @@ class _HoodPlan:
         if callable(self._to):
             self._to = self._to()
         return self._to
+
+    def roll_plan(self, L: int):
+        """Affine decomposition of the of-gather: if (almost) every
+        masked slot entry satisfies ``row == r + shift_j``, the [L, S]
+        neighbor gather lowers to S jnp.rolls (sequential HBM traffic,
+        cheap on TPU where arbitrary gathers are slow) plus a sparse
+        fixup scatter for the non-affine entries (wrap rows, block
+        boundaries, rows near refinement). Returns
+        ``(shifts [S], wrong_rows [n_dev, S, W], wrong_src [n_dev, S, W])``
+        or None when the tables aren't affine enough to pay off.
+        Computed once per structure epoch (cached)."""
+        if getattr(self, "_roll_plan", None) is not None:
+            return self._roll_plan if self._roll_plan != () else None
+        rows = np.asarray(self.nbr_rows, dtype=np.int64)
+        mask = np.asarray(self.nbr_mask)
+        n_dev, Lr, S = rows.shape
+        base = np.arange(Lr, dtype=np.int64)[None, :]
+        shifts = np.zeros(S, dtype=np.int64)
+        wrong_sets = []
+        n_masked = n_wrong = 0
+        for j in range(S):
+            mj = mask[:, :, j]
+            dj = rows[:, :, j] - base
+            local = rows[:, :, j] < L  # rolls only cover local rows
+            dm = dj[mj & local]
+            if len(dm):
+                vals, counts = np.unique(dm, return_counts=True)
+                shifts[j] = vals[np.argmax(counts)]
+            # ghost reads (row >= L) can coincidentally equal r + shift
+            # but the roll never sees them: always fix them up
+            wrong = mj & ((dj != shifts[j]) | ~local)
+            n_masked += int(mj.sum())
+            n_wrong += int(wrong.sum())
+            wrong_sets.append([np.nonzero(wrong[d])[0] for d in range(n_dev)])
+        if n_masked == 0 or n_wrong / n_masked > 0.25:
+            self._roll_plan = ()
+            return None
+        W = max(1, max(len(w) for per in wrong_sets for w in per))
+        wrong_rows = np.full((n_dev, S, W), L, dtype=np.int32)  # pad: dropped
+        wrong_src = np.zeros((n_dev, S, W), dtype=np.int32)
+        for j, per in enumerate(wrong_sets):
+            for d, w in enumerate(per):
+                wrong_rows[d, j, : len(w)] = w
+                wrong_src[d, j, : len(w)] = rows[d, w, j]
+        self._roll_plan = (shifts, wrong_rows, wrong_src)
+        return self._roll_plan
 
     def merged_of_tables(self, pad_row):
         """Dense [n_dev, L, S] (rows, offs, mask) merging the far and
@@ -1468,12 +1515,28 @@ class Grid:
         for n, arr in zip(fields_out, out):
             self.data[n] = arr
 
+
+    def _use_roll_gather(self) -> bool:
+        """Roll-decomposed gathers trade a dense random gather for S
+        sequential rolls + a sparse fixup: a clear win on TPU (random
+        gathers crawl), a small loss on the CPU backend (caches absorb
+        the near-sequential gather, the stack materialization doesn't
+        pay). Default: on for accelerators, off for CPU; override with
+        DCCRG_ROLL_STENCIL=0/1."""
+        import os as _os
+
+        env = _os.environ.get("DCCRG_ROLL_STENCIL")
+        if env in ("0", "1"):
+            return env == "1"
+        return self.mesh.devices.flat[0].platform not in ("cpu",)
+
     def _make_stencil(self, kernel, fields_in, fields_out, neighborhood_id, include_to,
                       n_extra=0):
         hood = self.plan.hoods[neighborhood_id]
         L, R = self.plan.L, self.plan.R
         sh = self._sharding()
         split = hood.hard_nbr_rows is not None and not include_to
+        roll = None
         if include_to and hood.hard_nbr_rows is not None:
             # include_to on a split-table plan: rare API-parity path,
             # served by the merged dense tables
@@ -1484,7 +1547,20 @@ class Grid:
             nbr_mask = jax.device_put(jnp.asarray(m_mask), sh)
         else:
             uniform_offs = hood.offs_const is not None
-            nbr_rows = jax.device_put(jnp.asarray(hood.nbr_rows), sh)
+            # affine tables lower the gather to rolls + sparse fixups
+            # (the dense [n_dev, L, S] row table then never ships)
+            roll = (hood.roll_plan(L)
+                    if uniform_offs and not include_to and self._use_roll_gather()
+                    else None)
+            if roll is not None:
+                r_shifts = tuple(int(s) for s in roll[0])
+                r_wrongr = jax.device_put(jnp.asarray(roll[1]), sh)
+                r_wrongs = jax.device_put(jnp.asarray(roll[2]), sh)
+                nbr_rows = jax.device_put(
+                    jnp.zeros((self.n_dev, 1, 1), jnp.int32), sh
+                )
+            else:
+                nbr_rows = jax.device_put(jnp.asarray(hood.nbr_rows), sh)
             if uniform_offs:
                 # per-slot constant offsets: synthesized in-body from the
                 # mask instead of storing [n_dev, L, S, 3] in HBM
@@ -1492,6 +1568,8 @@ class Grid:
             else:
                 nbr_offs = jax.device_put(jnp.asarray(hood.nbr_offs), sh)
             nbr_mask = jax.device_put(jnp.asarray(hood.nbr_mask), sh)
+        if include_to or not uniform_offs:
+            roll = None
         scaled = uniform_offs and hood.scale_rows is not None
         if scaled:
             scale_arr = jax.device_put(jnp.asarray(hood.scale_rows), sh)
@@ -1509,6 +1587,9 @@ class Grid:
 
         def body(nrows, noffs, nmask, *args):
             nrows, nmask = nrows[0], nmask[0]
+            if roll is not None:
+                wr, ws, *args = args
+                wr, ws = wr[0], ws[0]
             if scaled:
                 sc, *args = args
             if uniform_offs:
@@ -1528,7 +1609,23 @@ class Grid:
             outs_cur = args[n_in: n_in + n_out]
             extra = args[n_in + n_out:]
             cell_fields = {n: f[0][:L] for n, f in zip(fields_in, ins)}
-            nbr_fields = {n: f[0][nrows] for n, f in zip(fields_in, ins)}
+
+            def gather_nbr(fl):
+                if roll is None:
+                    return fl[nrows]
+                cols = [jnp.roll(fl[:L], -s, axis=0) for s in r_shifts]
+                st = jnp.stack(cols, axis=1)  # [L, S, ...]
+                rows_flat = wr.reshape(-1)
+                slots_flat = jnp.repeat(
+                    jnp.arange(len(r_shifts), dtype=jnp.int32), wr.shape[1]
+                )
+                st = st.at[rows_flat, slots_flat].set(
+                    fl[ws.reshape(-1)], mode="drop"
+                )
+                mexp = nmask.reshape(nmask.shape + (1,) * (st.ndim - 2))
+                return jnp.where(mexp, st, jnp.zeros((), st.dtype))
+
+            nbr_fields = {n: gather_nbr(f[0]) for n, f in zip(fields_in, ins)}
             if include_to:
                 to_fields = {n: f[0][trows] for n, f in zip(fields_in, ins)}
                 result = kernel(
@@ -1562,6 +1659,7 @@ class Grid:
             body,
             mesh=mesh,
             in_specs=(P(axis), P() if uniform_offs else P(axis), P(axis))
+            + ((P(axis), P(axis)) if roll is not None else ())
             + ((P(axis),) if scaled else ())
             + split_specs
             + to_specs
@@ -1572,7 +1670,8 @@ class Grid:
 
         @jax.jit
         def run(*args):
-            pre = (scale_arr,) if scaled else ()
+            pre = (r_wrongr, r_wrongs) if roll is not None else ()
+            pre += (scale_arr,) if scaled else ()
             pre += (h_rows, h_nrows, h_offs, h_mask) if split else ()
             if include_to:
                 return mapped(nbr_rows, nbr_offs, nbr_mask, *pre, to_rows, to_offs, to_mask, *args)
@@ -1625,7 +1724,15 @@ class Grid:
         sh = self._sharding()
         uniform_offs = hood.offs_const is not None
         split = hood.hard_nbr_rows is not None
-        nbr_rows = jax.device_put(jnp.asarray(hood.nbr_rows), sh)
+        roll = (hood.roll_plan(L)
+                if uniform_offs and self._use_roll_gather() else None)
+        if roll is not None:
+            r_shifts = tuple(int(s) for s in roll[0])
+            r_wrongr = jax.device_put(jnp.asarray(roll[1]), sh)
+            r_wrongs = jax.device_put(jnp.asarray(roll[2]), sh)
+            nbr_rows = jax.device_put(jnp.zeros((self.n_dev, 1, 1), jnp.int32), sh)
+        else:
+            nbr_rows = jax.device_put(jnp.asarray(hood.nbr_rows), sh)
         if uniform_offs:
             nbr_offs = jnp.asarray(hood.offs_const)  # [S, 3] replicated
         else:
@@ -1655,6 +1762,9 @@ class Grid:
             recv_rs = [a[0] for a in args[n_x:2 * n_x]]
             args = args[2 * n_x:]
             nrows, nmask = nrows[0], nmask[0]
+            if roll is not None:
+                wr, ws, *args = args
+                wr, ws = wr[0], ws[0]
             if scaled:
                 sc, *args = args
             if uniform_offs:
@@ -1668,6 +1778,21 @@ class Grid:
                 hr, hnr, hof, hm = hr[0], hnr[0], hof[0], hm[0]
                 hrc = jnp.minimum(hr, L - 1)
             rrs = [jnp.where(rv >= 0, rv, R - 1).reshape(-1) for rv in recv_rs]
+
+            def gather_nbr(fl):
+                if roll is None:
+                    return fl[nrows]
+                cols = [jnp.roll(fl[:L], -s, axis=0) for s in r_shifts]
+                st = jnp.stack(cols, axis=1)  # [L, S, ...]
+                rows_flat = wr.reshape(-1)
+                slots_flat = jnp.repeat(
+                    jnp.arange(len(r_shifts), dtype=jnp.int32), wr.shape[1]
+                )
+                st = st.at[rows_flat, slots_flat].set(
+                    fl[ws.reshape(-1)], mode="drop"
+                )
+                mexp = nmask.reshape(nmask.shape + (1,) * (st.ndim - 2))
+                return jnp.where(mexp, st, jnp.zeros((), st.dtype))
             statics = {n: a[0] for n, a in zip(static_in, args[:n_static])}
             state0 = tuple(a[0] for a in args[n_static:n_static + n_out])
             extra = args[n_static + n_out:]
@@ -1689,7 +1814,7 @@ class Grid:
                 full = dict(statics)
                 full.update(zip(fields_out, state))
                 cell_fields = {n: full[n][:L] for n in fields_in}
-                nbr_fields = {n: full[n][nrows] for n in fields_in}
+                nbr_fields = {n: gather_nbr(full[n]) for n in fields_in}
                 result = kernel(cell_fields, nbr_fields, noffs, nmask, *extra)
                 if split:
                     h_cell = {n: cell_fields[n][hrc] for n in fields_in}
@@ -1712,6 +1837,7 @@ class Grid:
             in_specs=(P(), P(axis),
                       P() if uniform_offs else P(axis), P(axis))
             + (P(axis),) * (2 * n_x)
+            + ((P(axis), P(axis)) if roll is not None else ())
             + ((P(axis),) if scaled else ())
             + ((P(axis),) * 4 if split else ())
             + (P(axis),) * (n_static + n_out) + (P(),) * n_extra,
@@ -1721,7 +1847,8 @@ class Grid:
 
         @jax.jit
         def run(n_steps, *args):
-            pre = (scale_arr,) if scaled else ()
+            pre = (r_wrongr, r_wrongs) if roll is not None else ()
+            pre += (scale_arr,) if scaled else ()
             pre += (h_rows, h_nrows, h_offs, h_mask) if split else ()
             return mapped(n_steps, nbr_rows, nbr_offs, nbr_mask,
                           *sends, *recvs, *pre, *args)

@@ -437,3 +437,41 @@ def test_transfer_predicate_in_fused_loop():
     got = g.get("v", cells)
     assert got[1] == 1.0 + 3.0  # remote neighbor flows again
     assert got[2] == 2.0 + 4.0
+
+
+def test_roll_gather_matches_table_gather(monkeypatch):
+    """The roll-decomposed neighbor gather (TPU default) must equal
+    the table gather on uniform and refined plans, through both
+    apply_stencil and the fused run_steps loop."""
+    def build():
+        mesh = Mesh(np.array(jax.devices()[:2]), ("dev",))
+        g = (Grid(cell_data={"v": jnp.float32})
+             .set_initial_length((16, 16, 4))
+             .set_periodic(True, False, False)
+             .set_maximum_refinement_level(1)
+             .initialize(mesh))
+        g.refine_completely(1)
+        g.stop_refining()
+        cells = g.plan.cells
+        rng = np.random.default_rng(5)
+        g.set("v", cells, rng.random(len(cells)).astype(np.float32))
+        g.update_copies_of_remote_neighbors()
+        return g
+
+    def kernel(cell, nbr, offs, mask, *e):
+        return {"v": cell["v"] + 0.25 * jnp.sum(
+            jnp.where(mask, nbr["v"] * (1 + offs[..., 0]), 0.0), axis=1)}
+
+    results = {}
+    for mode in ("1", "0"):
+        monkeypatch.setenv("DCCRG_ROLL_STENCIL", mode)
+        g = build()
+        if mode == "1":
+            hood = g.plan.hoods[DEFAULT_NEIGHBORHOOD_ID]
+            assert hood.roll_plan(g.plan.L) is not None
+        g.apply_stencil(kernel, ["v"], ["v"])
+        one = g.get("v", g.plan.cells).copy()
+        g.run_steps(kernel, ["v"], ["v"], 2)
+        results[mode] = (one, g.get("v", g.plan.cells))
+    np.testing.assert_allclose(results["1"][0], results["0"][0], rtol=1e-6)
+    np.testing.assert_allclose(results["1"][1], results["0"][1], rtol=1e-6)
