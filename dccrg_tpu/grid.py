@@ -1467,11 +1467,22 @@ class Grid:
     def wait_remote_neighbor_copy_update_sends(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID) -> None:
         pass
 
-    def get_number_of_update_send_cells(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID) -> int:
-        """Total cells sent per halo update (dccrg.hpp:5428)."""
+    def get_number_of_update_send_cells(
+        self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID, field: str | None = None
+    ) -> int:
+        """Total cells sent per halo update (dccrg.hpp:5428); with
+        ``field``, the count after that field's transfer predicate."""
+        if field is not None:
+            send, _ = self._field_pair_tables(neighborhood_id, field)
+            return int(np.sum(send >= 0))
         return int(np.sum(self.plan.hoods[neighborhood_id].send_rows >= 0))
 
-    def get_number_of_update_receive_cells(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID) -> int:
+    def get_number_of_update_receive_cells(
+        self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID, field: str | None = None
+    ) -> int:
+        if field is not None:
+            _, recv = self._field_pair_tables(neighborhood_id, field)
+            return int(np.sum(recv >= 0))
         return int(np.sum(self.plan.hoods[neighborhood_id].recv_rows >= 0))
 
     # -- stencil execution ---------------------------------------------

@@ -151,6 +151,26 @@ class AmrAdvection:
                       hump(self.grid.geometry.get_center(cells)).astype(np.float32))
         self.time = 0.0
 
+    @classmethod
+    def from_grid(cls, grid, cfl=0.5, diff_increase=0.02,
+                  diff_threshold=0.025, unrefine_sensitivity=0.5,
+                  time=0.0):
+        """Wrap an existing grid (e.g. one restored with
+        ``Grid.from_file``) carrying this app's field schema — the
+        restart path of the reference's advection test."""
+        app = cls.__new__(cls)
+        app.cfl = cfl
+        app.diff_increase = diff_increase
+        app.diff_threshold = diff_threshold
+        app.unrefine_sensitivity = unrefine_sensitivity
+        app.grid = grid
+        app._flux_kernel = make_flux_kernel()
+        app._fused_kernel = make_fused_step_kernel()
+        app._diff_kernel = make_diff_kernel(diff_threshold)
+        app._refresh_static()
+        app.time = time
+        return app
+
     # -- static per-epoch fields ---------------------------------------
 
     def _refresh_static(self) -> None:
