@@ -273,9 +273,100 @@ class PoissonSolver:
 
     # -- solve (poisson_solve.hpp:252-523) -----------------------------
 
+    def _fused_solve_fn(self):
+        """The ENTIRE biconjugate solve as one XLA program: initial
+        residual, then a lax.while_loop whose body fuses the p0/p1
+        halo exchange, both matvecs, the three global dots (XLA
+        all-reduces — the reference pays an MPI_Allreduce per
+        iteration, poisson_solve.hpp:341-349) and the vector updates.
+        No host round-trips until the result is read."""
+        key = self._prepared_epoch
+        if getattr(self, "_fused_cache_key", None) == key:
+            return self._fused_cache
+        g = self.grid
+        fields_in_fwd = ["p0", "ilen", "ctype", "scale"] + [
+            n for pair in _F_NAMES for n in pair
+        ]
+        fields_in_tr = ["p1"] + fields_in_fwd[1:]
+        fwd = g._make_stencil(self._fwd, tuple(fields_in_fwd), ("Ap0",),
+                              POISSON_NEIGHBORHOOD_ID, False)
+        tr = g._make_stencil(self._tr, tuple(fields_in_tr), ("r1",),
+                             POISSON_NEIGHBORHOOD_ID, False)
+        exchange1 = g._exchange_fn(POISSON_NEIGHBORHOOD_ID, ("p0",))
+        exchange2 = g._exchange_fn(POISSON_NEIGHBORHOOD_ID, ("p0", "p1"))
+        statics = tuple(g.data[n] for n in fields_in_fwd[1:])
+        mask = self._solve_mask
+        single = g.n_dev == 1
+
+        def dot(a, b):
+            return jnp.sum(a * b * mask)
+
+        @jax.jit
+        def run(solution, rhs, scratch, rtol, max_iterations):
+            # initial residual (initialize_solver, :986-1041)
+            p0 = solution
+            if not single:
+                (p0,) = exchange1(p0)
+            (Ap0,) = fwd(p0, *statics, scratch)
+            r0 = (rhs - Ap0) * mask
+            dot_r0 = dot(r0, r0)
+            b2 = dot(rhs, rhs)
+            target = jnp.maximum(
+                rtol * rtol * jnp.maximum(jnp.maximum(b2, dot_r0), 1e-30),
+                1e-30,
+            )
+
+            def cond(s):
+                return s["go"] & (s["residual"] > target) & (
+                    s["it"] < max_iterations
+                )
+
+            def body(s):
+                p0, p1 = s["p0"], s["p1"]
+                if not single:
+                    p0, p1 = exchange2(p0, p1)
+                (Ap0,) = fwd(p0, *statics, s["Ap0"])
+                dot_p = dot(p1, Ap0)
+                go = (dot_p != 0) & (s["dot_r"] != 0)
+                safe_p = jnp.where(dot_p == 0, 1, dot_p)
+                alpha = jnp.where(go, s["dot_r"] / safe_p, 0.0)
+                solution = s["solution"] + alpha * p0 * mask
+                r0 = s["r0"] - alpha * Ap0 * mask
+                (Atp1,) = tr(p1, *statics, s["r1"])
+                r1 = s["r1"] - alpha * Atp1 * mask
+                new_dot_r = dot(r0, r1)
+                safe_r = jnp.where(s["dot_r"] == 0, 1, s["dot_r"])
+                beta = jnp.where(go, new_dot_r / safe_r, 0.0)
+                p0 = (r0 + beta * p0) * mask
+                p1 = (r1 + beta * p1) * mask
+                return {
+                    "solution": jnp.where(go, solution, s["solution"]),
+                    "r0": jnp.where(go, r0, s["r0"]),
+                    "r1": jnp.where(go, r1, s["r1"]),
+                    "p0": jnp.where(go, p0, s["p0"]),
+                    "p1": jnp.where(go, p1, s["p1"]),
+                    "Ap0": Ap0,
+                    "dot_r": jnp.where(go, new_dot_r, s["dot_r"]),
+                    "residual": jnp.where(go, dot(r0, r0), s["residual"]),
+                    "it": s["it"] + jnp.where(go, 1, 0),
+                    "go": go,
+                }
+
+            init = {
+                "solution": solution, "r0": r0, "r1": r0, "p0": r0,
+                "p1": r0, "Ap0": Ap0, "dot_r": dot_r0, "residual": dot_r0,
+                "it": jnp.int32(0), "go": jnp.bool_(True),
+            }
+            out = jax.lax.while_loop(cond, body, init)
+            return out["solution"], out["it"], out["residual"]
+
+        self._fused_cache = run
+        self._fused_cache_key = key
+        return run
+
     def solve(self, rtol: float = 1e-5, max_iterations: int = 1000,
               cells_to_solve=None, cells_to_skip=None,
-              cache_is_up_to_date: bool = False) -> dict:
+              cache_is_up_to_date: bool = False, fused: bool = True) -> dict:
         g = self.grid
         # re-prepare only when the structure epoch or the cell
         # classification changed (the reference's cache_is_up_to_date
@@ -291,6 +382,20 @@ class PoissonSolver:
         singular = cells_to_solve is None and cells_to_skip is None
         if singular:
             self._remove_mean("rhs")
+
+        if fused:
+            run = self._fused_solve_fn()
+            sol, it, residual = run(
+                self.grid.data["solution"], self.grid.data["rhs"],
+                self.grid.data["Ap0"],
+                jnp.asarray(rtol, dtype=self.dtype),
+                jnp.int32(max_iterations),
+            )
+            self.grid.data["solution"] = sol
+            if singular:
+                self._remove_mean("solution")
+            return {"iterations": int(it),
+                    "residual": float(np.sqrt(max(float(residual), 0.0)))}
 
         # r0 = rhs - A·solution, with boundary cells' solution as data
         # (initialize_solver, poisson_solve.hpp:986-1041)

@@ -260,3 +260,38 @@ def test_f64_parity_mode():
     assert err64 < 1e-9, err64
     assert err64 < err32, (err64, err32)
     assert err32 < 1e-4, err32
+
+
+def test_fused_solve_matches_host_loop():
+    """The single-program lax.while_loop solve must walk the same
+    Krylov trajectory as the host-driven loop (same ops, same order)."""
+    import jax.numpy as jnp
+    from dccrg_tpu.models.poisson import PoissonSolver
+
+    def make():
+        s = PoissonSolver(length=(8, 8, 4), mesh=mesh1(4),
+                          periodic=(True, False, False),
+                          max_refinement_level=1)
+        g = s.grid
+        g.refine_completely(1)
+        g.stop_refining()
+        cells = g.get_cells()
+        centers = g.geometry.get_center(cells)
+        rng = np.random.default_rng(0)
+        s.set_rhs(np.sin(centers[:, 0]) + 0.1 * rng.random(len(cells)))
+        # Dirichlet boundary: first level-0 plane
+        solve = cells[centers[:, 1] > 1.5]
+        return s, solve
+
+    s1, solve1 = make()
+    out1 = s1.solve(rtol=1e-6, max_iterations=60, cells_to_solve=solve1,
+                    fused=True)
+    s2, solve2 = make()
+    out2 = s2.solve(rtol=1e-6, max_iterations=60, cells_to_solve=solve2,
+                    fused=False)
+    assert out1["iterations"] == out2["iterations"]
+    # f32 reduction orders differ between the fused and host programs
+    np.testing.assert_allclose(out1["residual"], out2["residual"],
+                               rtol=5e-2, atol=1e-10)
+    np.testing.assert_allclose(s1.solution(), s2.solution(),
+                               rtol=5e-4, atol=5e-6)
