@@ -188,3 +188,65 @@ def test_sparse_user_hood_to_queries(monkeypatch):
         assert hybrid.get_neighbors_to(c, 42) == generic.get_neighbors_to(c, 42), int(c)
     assert entry_sets(hybrid, 42, "to") == entry_sets(generic, 42, "to")
     assert entry_sets(hybrid, 42, "of") == entry_sets(generic, 42, "of")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_randomized_adaptation_stress(monkeypatch, seed):
+    """Random refine/unrefine/dont_* sequences: the hybrid plan must
+    match the forced-generic plan after every commit, and the DEBUG
+    verifiers must stay satisfied."""
+    rng = np.random.default_rng(seed)
+    dims = tuple(int(v) for v in rng.integers(3, 6, 3))
+    periodic = tuple(bool(b) for b in rng.integers(0, 2, 3))
+    n_dev = int(rng.choice([1, 2, 4, 5]))
+
+    def build(force_generic):
+        if force_generic:
+            monkeypatch.setenv("DCCRG_FORCE_GENERIC", "1")
+        else:
+            monkeypatch.delenv("DCCRG_FORCE_GENERIC", raising=False)
+        g = (Grid(cell_data={"v": jnp.float32})
+             .set_initial_length(dims)
+             .set_periodic(*periodic)
+             .set_maximum_refinement_level(2)
+             .initialize(mesh_of(n_dev)))
+        local_rng = np.random.default_rng(seed + 100)
+        for round_ in range(3):
+            cells = g.plan.cells
+            lvl = g.mapping.get_refinement_level(cells)
+            for c in local_rng.choice(cells, size=min(5, len(cells)), replace=False):
+                op = local_rng.integers(0, 4)
+                if op == 0:
+                    g.refine_completely(int(c))
+                elif op == 1:
+                    g.unrefine_completely(int(c))
+                elif op == 2:
+                    g.dont_refine(int(c))
+                else:
+                    g.dont_unrefine(int(c))
+            g.stop_refining()
+            g.clear_refined_unrefined_data()
+        return g
+
+    hybrid = build(False)
+    generic = build(True)
+    np.testing.assert_array_equal(hybrid.plan.cells, generic.plan.cells)
+    np.testing.assert_array_equal(hybrid.plan.owner, generic.plan.owner)
+    hid = DEFAULT_NEIGHBORHOOD_ID
+    assert entry_sets(hybrid, hid, "of") == entry_sets(generic, hid, "of")
+    assert entry_sets(hybrid, hid, "to") == entry_sets(generic, hid, "to")
+    np.testing.assert_array_equal(hybrid.plan.hoods[hid].send_rows,
+                                  generic.plan.hoods[hid].send_rows)
+    # DEBUG verifiers on the hybrid result
+    from dccrg_tpu import verify as _verify
+    _verify.is_consistent(hybrid)
+    _verify.verify_neighbors(hybrid)
+    _verify.verify_remote_neighbor_info(hybrid)
+    # exchange still correct
+    cells = hybrid.plan.cells
+    hybrid.set("v", cells, cells.astype(np.float32))
+    hybrid.update_copies_of_remote_neighbors()
+    host = np.asarray(hybrid.data["v"])
+    for d in range(hybrid.n_dev):
+        for r, cid in enumerate(hybrid.plan.ghost_ids[d]):
+            assert host[d, hybrid.plan.L + r] == float(cid)
