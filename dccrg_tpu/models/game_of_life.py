@@ -60,3 +60,11 @@ class GameOfLife:
     def step(self) -> None:
         self.grid.update_copies_of_remote_neighbors(fields=["live"])
         self.grid.apply_stencil(life_kernel, ["live"], ["live", "total"])
+
+    def run(self, n_steps: int) -> None:
+        """``n_steps`` generations as ONE device program: exchange +
+        rules per generation inside the fused step loop (the TPU form
+        of the reference's overlapped main loop,
+        examples/game_of_life.cpp)."""
+        self.grid.run_steps(life_kernel, ["live"], ["live", "total"],
+                            n_steps, exchange_fields=["live"])
