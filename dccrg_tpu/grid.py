@@ -107,6 +107,20 @@ def _trim_allocator():
         pass
 
 
+def bucket_capacity(n: int) -> int:
+    """Round a capacity up to a quarter-power-of-two bucket (16, 20,
+    24, 28, 32, 40, ...): structure changes that stay within a bucket
+    keep every array shape identical, so the jitted exchange/stencil/
+    step-loop programs (keyed by shape, not epoch) are reused instead
+    of recompiled — the difference between an O(ms) and an O(30 s)
+    AMR epoch on TPU. Waste is bounded at 25%."""
+    n = int(n)
+    if n <= 16:
+        return 16
+    step = 1 << max(max(n - 1, 1).bit_length() - 3, 0)
+    return ((n + step - 1) // step) * step
+
+
 def default_mesh(devices=None) -> Mesh:
     """1-D device mesh over all (or given) devices, axis name 'dev'."""
     if devices is None:
@@ -174,6 +188,11 @@ class _HoodPlan:
             to_tables = (to_rows, to_offs, to_mask)
         self._to = to_tables  # (rows, offs, mask) or thunk
         self._roll_plan = None  # computed on demand by roll_plan()
+        # per-epoch memo of device uploads (tables as jit ARGUMENTS:
+        # programs are shape-keyed and reused across structure epochs,
+        # only the table values re-upload)
+        self._dev = {}
+        self._pair_host = {}  # field -> predicate-filtered pair tables
 
     @property
     def lists(self):
@@ -192,7 +211,17 @@ class _HoodPlan:
             self._to = self._to()
         return self._to
 
-    def roll_plan(self, L: int):
+    def dev(self, name, host_array, sharding=None):
+        """Memoized device upload of a named table (replicated when
+        no sharding is given)."""
+        hit = self._dev.get(name)
+        if hit is None:
+            hit = (jnp.asarray(host_array) if sharding is None
+                   else jax.device_put(jnp.asarray(host_array), sharding))
+            self._dev[name] = hit
+        return hit
+
+    def roll_plan(self, L: int, cap=bucket_capacity):
         """Affine decomposition of the of-gather: if (almost) every
         masked slot entry satisfies ``row == r + shift_j``, the [L, S]
         neighbor gather lowers to S jnp.rolls (sequential HBM traffic,
@@ -228,7 +257,7 @@ class _HoodPlan:
         if n_masked == 0 or n_wrong / n_masked > 0.25:
             self._roll_plan = ()
             return None
-        W = max(1, max(len(w) for per in wrong_sets for w in per))
+        W = cap(max(1, max(len(w) for per in wrong_sets for w in per)))
         wrong_rows = np.full((n_dev, S, W), L, dtype=np.int32)  # pad: dropped
         wrong_src = np.zeros((n_dev, S, W), dtype=np.int32)
         for j, per in enumerate(wrong_sets):
@@ -344,10 +373,14 @@ class Grid:
         self._partitioning_levels = []  # hierarchical partitioning
         # per-field transfer predicates (receiver-dependent payloads)
         self._transfer_predicates = {}
-        # jitted function caches
-        self._exchange_cache = {}
+        # capacity hysteresis memo (see _sticky_cap)
+        self._cap_memo = {}
+        # compiled-program cache, keyed by the STATIC shape signature
+        # (L, R, flags, kernel, ...) — never invalidated by structure
+        # epochs: with bucketed capacities (bucket_capacity) a rebuild
+        # that lands in the same buckets reuses every compiled program
+        self._program_cache = {}
         self._pending = {}
-        self._stencil_cache = {}
         import os
 
         self._debug = os.environ.get("DCCRG_DEBUG") == "1"
@@ -495,6 +528,31 @@ class Grid:
         hp = self.plan.hoods[neighborhood_id]
         return np.asarray((hp.recv_rows >= 0).any(axis=2))
 
+    # capacities whose arrays are small but whose need varies a lot
+    # epoch-to-epoch (hard-shell sizes, pair lists, fixup widths):
+    # give them a 2x band so shapes virtually never change
+    _WIDE_CAPS = ("G", "M", "S", "S_hard", "Hmax", "T_hard", "rollW")
+
+    def _sticky_cap(self, name, needed: int) -> int:
+        """Capacity with hysteresis: grow in buckets with headroom,
+        keep the previous capacity while the need still fits, shrink
+        only once the need drops well below it — epoch-to-epoch
+        structural churn then keeps array shapes identical, so the
+        shape-keyed compiled programs are reused instead of
+        recompiled."""
+        needed = int(needed)
+        base = name[0] if isinstance(name, tuple) else name
+        wide = base in self._WIDE_CAPS
+        prev = self._cap_memo.get(name)
+        if prev is not None and prev // (4 if wide else 2) <= needed <= prev:
+            return prev
+        # headroom absorbs drift (a refined region that wanders grows
+        # some devices' loads a little every epoch); the big L arrays
+        # get 25%, the small high-variance ones 2x
+        cap = bucket_capacity(needed * 2 if wide else needed + needed // 4)
+        self._cap_memo[name] = cap
+        return cap
+
     # -- structure plan building --------------------------------------
 
     def _build_plan(self, cells: np.ndarray, owner: np.ndarray):
@@ -584,8 +642,9 @@ class Grid:
 
         n_local = np.array([len(x) for x in local_ids], dtype=np.int64)
         n_ghost = np.array([len(x) for x in ghost_ids], dtype=np.int64)
-        L = max(1, int(n_local.max()))
+        L = self._sticky_cap("L", max(1, int(n_local.max())))
         G = int(n_ghost.max()) if n_dev > 1 else 0
+        G = self._sticky_cap("G", G) if G else 0
         R = L + G + 1  # final row = permanent zero pad
 
         # row lookups: row_by_gidx[d][global cell index] -> row on
@@ -619,7 +678,7 @@ class Grid:
             plan.hoods[hid] = self._build_hood_plan(
                 plan, hood_lists[hid], offs,
                 n_inner_arr if hid == DEFAULT_NEIGHBORHOOD_ID else None,
-                hood_gidx[hid], row_by_gidx,
+                hood_gidx[hid], row_by_gidx, hid,
             )
         self._finish_plan(plan)
 
@@ -629,7 +688,7 @@ class Grid:
         neighbor-entry stream, bounded temporaries."""
         layout, hood_data = uniform_mod.build_uniform_plan(
             self.mapping, self.topology, self.neighborhoods, cells, owner,
-            self.n_dev,
+            self.n_dev, cap=self._sticky_cap,
         )
         plan = _Plan(
             cells=cells,
@@ -673,7 +732,7 @@ class Grid:
 
         layout, hood_data = hybrid_mod.build_hybrid_plan(
             self.mapping, self.topology, self.neighborhoods, cells, owner,
-            self.n_dev,
+            self.n_dev, cap=self._sticky_cap,
         )
         plan = _Plan(
             cells=cells,
@@ -716,8 +775,8 @@ class Grid:
     def _finish_plan(self, plan: _Plan):
         plan.epoch = getattr(self, "plan", None).epoch + 1 if getattr(self, "plan", None) else 0
         self.plan = plan
-        self._exchange_cache.clear()
-        self._stencil_cache.clear()
+        # compiled programs are shape-keyed and survive the epoch; the
+        # per-epoch device tables live on the (replaced) hood plans
 
         self._update_data_items()
 
@@ -733,7 +792,7 @@ class Grid:
             _verify.pin_requests_succeeded(self)
 
     def _build_hood_plan(self, plan: _Plan, nl, offsets, n_inner_arr, gidx,
-                         row_by_gidx):
+                         row_by_gidx, hid):
         n_dev, L, R = plan.n_dev, plan.L, plan.R
         cells, owner = plan.cells, plan.owner
 
@@ -771,7 +830,7 @@ class Grid:
                 np.where(change, np.arange(n), 0)
             )
             slot = np.arange(n) - group_start
-            S = max(1, int(slot.max()) + 1)
+            S = self._sticky_cap(("S", hid), max(1, int(slot.max()) + 1))
             rows = np.full((n_dev * L * S,), R - 1, dtype=np.int32)
             offs = np.zeros((n_dev * L * S, 3), dtype=np.int32)
             mask = np.zeros((n_dev * L * S,), dtype=bool)
@@ -804,7 +863,10 @@ class Grid:
             gowner = owner[np.searchsorted(cells, gids)]
             for p in range(n_dev):
                 pair_ids[p][q] = gids[gowner == p]
-        M = max(1, max(len(pair_ids[p][q]) for p in range(n_dev) for q in range(n_dev)))
+        M = self._sticky_cap(
+            ("M", hid),
+            max(1, max(len(pair_ids[p][q]) for p in range(n_dev) for q in range(n_dev))),
+        )
         send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
         recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
         for p in range(n_dev):
@@ -1277,10 +1339,12 @@ class Grid:
             if field not in self.fields:
                 raise KeyError(f"unknown field {field!r}")
             self._transfer_predicates[field] = fn
-        # both caches bake the pair tables into jitted closures: the
-        # exchange functions AND the run_steps loops
-        self._exchange_cache.clear()
-        self._stencil_cache.clear()
+        # pair tables are runtime arguments of the compiled programs;
+        # only the cached (host + device) tables need rebuilding
+        for hood in self.plan.hoods.values():
+            hood._pair_host.clear()
+            for k in [k for k in hood._dev if isinstance(k, tuple) and k[0] == "pair"]:
+                del hood._dev[k]
 
     def _field_pair_tables(self, neighborhood_id, field):
         """(send_rows, recv_rows) for one field: the neighborhood's
@@ -1289,8 +1353,7 @@ class Grid:
         fn = self._transfer_predicates.get(field)
         if fn is None:
             return hood.send_rows, hood.recv_rows
-        key = (self.plan.epoch, neighborhood_id, field, "pairpred")
-        cached = self._exchange_cache.get(key)
+        cached = hood._pair_host.get(field)
         if cached is not None:
             return cached
         send = hood.send_rows.copy()
@@ -1309,48 +1372,31 @@ class Grid:
                 drop = valid[~keep]
                 send[p, q, drop] = -1
                 recv[q, p, drop] = -1
-        self._exchange_cache[key] = (send, recv)
+        hood._pair_host[field] = (send, recv)
         return send, recv
 
-    def _exchange_fn(self, neighborhood_id, field_names):
-        """Fused halo exchange: the split-phase start/finish programs
-        composed under one jit (XLA fuses them into one program)."""
-        key = (self.plan.epoch, neighborhood_id, field_names)
-        fn = self._exchange_cache.get(key)
-        if fn is not None:
-            return fn
-        start, finish = self._exchange_split_fns(neighborhood_id, field_names)
-
-        @jax.jit
-        def exchange(*fields):
-            return finish(*start(*fields), *fields)
-
-        self._exchange_cache[key] = exchange
-        return exchange
-
-    def _exchange_split_fns(self, neighborhood_id, field_names):
-        """Split-phase halo exchange as two jitted programs.
-
-        ``start`` runs the all_to_all and returns only the received
-        ghost payload; ``finish`` scatters that payload into the
-        *current* field arrays, touching ghost rows only — the
-        reference's receives write ``remote_neighbors`` exclusively
-        (dccrg.hpp:10726-10935), so user writes to local rows between
-        start and wait must survive."""
-        key = (self.plan.epoch, neighborhood_id, field_names, "split")
-        fns = self._exchange_cache.get(key)
-        if fns is not None:
-            return fns
-        R = self.plan.R
+    def _pair_tables_device(self, neighborhood_id, field_names):
+        """Per-field (send, recv) device tables, hood-memoized."""
+        hood = self.plan.hoods[neighborhood_id]
         sh = self._sharding()
-        # per-field pair tables: a field with a transfer predicate
-        # moves a filtered subset of the neighborhood's list
-        tables = [self._field_pair_tables(neighborhood_id, n) for n in field_names]
-        sends = tuple(jax.device_put(jnp.asarray(s), sh) for s, _ in tables)
-        recvs = tuple(jax.device_put(jnp.asarray(r), sh) for _, r in tables)
+        sends, recvs = [], []
+        for n in field_names:
+            s, r = self._field_pair_tables(neighborhood_id, n)
+            sends.append(hood.dev(("pair", n, "s"), s, sh))
+            recvs.append(hood.dev(("pair", n, "r"), r, sh))
+        return tuple(sends), tuple(recvs)
+
+    def _exchange_programs(self, n_f):
+        """(start, finish, fused) jitted exchange programs for n_f
+        fields — tables and field arrays are arguments, so one program
+        serves every epoch whose (bucketed) shapes match."""
+        key = ("exchange", n_f, self.plan.R)
+        hit = self._program_cache.get(key)
+        if hit is not None:
+            return hit
+        R = self.plan.R
         axis = self.axis
         mesh = self.mesh
-        n_f = len(field_names)
 
         def start_body(*args):
             send_rs, fields = args[:n_f], args[n_f:]
@@ -1389,17 +1435,40 @@ class Grid:
             out_specs=(P(axis),) * n_f,
         )
 
+        start = jax.jit(lambda *a: start_mapped(*a))
+        finish = jax.jit(lambda *a: finish_mapped(*a))
+
         @jax.jit
+        def fused(*args):
+            sends = args[:n_f]
+            recvs = args[n_f : 2 * n_f]
+            fields = args[2 * n_f :]
+            bufs = start_mapped(*sends, *fields)
+            return finish_mapped(*recvs, *bufs, *fields)
+
+        hit = (start, finish, fused)
+        self._program_cache[key] = hit
+        return hit
+
+    def _exchange_split_fns(self, neighborhood_id, field_names):
+        """Split-phase halo exchange: ``start`` runs the all_to_all and
+        returns only the received ghost payload; ``finish`` scatters
+        that payload into the *current* field arrays, touching ghost
+        rows only — the reference's receives write ``remote_neighbors``
+        exclusively (dccrg.hpp:10726-10935), so user writes to local
+        rows between start and wait must survive. Returns callables
+        bound to this epoch's pair tables; the underlying compiled
+        programs are shared across epochs."""
+        start_j, finish_j, _fused = self._exchange_programs(len(field_names))
+        sends, recvs = self._pair_tables_device(neighborhood_id, field_names)
+
         def start(*fields):
-            return start_mapped(*sends, *fields)
+            return start_j(*sends, *fields)
 
-        @jax.jit
         def finish(*bufs_and_fields):
-            return finish_mapped(*recvs, *bufs_and_fields)
+            return finish_j(*recvs, *bufs_and_fields)
 
-        fns = (start, finish)
-        self._exchange_cache[key] = fns
-        return fns
+        return start, finish
 
     def update_copies_of_remote_neighbors(
         self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID, fields=None
@@ -1412,8 +1481,9 @@ class Grid:
         if self.n_dev == 1:
             return
         names = tuple(sorted(fields)) if fields is not None else tuple(sorted(self.fields))
-        fn = self._exchange_fn(neighborhood_id, names)
-        out = fn(*(self.data[n] for n in names))
+        _start, _finish, fused = self._exchange_programs(len(names))
+        sends, recvs = self._pair_tables_device(neighborhood_id, names)
+        out = fused(*sends, *recvs, *(self.data[n] for n in names))
         for n, arr in zip(names, out):
             self.data[n] = arr
 
@@ -1511,18 +1581,12 @@ class Grid:
         """
         fields_in = tuple(fields_in)
         fields_out = tuple(fields_out)
-        key = (
-            self.plan.epoch, neighborhood_id, fields_in, fields_out, include_to,
-            kernel, len(extra_args),
+        fn, tables = self._make_stencil(
+            kernel, fields_in, fields_out, neighborhood_id, include_to,
+            n_extra=len(extra_args),
         )
-        fn = self._stencil_cache.get(key)
-        if fn is None:
-            fn = self._make_stencil(
-                kernel, fields_in, fields_out, neighborhood_id, include_to,
-                n_extra=len(extra_args),
-            )
-            self._stencil_cache[key] = fn
-        out = fn(*(self.data[n] for n in fields_in), *(self.data[n] for n in fields_out), *extra_args)
+        out = fn(*tables, *(self.data[n] for n in fields_in),
+                 *(self.data[n] for n in fields_out), *extra_args)
         for n, arr in zip(fields_out, out):
             self.data[n] = arr
 
@@ -1543,62 +1607,73 @@ class Grid:
 
     def _make_stencil(self, kernel, fields_in, fields_out, neighborhood_id, include_to,
                       n_extra=0):
+        """(program, bound tables) for a gather stencil. The jitted
+        program takes every table as an argument and is cached by its
+        STATIC signature (capacities, flags, kernel) — bucketed plan
+        rebuilds reuse it; only the table values re-upload."""
         hood = self.plan.hoods[neighborhood_id]
         L, R = self.plan.L, self.plan.R
         sh = self._sharding()
         split = hood.hard_nbr_rows is not None and not include_to
+        merged = include_to and hood.hard_nbr_rows is not None
         roll = None
-        if include_to and hood.hard_nbr_rows is not None:
-            # include_to on a split-table plan: rare API-parity path,
-            # served by the merged dense tables
-            m_rows, m_offs, m_mask = hood.merged_of_tables(R - 1)
+        if merged:
             uniform_offs = False
-            nbr_rows = jax.device_put(jnp.asarray(m_rows), sh)
-            nbr_offs = jax.device_put(jnp.asarray(m_offs), sh)
-            nbr_mask = jax.device_put(jnp.asarray(m_mask), sh)
+            if "m_rows" not in hood._dev:
+                m_rows, m_offs, m_mask = hood.merged_of_tables(R - 1)
+                hood.dev("m_rows", m_rows, sh)
+                hood.dev("m_offs", m_offs, sh)
+                hood.dev("m_mask", m_mask, sh)
+            tables = [hood._dev["m_rows"], hood._dev["m_offs"],
+                      hood._dev["m_mask"]]
         else:
             uniform_offs = hood.offs_const is not None
-            # affine tables lower the gather to rolls + sparse fixups
-            # (the dense [n_dev, L, S] row table then never ships)
-            roll = (hood.roll_plan(L)
+            roll = (hood.roll_plan(
+                        L, cap=lambda n: self._sticky_cap(("rollW", neighborhood_id), n))
                     if uniform_offs and not include_to and self._use_roll_gather()
                     else None)
             if roll is not None:
-                r_shifts = tuple(int(s) for s in roll[0])
-                r_wrongr = jax.device_put(jnp.asarray(roll[1]), sh)
-                r_wrongs = jax.device_put(jnp.asarray(roll[2]), sh)
-                nbr_rows = jax.device_put(
-                    jnp.zeros((self.n_dev, 1, 1), jnp.int32), sh
-                )
+                tables = [hood.dev("roll_dummy",
+                                   np.zeros((self.n_dev, 1, 1), np.int32), sh)]
             else:
-                nbr_rows = jax.device_put(jnp.asarray(hood.nbr_rows), sh)
+                tables = [hood.dev("nbr_rows", hood.nbr_rows, sh)]
             if uniform_offs:
-                # per-slot constant offsets: synthesized in-body from the
-                # mask instead of storing [n_dev, L, S, 3] in HBM
-                nbr_offs = jnp.asarray(hood.offs_const)  # [S, 3] replicated
+                # per-slot constant offsets: synthesized in-body from
+                # the mask instead of storing [n_dev, L, S, 3] in HBM
+                tables.append(hood.dev("offs_const", hood.offs_const))
             else:
-                nbr_offs = jax.device_put(jnp.asarray(hood.nbr_offs), sh)
-            nbr_mask = jax.device_put(jnp.asarray(hood.nbr_mask), sh)
-        if include_to or not uniform_offs:
-            roll = None
+                tables.append(hood.dev("nbr_offs", hood.nbr_offs, sh))
+            tables.append(hood.dev("nbr_mask", hood.nbr_mask, sh))
+        r_shifts = tuple(int(s) for s in roll[0]) if roll is not None else None
+        if roll is not None:
+            tables.append(hood.dev("roll_wr", roll[1], sh))
+            tables.append(hood.dev("roll_ws", roll[2], sh))
         scaled = uniform_offs and hood.scale_rows is not None
         if scaled:
-            scale_arr = jax.device_put(jnp.asarray(hood.scale_rows), sh)
+            tables.append(hood.dev("scale_rows", hood.scale_rows, sh))
         if split:
-            h_rows = jax.device_put(jnp.asarray(hood.hard_rows), sh)
-            h_nrows = jax.device_put(jnp.asarray(hood.hard_nbr_rows), sh)
-            h_offs = jax.device_put(jnp.asarray(hood.hard_offs), sh)
-            h_mask = jax.device_put(jnp.asarray(hood.hard_mask), sh)
+            tables.append(hood.dev("hard_rows", hood.hard_rows, sh))
+            tables.append(hood.dev("hard_nbr_rows", hood.hard_nbr_rows, sh))
+            tables.append(hood.dev("hard_offs", hood.hard_offs, sh))
+            tables.append(hood.dev("hard_mask", hood.hard_mask, sh))
         if include_to:
-            to_rows = jax.device_put(jnp.asarray(hood.to_rows), sh)
-            to_offs = jax.device_put(jnp.asarray(hood.to_offs), sh)
-            to_mask = jax.device_put(jnp.asarray(hood.to_mask), sh)
+            tables.append(hood.dev("to_rows", hood.to_rows, sh))
+            tables.append(hood.dev("to_offs", hood.to_offs, sh))
+            tables.append(hood.dev("to_mask", hood.to_mask, sh))
+
+        key = ("stencil", kernel, fields_in, fields_out, include_to, n_extra,
+               L, R, uniform_offs, scaled, split, merged, r_shifts)
+        fn = self._program_cache.get(key)
+        if fn is not None:
+            return fn, tables
+
         n_in, n_out = len(fields_in), len(fields_out)
         axis, mesh = self.axis, self.mesh
+        use_roll = r_shifts is not None
 
         def body(nrows, noffs, nmask, *args):
             nrows, nmask = nrows[0], nmask[0]
-            if roll is not None:
+            if use_roll:
                 wr, ws, *args = args
                 wr, ws = wr[0], ws[0]
             if scaled:
@@ -1622,7 +1697,7 @@ class Grid:
             cell_fields = {n: f[0][:L] for n, f in zip(fields_in, ins)}
 
             def gather_nbr(fl):
-                if roll is None:
+                if not use_roll:
                     return fl[nrows]
                 cols = [jnp.roll(fl[:L], -s, axis=0) for s in r_shifts]
                 st = jnp.stack(cols, axis=1)  # [L, S, ...]
@@ -1664,31 +1739,22 @@ class Grid:
                 outs.append(fl[None])
             return tuple(outs)
 
-        split_specs = (P(axis),) * 4 if split else ()
-        to_specs = (P(axis), P(axis), P(axis)) if include_to else ()
         mapped = _shard_map(
             body,
             mesh=mesh,
             in_specs=(P(axis), P() if uniform_offs else P(axis), P(axis))
-            + ((P(axis), P(axis)) if roll is not None else ())
+            + ((P(axis), P(axis)) if use_roll else ())
             + ((P(axis),) if scaled else ())
-            + split_specs
-            + to_specs
+            + ((P(axis),) * 4 if split else ())
+            + ((P(axis), P(axis), P(axis)) if include_to else ())
             + (P(axis),) * (n_in + n_out) + (P(),) * n_extra,
             out_specs=(P(axis),) * n_out,
             check_vma=False,
         )
 
-        @jax.jit
-        def run(*args):
-            pre = (r_wrongr, r_wrongs) if roll is not None else ()
-            pre += (scale_arr,) if scaled else ()
-            pre += (h_rows, h_nrows, h_offs, h_mask) if split else ()
-            if include_to:
-                return mapped(nbr_rows, nbr_offs, nbr_mask, *pre, to_rows, to_offs, to_mask, *args)
-            return mapped(nbr_rows, nbr_offs, nbr_mask, *pre, *args)
-
-        return run
+        fn = jax.jit(lambda *a: mapped(*a))
+        self._program_cache[key] = fn
+        return fn, tables
 
     # -- fused multi-step execution ------------------------------------
 
@@ -1716,9 +1782,11 @@ class Grid:
 
         ``exchange_fields`` must be a subset of ``fields_out`` (fields
         that change per step); static fields' ghosts are assumed valid
-        for the whole epoch. Returns ``fn(n_steps, *in, *out, *extra)
-        -> out arrays`` where ``n_steps`` is dynamic (no recompile per
-        step count). Use :meth:`run_steps` for the stateful wrapper.
+        for the whole epoch. Returns ``(fn, tables, static_in)`` where
+        ``fn(n_steps, *tables, *in, *out, *extra) -> out arrays`` with
+        dynamic ``n_steps``; the program is cached by its static shape
+        signature and survives (bucketed) structure epochs. Use
+        :meth:`run_steps` for the stateful wrapper.
         """
         fields_in = tuple(fields_in)
         fields_out = tuple(fields_out)
@@ -1735,37 +1803,50 @@ class Grid:
         sh = self._sharding()
         uniform_offs = hood.offs_const is not None
         split = hood.hard_nbr_rows is not None
-        roll = (hood.roll_plan(L)
+        roll = (hood.roll_plan(
+                    L, cap=lambda n: self._sticky_cap(("rollW", neighborhood_id), n))
                 if uniform_offs and self._use_roll_gather() else None)
-        if roll is not None:
-            r_shifts = tuple(int(s) for s in roll[0])
-            r_wrongr = jax.device_put(jnp.asarray(roll[1]), sh)
-            r_wrongs = jax.device_put(jnp.asarray(roll[2]), sh)
-            nbr_rows = jax.device_put(jnp.zeros((self.n_dev, 1, 1), jnp.int32), sh)
-        else:
-            nbr_rows = jax.device_put(jnp.asarray(hood.nbr_rows), sh)
-        if uniform_offs:
-            nbr_offs = jnp.asarray(hood.offs_const)  # [S, 3] replicated
-        else:
-            nbr_offs = jax.device_put(jnp.asarray(hood.nbr_offs), sh)
-        nbr_mask = jax.device_put(jnp.asarray(hood.nbr_mask), sh)
-        scaled = uniform_offs and hood.scale_rows is not None
-        if scaled:
-            scale_arr = jax.device_put(jnp.asarray(hood.scale_rows), sh)
-        if split:
-            h_rows = jax.device_put(jnp.asarray(hood.hard_rows), sh)
-            h_nrows = jax.device_put(jnp.asarray(hood.hard_nbr_rows), sh)
-            h_offs = jax.device_put(jnp.asarray(hood.hard_offs), sh)
-            h_mask = jax.device_put(jnp.asarray(hood.hard_mask), sh)
+        r_shifts = tuple(int(s) for s in roll[0]) if roll is not None else None
+        use_roll = r_shifts is not None
         static_in = tuple(n for n in fields_in if n not in fields_out)
         n_static, n_out = len(static_in), len(fields_out)
         exch_idx = tuple(fields_out.index(n) for n in exchange_fields)
-        # per-exchanged-field pair tables (transfer predicates filter)
-        pair = [self._field_pair_tables(neighborhood_id, fields_out[j])
-                for j in exch_idx]
-        sends = tuple(jax.device_put(jnp.asarray(s), sh) for s, _ in pair)
-        recvs = tuple(jax.device_put(jnp.asarray(r), sh) for _, r in pair)
         n_x = len(exch_idx)
+
+        tables = []
+        if use_roll:
+            tables.append(hood.dev("roll_dummy",
+                                   np.zeros((self.n_dev, 1, 1), np.int32), sh))
+        else:
+            tables.append(hood.dev("nbr_rows", hood.nbr_rows, sh))
+        if uniform_offs:
+            tables.append(hood.dev("offs_const", hood.offs_const))
+        else:
+            tables.append(hood.dev("nbr_offs", hood.nbr_offs, sh))
+        tables.append(hood.dev("nbr_mask", hood.nbr_mask, sh))
+        sends, recvs = self._pair_tables_device(
+            neighborhood_id, tuple(fields_out[j] for j in exch_idx)
+        )
+        tables.extend(sends)
+        tables.extend(recvs)
+        if use_roll:
+            tables.append(hood.dev("roll_wr", roll[1], sh))
+            tables.append(hood.dev("roll_ws", roll[2], sh))
+        scaled = uniform_offs and hood.scale_rows is not None
+        if scaled:
+            tables.append(hood.dev("scale_rows", hood.scale_rows, sh))
+        if split:
+            tables.append(hood.dev("hard_rows", hood.hard_rows, sh))
+            tables.append(hood.dev("hard_nbr_rows", hood.hard_nbr_rows, sh))
+            tables.append(hood.dev("hard_offs", hood.hard_offs, sh))
+            tables.append(hood.dev("hard_mask", hood.hard_mask, sh))
+
+        key = ("steploop", kernel, fields_in, fields_out, exch_idx, n_extra,
+               L, R, uniform_offs, scaled, split, r_shifts)
+        fn = self._program_cache.get(key)
+        if fn is not None:
+            return fn, tables, static_in
+
         axis, mesh, n_dev = self.axis, self.mesh, self.n_dev
 
         def body(n_steps, nrows, noffs, nmask, *args):
@@ -1773,7 +1854,7 @@ class Grid:
             recv_rs = [a[0] for a in args[n_x:2 * n_x]]
             args = args[2 * n_x:]
             nrows, nmask = nrows[0], nmask[0]
-            if roll is not None:
+            if use_roll:
                 wr, ws, *args = args
                 wr, ws = wr[0], ws[0]
             if scaled:
@@ -1791,7 +1872,7 @@ class Grid:
             rrs = [jnp.where(rv >= 0, rv, R - 1).reshape(-1) for rv in recv_rs]
 
             def gather_nbr(fl):
-                if roll is None:
+                if not use_roll:
                     return fl[nrows]
                 cols = [jnp.roll(fl[:L], -s, axis=0) for s in r_shifts]
                 st = jnp.stack(cols, axis=1)  # [L, S, ...]
@@ -1804,6 +1885,7 @@ class Grid:
                 )
                 mexp = nmask.reshape(nmask.shape + (1,) * (st.ndim - 2))
                 return jnp.where(mexp, st, jnp.zeros((), st.dtype))
+
             statics = {n: a[0] for n, a in zip(static_in, args[:n_static])}
             state0 = tuple(a[0] for a in args[n_static:n_static + n_out])
             extra = args[n_static + n_out:]
@@ -1848,7 +1930,7 @@ class Grid:
             in_specs=(P(), P(axis),
                       P() if uniform_offs else P(axis), P(axis))
             + (P(axis),) * (2 * n_x)
-            + ((P(axis), P(axis)) if roll is not None else ())
+            + ((P(axis), P(axis)) if use_roll else ())
             + ((P(axis),) if scaled else ())
             + ((P(axis),) * 4 if split else ())
             + (P(axis),) * (n_static + n_out) + (P(),) * n_extra,
@@ -1856,15 +1938,9 @@ class Grid:
             check_vma=False,
         )
 
-        @jax.jit
-        def run(n_steps, *args):
-            pre = (r_wrongr, r_wrongs) if roll is not None else ()
-            pre += (scale_arr,) if scaled else ()
-            pre += (h_rows, h_nrows, h_offs, h_mask) if split else ()
-            return mapped(n_steps, nbr_rows, nbr_offs, nbr_mask,
-                          *sends, *recvs, *pre, *args)
-
-        return run, static_in
+        fn = jax.jit(lambda *a: mapped(*a))
+        self._program_cache[key] = fn
+        return fn, tables, static_in
 
     def run_steps(
         self,
@@ -1880,21 +1956,13 @@ class Grid:
         results (see compile_step_loop)."""
         fields_in = tuple(fields_in)
         fields_out = tuple(fields_out)
-        key = (
-            self.plan.epoch, "steploop", neighborhood_id, fields_in, fields_out,
-            tuple(exchange_fields) if exchange_fields is not None else None,
-            kernel, len(extra_args),
+        fn, tables, static_in = self.compile_step_loop(
+            kernel, fields_in, fields_out, exchange_fields,
+            neighborhood_id, n_extra=len(extra_args),
         )
-        entry = self._stencil_cache.get(key)
-        if entry is None:
-            entry = self.compile_step_loop(
-                kernel, fields_in, fields_out, exchange_fields,
-                neighborhood_id, n_extra=len(extra_args),
-            )
-            self._stencil_cache[key] = entry
-        fn, static_in = entry
         out = fn(
             jnp.int32(n_steps),
+            *tables,
             *(self.data[n] for n in static_in),
             *(self.data[n] for n in fields_out),
             *extra_args,

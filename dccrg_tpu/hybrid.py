@@ -130,7 +130,8 @@ class _LevelBlock:
         return out
 
 
-def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev):
+def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
+                      cap=None):
     """All plan pieces for a refined grid.
 
     Returns ``(layout, hood_data)`` like uniform.build_uniform_plan:
@@ -282,10 +283,15 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev):
         ghost_pos_sorted.append(gp)
         ghost_ids.append(cells[gp])
 
+    from .grid import bucket_capacity
+
+    if cap is None:
+        cap = lambda name, needed: bucket_capacity(needed)
     n_local = np.array([len(x) for x in local_ids], dtype=np.int64)
     n_ghost = np.array([len(x) for x in ghost_ids], dtype=np.int64)
-    L = max(1, int(n_local.max()))
+    L = cap("L", max(1, int(n_local.max())))
     G = int(n_ghost.max()) if n_dev > 1 else 0
+    G = cap("G", G) if G else 0
     R = L + G + 1  # final row = permanent zero pad
 
     row_of_pos = np.full(n, -1, dtype=np.int32)
@@ -407,7 +413,7 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev):
             changed[1:] = s_p[1:] != s_p[:-1]
             gstart = np.maximum.accumulate(np.where(changed, np.arange(nE), 0))
             slot = np.arange(nE) - gstart
-            S_hard = max(1, int(slot.max()) + 1)
+            S_hard = cap(("S_hard", hid), max(1, int(slot.max()) + 1))
             hdev = owner[s_p].astype(np.int64)
             hrow = hdev * L + row_of_pos[s_p]
             urow, uinv = np.unique(hrow, return_inverse=True)
@@ -415,7 +421,7 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev):
             dev_start = np.searchsorted(ud, np.arange(n_dev))
             dense_idx = np.arange(len(urow)) - dev_start[ud]
             counts = np.bincount(ud, minlength=n_dev)
-            Hmax = max(1, int(counts.max()))
+            Hmax = cap(("Hmax", hid), max(1, int(counts.max())))
             hard_rows_dev = np.full((n_dev, Hmax), L, dtype=np.int32)  # pad=L: dropped
             hard_nbr_dev = np.full((n_dev, Hmax, S_hard), R - 1, dtype=np.int32)
             hard_offs_dev = np.zeros((n_dev, Hmax, S_hard, 3), dtype=np.int32)
@@ -459,6 +465,7 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev):
         for p in range(n_dev):
             pair_pos[p][q] = gp[gowner == p]
             M = max(M, len(pair_pos[p][q]))
+    M = cap(("M", "hybrid"), M)
     send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
     recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
     for p in range(n_dev):
@@ -553,7 +560,7 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev):
                 gstart = np.maximum.accumulate(np.where(changed, np.arange(nT), 0))
                 tslot = np.arange(nT) - gstart
                 tslot += np.where(is_hard_target[tv], 0, k)
-                T_hard = int(tslot.max()) + 1
+                T_hard = cap(("T_hard", hid), int(tslot.max()) + 1)
             else:
                 tslot = np.empty(0, dtype=np.int64)
                 T_hard = 0

@@ -219,5 +219,5 @@ class ParticleModel:
         pad[:, : old_pos.shape[1]] = old_pos
         g.set("pos", cells, pad)
         g.set("count", cells, cnt)
-        g._stencil_cache.clear()
-        g._exchange_cache.clear()
+        # compiled programs are shape-keyed: the new capacity simply
+        # retraces; no cache invalidation needed

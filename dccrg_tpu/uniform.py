@@ -70,7 +70,8 @@ class _NeighborMaps:
         return ng.reshape(-1), valid.reshape(-1)
 
 
-def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev):
+def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
+                       cap=None):
     """All plan pieces for a level-0-only grid.
 
     Returns ``(layout, hood_data)`` where layout is a dict with
@@ -135,10 +136,15 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev):
         ghost_gidx.append(gg.astype(np.int64))
         ghost_ids.append((gg.astype(np.uint64) + 1))
 
+    from .grid import bucket_capacity
+
+    if cap is None:
+        cap = lambda name, needed: bucket_capacity(needed)
     n_local = np.array([len(x) for x in local_ids], dtype=np.int64)
     n_ghost = np.array([len(x) for x in ghost_ids], dtype=np.int64)
-    L = max(1, int(n_local.max()))
+    L = cap("L", max(1, int(n_local.max())))
     G = int(n_ghost.max()) if n_dev > 1 else 0
+    G = cap("G", G) if G else 0
     R = L + G + 1  # final row = permanent zero pad
 
     row_of_pos = np.full(n0, -1, dtype=np.int32)
@@ -177,7 +183,8 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev):
         gowner = owner[gg]
         for p in range(n_dev):
             pair_gidx[p][q] = gg[gowner == p]
-    M = max(1, max(len(pair_gidx[p][q]) for p in range(n_dev) for q in range(n_dev)))
+    M = cap(("M", "uniform"),
+            max(1, max(len(pair_gidx[p][q]) for p in range(n_dev) for q in range(n_dev))))
     send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
     recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
     for p in range(n_dev):
@@ -241,7 +248,13 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev):
             if n_dev > 1:  # single device emits no cross sentinels
                 grows = fixup_sentinels(grows)
             if identity_perm:
-                rows_t, mask_t = grows, gmask
+                # rows are gidx order, but L may exceed n0 (bucketed
+                # capacity): place the lattice block, pad the rest
+                rows_t = np.full((n_dev * L, k), R - 1, dtype=np.int32)
+                mask_t = np.zeros((n_dev * L, k), dtype=bool)
+                rows_t[:n0] = grows
+                mask_t[:n0] = gmask
+                del grows, gmask
             else:
                 rows_t = np.empty((n_dev * L, k), dtype=np.int32)
                 mask_t = np.empty((n_dev * L, k), dtype=bool)
