@@ -2306,16 +2306,26 @@ class Grid:
             src2 = src.reshape(self.n_dev, self.plan.R)
             src_dev = jax.device_put(jnp.asarray(src2), sh)
             mask_dev = jax.device_put(jnp.asarray(src2 >= 0), sh)
-            n_dev, R_old = self.n_dev, old_R
+            n_dev = self.n_dev
 
-            @partial(jax.jit, static_argnums=(3,), out_shardings=sh)
-            def move(old, srcs, mask, n_extra_dims):
-                flat = old.reshape((n_dev * R_old,) + old.shape[2:])
-                g = flat[jnp.clip(srcs, 0)]
-                return jnp.where(mask.reshape(mask.shape + (1,) * n_extra_dims), g, 0)
+            def move_for(n_extra_dims):
+                key = ("restructure_move", n_extra_dims)
+                fn = self._program_cache.get(key)
+                if fn is None:
+                    @partial(jax.jit, out_shardings=sh)
+                    def fn(old, srcs, mask):
+                        flat = old.reshape((-1,) + old.shape[2:])
+                        g = flat[jnp.clip(srcs, 0)]
+                        return jnp.where(
+                            mask.reshape(mask.shape + (1,) * n_extra_dims), g, 0
+                        )
+                    self._program_cache[key] = fn
+                return fn
 
             for name, (shape, dtype) in self.fields.items():
-                self.data[name] = move(self.data[name], src_dev, mask_dev, len(shape))
+                self.data[name] = move_for(len(shape))(
+                    self.data[name], src_dev, mask_dev
+                )
         else:
             keep = src >= 0
             srcc = np.clip(src, 0, None)
