@@ -531,7 +531,7 @@ class Grid:
     # capacities whose arrays are small but whose need varies a lot
     # epoch-to-epoch (hard-shell sizes, pair lists, fixup widths):
     # give them a 2x band so shapes virtually never change
-    _WIDE_CAPS = ("G", "M", "S", "S_hard", "Hmax", "T_hard", "rollW")
+    _WIDE_CAPS = ("G", "M", "S", "S_hard", "Hmax", "T_hard", "rollW", "removed")
 
     def _sticky_cap(self, name, needed: int) -> int:
         """Capacity with hysteresis: grow in buckets with headroom,
@@ -2262,8 +2262,20 @@ class Grid:
         old_ids = np.concatenate([res.refined_parents, res.removed_cells])
         self._removed_data = {}
         if len(old_ids):
+            # gather the disappearing cells' rows ON DEVICE and pull
+            # only that slice (not every field's full array); padded to
+            # a sticky capacity so the gather doesn't retrace per epoch
+            dev, rows = self._host_rows(old_ids)
+            n_old = len(old_ids)
+            capn = self._sticky_cap("removed", n_old)
+            dpad = np.zeros(capn, dtype=np.int64)
+            rpad = np.zeros(capn, dtype=np.int64)
+            dpad[:n_old] = dev
+            rpad[:n_old] = rows
             for name in self.fields:
-                self._removed_data[name] = (old_ids, self.get(name, old_ids))
+                self._removed_data[name] = (
+                    old_ids, np.asarray(self.data[name][dpad, rpad])[:n_old]
+                )
         else:
             self._removed_data = {name: (old_ids, None) for name in self.fields}
         self._removed_cells = res.removed_cells
