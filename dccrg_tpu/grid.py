@@ -544,6 +544,8 @@ class Grid:
         base = name[0] if isinstance(name, tuple) else name
         wide = base in self._WIDE_CAPS
         prev = self._cap_memo.get(name)
+        if prev is not None and needed <= prev and base == "removed":
+            return prev  # tiny index buffer: never shrink
         if prev is not None and prev // (4 if wide else 2) <= needed <= prev:
             return prev
         # headroom absorbs drift (a refined region that wanders grows
