@@ -475,6 +475,10 @@ class Grid:
         self.initialized = True
         self._build_plan(cells, owner)
         self._allocate_fields()
+        if self._debug:
+            from . import verify as _verify
+
+            _verify.pin_requests_succeeded(self)
         return self
 
     def clone(self, cell_data=None) -> "Grid":
@@ -791,7 +795,10 @@ class Grid:
             _verify.is_consistent(self)
             _verify.verify_neighbors(self)
             _verify.verify_remote_neighbor_info(self)
-            _verify.pin_requests_succeeded(self)
+            # pin placement is checked where pins are APPLIED
+            # (initialize / balance_load / load_cells): a pin made
+            # between balance_loads only takes effect at the next one
+            # (dccrg.hpp:5913-6139)
 
     def _build_hood_plan(self, plan: _Plan, nl, offsets, n_inner_arr, gidx,
                          row_by_gidx, hid):
@@ -2059,6 +2066,10 @@ class Grid:
         staged = self._staged_balance
         self._staged_balance = {}
         self._restructure(self.plan.cells.copy(), new_owner)
+        if self._debug:
+            from . import verify as _verify
+
+            _verify.pin_requests_succeeded(self)
         for n, (ids, vals) in staged.items():
             if vals is None or n not in self.fields:
                 continue
@@ -2419,6 +2430,10 @@ class Grid:
         )
         self._build_plan(cells, owner)
         self._allocate_fields()
+        if self._debug:
+            from . import verify as _verify
+
+            _verify.pin_requests_succeeded(self)
 
     # -- VTK output (dccrg.hpp:3320-3392) ------------------------------
 
