@@ -25,6 +25,7 @@ TPU-native equivalent of the reference's ``class Dccrg``
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field as dataclass_field
 from functools import partial
 
@@ -76,7 +77,6 @@ def _tune_allocator():
     if _allocator_tuned:
         return
     _allocator_tuned = True
-    import os
 
     if os.environ.get("DCCRG_NO_MALLOPT") == "1":
         return
@@ -119,6 +119,29 @@ def bucket_capacity(n: int) -> int:
         return 16
     step = 1 << max(max(n - 1, 1).bit_length() - 3, 0)
     return ((n + step - 1) // step) * step
+
+
+
+def _make_nbr_gather(use_roll, r_shifts, L, nrows, nmask, wr, ws):
+    """Per-device neighbor gather for stencil bodies: a table gather,
+    or S sequential rolls + a sparse fixup scatter when the table is
+    affine (see _HoodPlan.roll_plan). Shared by apply_stencil and the
+    fused step loop."""
+    if not use_roll:
+        return lambda fl: fl[nrows]
+
+    def gather(fl):
+        cols = [jnp.roll(fl[:L], -s, axis=0) for s in r_shifts]
+        st = jnp.stack(cols, axis=1)  # [L, S, ...]
+        rows_flat = wr.reshape(-1)
+        slots_flat = jnp.repeat(
+            jnp.arange(len(r_shifts), dtype=jnp.int32), wr.shape[1]
+        )
+        st = st.at[rows_flat, slots_flat].set(fl[ws.reshape(-1)], mode="drop")
+        mexp = nmask.reshape(nmask.shape + (1,) * (st.ndim - 2))
+        return jnp.where(mexp, st, jnp.zeros((), st.dtype))
+
+    return gather
 
 
 def default_mesh(devices=None) -> Mesh:
@@ -381,8 +404,6 @@ class Grid:
         # that lands in the same buckets reuses every compiled program
         self._program_cache = {}
         self._pending = {}
-        import os
-
         self._debug = os.environ.get("DCCRG_DEBUG") == "1"
         # extensible iteration-cache items (dccrg.hpp:7404-7518)
         self._cell_items = {}
@@ -593,9 +614,7 @@ class Grid:
         # refined grids take the hybrid path (hybrid.py): closed-form
         # tables away from refinement, generic engine for the hard
         # subset near it — O(refinement surface), not O(grid)
-        import os as _os
-
-        if n0 < 2**31 - 2 and _os.environ.get("DCCRG_FORCE_GENERIC") != "1":
+        if n0 < 2**31 - 2 and os.environ.get("DCCRG_FORCE_GENERIC") != "1":
             self._build_plan_hybrid(cells, owner)
             return
 
@@ -1600,6 +1619,9 @@ class Grid:
             self.data[n] = arr
 
 
+    def _on_accelerator(self) -> bool:
+        return self.mesh.devices.flat[0].platform not in ("cpu",)
+
     def _use_roll_gather(self) -> bool:
         """Roll-decomposed gathers trade a dense random gather for S
         sequential rolls + a sparse fixup: a clear win on TPU (random
@@ -1607,12 +1629,10 @@ class Grid:
         the near-sequential gather, the stack materialization doesn't
         pay). Default: on for accelerators, off for CPU; override with
         DCCRG_ROLL_STENCIL=0/1."""
-        import os as _os
-
-        env = _os.environ.get("DCCRG_ROLL_STENCIL")
+        env = os.environ.get("DCCRG_ROLL_STENCIL")
         if env in ("0", "1"):
             return env == "1"
-        return self.mesh.devices.flat[0].platform not in ("cpu",)
+        return self._on_accelerator()
 
     def _make_stencil(self, kernel, fields_in, fields_out, neighborhood_id, include_to,
                       n_extra=0):
@@ -1704,22 +1724,10 @@ class Grid:
             outs_cur = args[n_in: n_in + n_out]
             extra = args[n_in + n_out:]
             cell_fields = {n: f[0][:L] for n, f in zip(fields_in, ins)}
-
-            def gather_nbr(fl):
-                if not use_roll:
-                    return fl[nrows]
-                cols = [jnp.roll(fl[:L], -s, axis=0) for s in r_shifts]
-                st = jnp.stack(cols, axis=1)  # [L, S, ...]
-                rows_flat = wr.reshape(-1)
-                slots_flat = jnp.repeat(
-                    jnp.arange(len(r_shifts), dtype=jnp.int32), wr.shape[1]
-                )
-                st = st.at[rows_flat, slots_flat].set(
-                    fl[ws.reshape(-1)], mode="drop"
-                )
-                mexp = nmask.reshape(nmask.shape + (1,) * (st.ndim - 2))
-                return jnp.where(mexp, st, jnp.zeros((), st.dtype))
-
+            gather_nbr = _make_nbr_gather(
+                use_roll, r_shifts, L, nrows, nmask,
+                wr if use_roll else None, ws if use_roll else None,
+            )
             nbr_fields = {n: gather_nbr(f[0]) for n, f in zip(fields_in, ins)}
             if include_to:
                 to_fields = {n: f[0][trows] for n, f in zip(fields_in, ins)}
@@ -1879,21 +1887,10 @@ class Grid:
                 hr, hnr, hof, hm = hr[0], hnr[0], hof[0], hm[0]
                 hrc = jnp.minimum(hr, L - 1)
             rrs = [jnp.where(rv >= 0, rv, R - 1).reshape(-1) for rv in recv_rs]
-
-            def gather_nbr(fl):
-                if not use_roll:
-                    return fl[nrows]
-                cols = [jnp.roll(fl[:L], -s, axis=0) for s in r_shifts]
-                st = jnp.stack(cols, axis=1)  # [L, S, ...]
-                rows_flat = wr.reshape(-1)
-                slots_flat = jnp.repeat(
-                    jnp.arange(len(r_shifts), dtype=jnp.int32), wr.shape[1]
-                )
-                st = st.at[rows_flat, slots_flat].set(
-                    fl[ws.reshape(-1)], mode="drop"
-                )
-                mexp = nmask.reshape(nmask.shape + (1,) * (st.ndim - 2))
-                return jnp.where(mexp, st, jnp.zeros((), st.dtype))
+            gather_nbr = _make_nbr_gather(
+                use_roll, r_shifts, L, nrows, nmask,
+                wr if use_roll else None, ws if use_roll else None,
+            )
 
             statics = {n: a[0] for n, a in zip(static_in, args[:n_static])}
             state0 = tuple(a[0] for a in args[n_static:n_static + n_out])
@@ -2324,10 +2321,7 @@ class Grid:
         # — move data with an on-device gather. On the CPU backend the
         # "transfer" is a memcpy and the host scatter is cheaper than
         # compiling a per-epoch-shape gather program.
-        on_accel = self.mesh.devices.flat[0].platform not in ("cpu",)
-        import os as _os
-
-        if on_accel or _os.environ.get("DCCRG_DEVICE_RESTRUCTURE") == "1":
+        if self._on_accelerator() or os.environ.get("DCCRG_DEVICE_RESTRUCTURE") == "1":
             src2 = src.reshape(self.n_dev, self.plan.R)
             src_dev = jax.device_put(jnp.asarray(src2), sh)
             mask_dev = jax.device_put(jnp.asarray(src2 >= 0), sh)
