@@ -90,6 +90,15 @@ def _load():
         ctypes.c_int64,                     # capacity
         u64p, i64p,                         # err_cell, err_item
     ]
+    dll.dn_find_neighbors_to_subset.restype = ctypes.c_int64
+    dll.dn_find_neighbors_to_subset.argtypes = [
+        u64p, ctypes.c_int32, u8p,          # grid_length, max_lvl, periodic
+        u64p, ctypes.c_int64,               # cells_sorted, n_cells
+        u64p, ctypes.c_int64,               # query, n_query
+        i64p, ctypes.c_int64,               # hood, n_hood
+        i64p, u64p, i64p, i64p,             # out q/src/off/item
+        ctypes.c_int64,                     # capacity
+    ]
     dll.dn_morton_keys.restype = None
     dll.dn_morton_keys.argtypes = [u64p, ctypes.c_int64, ctypes.c_int32, u64p]
     dll.dn_hilbert_keys.restype = None
@@ -187,6 +196,42 @@ def find_neighbors_of(mapping, topology, all_cells_sorted, query_cells,
             )
         if total <= capacity:
             return src[:total], nbr[:total], off[:total], item[:total]
+        capacity = int(total)
+
+
+def find_neighbors_to_subset_raw(mapping, topology, all_cells_sorted,
+                                 query_cells, neighborhood):
+    """Native raw to-subset enumeration: the candidate entries of
+    neighbors.find_neighbors_to_subset's hard path, duplicates
+    included (the caller dedups/orders exactly as the NumPy path).
+    Returns (q_idx, src_id, off, item)."""
+    cells = np.ascontiguousarray(all_cells_sorted, dtype=np.uint64)
+    query = np.ascontiguousarray(query_cells, dtype=np.uint64)
+    hood = np.ascontiguousarray(neighborhood, dtype=np.int64).reshape(-1, 3)
+    length = np.ascontiguousarray(mapping.length.get(), dtype=np.uint64)
+    periodic = np.array([topology.is_periodic(d) for d in range(3)],
+                        dtype=np.uint8)
+    n, k = len(query), len(hood)
+    capacity = max(2 * n * k + 64, 1)
+    while True:
+        q = np.empty(capacity, dtype=np.int64)
+        srcs = np.empty(capacity, dtype=np.uint64)
+        off = np.empty((capacity, 3), dtype=np.int64)
+        item = np.empty(capacity, dtype=np.int64)
+        total = lib.dn_find_neighbors_to_subset(
+            _ptr(length, ctypes.c_uint64), mapping.max_refinement_level,
+            _ptr(periodic, ctypes.c_uint8),
+            _ptr(cells, ctypes.c_uint64), len(cells),
+            _ptr(query, ctypes.c_uint64), n,
+            _ptr(hood, ctypes.c_int64), k,
+            _ptr(q, ctypes.c_int64), _ptr(srcs, ctypes.c_uint64),
+            _ptr(off, ctypes.c_int64), _ptr(item, ctypes.c_int64),
+            capacity,
+        )
+        if total == -3:
+            raise ValueError("invalid cell id in query")
+        if total <= capacity:
+            return q[:total], srcs[:total], off[:total], item[:total]
         capacity = int(total)
 
 

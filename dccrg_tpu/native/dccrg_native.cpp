@@ -632,4 +632,103 @@ void dn_uniform_tables(int64_t nx, int64_t ny, int64_t nz, int32_t px,
 
 int32_t dn_abi_version(void) { return 1; }
 
+
+// ---------------------------------------------------------------------------
+// Subset neighbors_to: for each query cell v, the cells c with v in
+// their neighbors_of (semantics of ../neighbors.py::
+// find_neighbors_to_subset's enumeration path, itself mirroring
+// dccrg.hpp:4744-4897): candidate window bases are the <=3-per-
+// dimension size_c-aligned positions overlapping v's box, enumerated
+// per (item, source level); a candidate source counts iff it exists as
+// a leaf. Raw entries (duplicates included — the caller dedups exactly
+// like the NumPy path) are ordered by query index.
+
+static inline int64_t dn_floordiv(int64_t a, int64_t b) {
+  int64_t q = a / b, r = a % b;
+  return (r != 0 && ((r < 0) != (b < 0))) ? q - 1 : q;
+}
+
+// Returns total entry count (entries past capacity are counted, not
+// written), or -3 for an invalid query id.
+int64_t dn_find_neighbors_to_subset(
+    const uint64_t grid_length[3], int32_t max_lvl, const uint8_t periodic[3],
+    const uint64_t *cells_sorted, int64_t n_cells, const uint64_t *query,
+    int64_t n_query, const int64_t *hood, int64_t n_hood, int64_t *out_q,
+    uint64_t *out_src, int64_t *out_off, int64_t *out_item,
+    int64_t capacity) {
+  DnMapping m;
+  dn_mapping_init(&m, grid_length, max_lvl);
+  int64_t total = 0;
+  for (int64_t qi = 0; qi < n_query; ++qi) {
+    const uint64_t v = query[qi];
+    const int32_t lvl = dn_level(&m, v);
+    if (lvl < 0)
+      return -3;
+    const int64_t sv = (int64_t)1 << (uint64_t)(m.max_lvl - lvl);
+    uint64_t vb_u[3];
+    dn_indices(&m, v, lvl, vb_u);
+    const int64_t vb[3] = {(int64_t)vb_u[0], (int64_t)vb_u[1],
+                           (int64_t)vb_u[2]};
+    for (int64_t j = 0; j < n_hood; ++j) {
+      const int64_t *o = hood + 3 * j;
+      for (int32_t dlvl = -1; dlvl <= 1; ++dlvl) {
+        const int32_t c_lvl = lvl + dlvl;
+        if (c_lvl < 0 || c_lvl > m.max_lvl)
+          continue;
+        const int64_t sc = (int64_t)1 << (uint64_t)(m.max_lvl - c_lvl);
+        // per-dim aligned window bases overlapping [vb, vb + sv)
+        int64_t w_lo[3];
+        int64_t cnt[3];
+        for (int d = 0; d < 3; ++d) {
+          w_lo[d] = -dn_floordiv(-(vb[d] - sc + 1), sc) * sc;  // ceil*sc
+          cnt[d] = (vb[d] + sv - 1 - w_lo[d]) / sc + 1;
+          if (cnt[d] < 0)
+            cnt[d] = 0;
+        }
+        for (int64_t ix = 0; ix < cnt[0]; ++ix)
+          for (int64_t iy = 0; iy < cnt[1]; ++iy)
+            for (int64_t iz = 0; iz < cnt[2]; ++iz) {
+              const int64_t w[3] = {w_lo[0] + ix * sc, w_lo[1] + iy * sc,
+                                    w_lo[2] + iz * sc};
+              bool ok = true;
+              uint64_t cw[3];
+              for (int d = 0; d < 3; ++d) {
+                const int64_t il = (int64_t)m.index_length[d];
+                const int64_t cb = w[d] - o[d] * sc;
+                if (periodic[d]) {
+                  int64_t r = cb % il;
+                  if (r < 0)
+                    r += il;
+                  cw[d] = (uint64_t)r;
+                } else {
+                  // source cell fully inside, window min inside
+                  if (cb < 0 || cb + sc > il || w[d] < 0 || w[d] >= il) {
+                    ok = false;
+                    break;
+                  }
+                  cw[d] = (uint64_t)cb;
+                }
+              }
+              if (!ok)
+                continue;
+              const uint64_t cid = dn_cell_from_indices(&m, cw, c_lvl);
+              if (!dn_exists(cells_sorted, n_cells, cid))
+                continue;
+              if (total < capacity) {
+                out_q[total] = qi;
+                out_src[total] = cid;
+                // recorded to-offset = -(v.min - c.min in c's frame)
+                //                    = w - vb - o*sc per dimension
+                for (int d = 0; d < 3; ++d)
+                  out_off[3 * total + d] = w[d] - vb[d] - o[d] * sc;
+                out_item[total] = j;
+              }
+              ++total;
+            }
+      }
+    }
+  }
+  return total;
+}
+
 } // extern "C"

@@ -452,6 +452,22 @@ def find_neighbors_to_subset(
         src_pos = np.searchsorted(all_cells_sorted, src)
         order = np.lexsort((item, src_pos, q))
         return q[order], src[order], off[order]
+
+    # hard queries: candidate-window enumeration — native C++ when
+    # available, the NumPy loop below otherwise (identical raw entries)
+    from . import native
+
+    hard_idx = np.nonzero(~easy)[0]
+    if native.lib is not None and len(hard_idx):
+        hq, hsrc, hoff, hitem = native.find_neighbors_to_subset_raw(
+            mapping, topology, all_cells_sorted, query_cells[hard_idx],
+            neighborhood,
+        )
+        out_q.append(hard_idx[hq])
+        out_src.append(hsrc)
+        out_off.append(hoff)
+        out_item.append(hitem)
+        easy = np.ones(m, dtype=bool)  # skip the NumPy enumeration below
     for j, o in enumerate(neighborhood):
         for dlvl in (-1, 0, 1):
             c_lvl = v_lvl + dlvl

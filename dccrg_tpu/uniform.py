@@ -211,7 +211,8 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
         tgt = out if identity_perm else np.empty((n0, k), dtype=glob.dtype)
         B = 1 << 20
         for i in range(0, n0, B):
-            tgt[i: i + B] = glob[:, i: i + B].T
+            end = min(i + B, n0)  # L may exceed n0 (bucketed capacity)
+            tgt[i:end] = glob[:, i:end].T
         if not identity_perm:
             out[perm] = tgt
         return out
