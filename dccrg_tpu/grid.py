@@ -573,10 +573,15 @@ class Grid:
             return prev  # tiny index buffer: never shrink
         if prev is not None and prev // (4 if wide else 2) <= needed <= prev:
             return prev
-        # headroom absorbs drift (a refined region that wanders grows
-        # some devices' loads a little every epoch); the big L arrays
-        # get 25%, the small high-variance ones 2x
-        cap = bucket_capacity(needed * 2 if wide else needed + needed // 4)
+        if prev is None:
+            # first build: exact bucket (a static grid should not pay
+            # growth headroom it will never use)
+            cap = bucket_capacity(needed)
+        else:
+            # headroom absorbs drift (a refined region that wanders
+            # grows some devices' loads a little every epoch); the big
+            # L arrays get 25%, the small high-variance ones 2x
+            cap = bucket_capacity(needed * 2 if wide else needed + needed // 4)
         self._cap_memo[name] = cap
         return cap
 
