@@ -157,8 +157,37 @@ def bench_grid_path(baseline):
     return updates_per_sec, l2
 
 
+def probe_backend(timeout_s: int = 150) -> bool:
+    """Check in a SUBPROCESS that the accelerator backend actually
+    answers: a hung device tunnel would otherwise hang the whole bench
+    without emitting the JSON line the driver records."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+        return out.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     baseline = measure_baseline()
+
+    if not probe_backend():
+        print(
+            "device backend unreachable (probe timed out); no benchmark "
+            "was run", file=sys.stderr,
+        )
+        print(json.dumps({
+            "metric": f"advection 3D {N}^2x{NZ} cell-updates/sec/chip",
+            "value": 0,
+            "unit": "cell-updates/s",
+            "vs_baseline": 0,
+            "error": "TPU backend unreachable (device probe timed out)",
+        }))
+        return
 
     import jax
 
