@@ -106,3 +106,48 @@ def dc_to_vtk(dc_filename: str, vtk_filename: str, fields,
     _write_vtk(vtk_filename, cells, mins, maxs, scalars, title,
                cell_data=bool(spec))
     return cells
+
+
+def _parse_field_spec(spec_strs):
+    """CLI field specs: ``name:dtype`` or ``name:dtype:d0xd1`` (e.g.
+    ``density:float32`` or ``pos:float32:16x3``)."""
+    fields = {}
+    for s in spec_strs:
+        parts = s.split(":")
+        if len(parts) == 2:
+            name, dt = parts
+            fields[name] = ((), np.dtype(dt))
+        elif len(parts) == 3:
+            name, dt, shp = parts
+            shape = tuple(int(v) for v in shp.split("x"))
+            fields[name] = (shape, np.dtype(dt))
+        else:
+            raise SystemExit(f"bad field spec {s!r}: use name:dtype[:d0xd1]")
+    return fields
+
+
+def main(argv=None):
+    """``python -m dccrg_tpu.utils.vtk`` — the reference's dc2vtk
+    converters (examples/dc2vtk.cpp, tests/advection/dc2vtk.cpp) as one
+    CLI taking the field schema on the command line."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Convert a .dc checkpoint to an unstructured-grid "
+        ".vtk file (scalar fields only)")
+    ap.add_argument("dc_file")
+    ap.add_argument("vtk_file")
+    ap.add_argument("--field", action="append", required=True,
+                    dest="fields", metavar="NAME:DTYPE[:SHAPE]",
+                    help="cell field, repeatable, in the saved schema")
+    ap.add_argument("--header-size", type=int, default=0)
+    ap.add_argument("--title", default="dccrg_tpu grid")
+    args = ap.parse_args(argv)
+    cells = dc_to_vtk(args.dc_file, args.vtk_file,
+                      _parse_field_spec(args.fields),
+                      header_size=args.header_size, title=args.title)
+    print(f"wrote {args.vtk_file}: {len(cells)} cells")
+
+
+if __name__ == "__main__":
+    main()
