@@ -3,14 +3,28 @@
 (tests/advection/2d.cpp) — upwind solve, adapt every 4 steps, balance
 every 10 — with VTK snapshots of the refined grid.
 
-  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+Run (defaults to a virtual 8-device CPU mesh):
     python examples/amr_advection.py [steps] [outdir]
 """
 
+import os
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# examples default to the virtual 8-device CPU mesh; set
+# DCCRG_EXAMPLE_PLATFORM to run on another backend (the image's site
+# hook pre-points JAX at a TPU tunnel, so an env default isn't enough)
+_plat = os.environ.get("DCCRG_EXAMPLE_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _plat
+_flags = os.environ.get("XLA_FLAGS", "")
+if _plat == "cpu" and "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", _plat)
 
 
 import numpy as np
