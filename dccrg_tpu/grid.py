@@ -122,6 +122,37 @@ def bucket_capacity(n: int) -> int:
 
 
 
+
+def _synth_key(cf):
+    """Static cache-key component for a closed-form plan (None when
+    the plan has dense tables)."""
+    if cf is None:
+        return None
+    return (cf["dims"], cf["periodic"], cf["n0"],
+            tuple(map(tuple, cf["offsets"])))
+
+
+def _synth_mask(synth, L):
+    """Closed-form [L, S] validity mask from the row index alone (the
+    single-device uniform plan has no mask table)."""
+    (nx_, ny_, nz_), per_, n0_, offs_cells = synth
+    r_idx = jnp.arange(L, dtype=jnp.int32)
+    xc = r_idx % nx_
+    yc = (r_idx // nx_) % ny_
+    zc = r_idx // (nx_ * ny_)
+    cols = []
+    for (ox, oy, oz) in offs_cells:
+        v = (r_idx < n0_) if L > n0_ else jnp.ones((L,), bool)
+        for coord, o, nd, per in ((xc, ox, nx_, per_[0]),
+                                  (yc, oy, ny_, per_[1]),
+                                  (zc, oz, nz_, per_[2])):
+            if o != 0 and not per:
+                t = coord + o
+                v = v & (t >= 0) & (t < nd)
+        cols.append(v)
+    return jnp.stack(cols, axis=1)
+
+
 def _make_nbr_gather(use_roll, r_shifts, L, nrows, nmask, wr, ws):
     """Per-device neighbor gather for stencil bodies: a table gather,
     or S sequential rolls + a sparse fixup scatter when the table is
@@ -180,12 +211,19 @@ class _HoodPlan:
                  send_rows, recv_rows, n_inner, lists=None, to_tables=None,
                  to_rows=None, to_offs=None, to_mask=None, offs_const=None,
                  hard_rows=None, hard_nbr_rows=None, hard_offs=None,
-                 hard_mask=None, scale_rows=None):
+                 hard_mask=None, scale_rows=None, closed_form=None):
         self.offsets = offsets  # [K, 3] neighborhood items
-        # stencil gather tables, per device, padded:
-        self.nbr_rows = nbr_rows  # [n_dev, L, S] int32 row (pad: zero row)
+        # stencil gather tables, per device, padded. May be ONE thunk
+        # (returning (rows, mask)) for closed-form plans, materialized
+        # only if a host introspection path asks:
+        self._nbr_rows = nbr_rows  # [n_dev, L, S] int32 row (pad: zero row)
         self._nbr_offs = nbr_offs  # [n_dev, L, S, 3] int32 offsets, or thunk
-        self.nbr_mask = nbr_mask  # [n_dev, L, S] bool
+        self._nbr_mask = nbr_mask  # [n_dev, L, S] bool
+        # closed-form single-device uniform plans: stencils synthesize
+        # the mask from the row index and roll shifts arithmetically —
+        # no dense tables exist unless forced (dict with dims/periodic/
+        # offsets/n0)
+        self.closed_form = closed_form
         # when slot offsets are per-slot constants (uniform grids),
         # stencils synthesize noffs = mask * offs_const on device and
         # the full nbr_offs array is only built if a host query asks
@@ -233,6 +271,18 @@ class _HoodPlan:
         if callable(self._to):
             self._to = self._to()
         return self._to
+
+    @property
+    def nbr_rows(self):
+        if callable(self._nbr_rows):
+            self._nbr_rows, self._nbr_mask = self._nbr_rows()
+        return self._nbr_rows
+
+    @property
+    def nbr_mask(self):
+        if callable(self._nbr_mask):
+            self._nbr_rows, self._nbr_mask = self._nbr_mask()
+        return self._nbr_mask
 
     def dev(self, name, host_array, sharding=None):
         """Memoized device upload of a named table (replicated when
@@ -738,12 +788,14 @@ class Grid:
             def lists_thunk(offs=offs):
                 return build_neighbor_lists(mapping, topology, cells, offs)
 
-            plan.hoods[hid] = _HoodPlan(
+            closed = "closed_form" in hd
+            hood = _HoodPlan(
                 offsets=offs,
-                nbr_rows=hd["nbr_rows"],
+                nbr_rows=hd["tables_thunk"] if closed else hd["nbr_rows"],
                 nbr_offs=hd["nbr_offs"],
-                nbr_mask=hd["nbr_mask"],
+                nbr_mask=hd["tables_thunk"] if closed else hd["nbr_mask"],
                 offs_const=hd["offs_const"],
+                closed_form=hd.get("closed_form"),
                 to_tables=hd["to_thunk"],
                 send_rows=hd["send_rows"],
                 recv_rows=hd["recv_rows"],
@@ -751,6 +803,10 @@ class Grid:
                          if hid == DEFAULT_NEIGHBORHOOD_ID else None),
                 lists=lists_thunk,
             )
+            if closed:
+                # roll shifts + wrap fixups were computed arithmetically
+                hood._roll_plan = hd["roll_plan"]
+            plan.hoods[hid] = hood
         self._finish_plan(plan)
 
     def _build_plan_hybrid(self, cells: np.ndarray, owner: np.ndarray):
@@ -938,6 +994,13 @@ class Grid:
     def _host_rows(self, ids):
         """(device, row) for each cell id (host lookup)."""
         ids = np.atleast_1d(np.asarray(ids, dtype=np.uint64))
+        if ids is self.plan.cells or (
+            len(ids) == len(self.plan.cells) and ids[0] == self.plan.cells[0]
+            and ids[-1] == self.plan.cells[-1]
+            and np.array_equal(ids, self.plan.cells)
+        ):
+            # whole-grid access (init paths): skip the binary search
+            return self.plan.owner.copy(), self.plan.row_of_pos.astype(np.int64)
         pos = np.searchsorted(self.plan.cells, ids)
         if np.any(pos >= len(self.plan.cells)) or np.any(self.plan.cells[np.minimum(pos, len(self.plan.cells)-1)] != ids):
             raise KeyError("unknown cell id(s)")
@@ -1651,6 +1714,7 @@ class Grid:
         split = hood.hard_nbr_rows is not None and not include_to
         merged = include_to and hood.hard_nbr_rows is not None
         roll = None
+        cf = None
         if merged:
             uniform_offs = False
             if "m_rows" not in hood._dev:
@@ -1662,10 +1726,17 @@ class Grid:
                       hood._dev["m_mask"]]
         else:
             uniform_offs = hood.offs_const is not None
-            roll = (hood.roll_plan(
-                        L, cap=lambda n: self._sticky_cap(("rollW", neighborhood_id), n))
-                    if uniform_offs and not include_to and self._use_roll_gather()
-                    else None)
+            cf = hood.closed_form if not include_to else None
+            # affine tables lower the gather to rolls + sparse fixups;
+            # closed-form plans HAVE no tables, so they always roll and
+            # additionally synthesize the mask in-body
+            if cf is not None:
+                roll = hood.roll_plan(L)
+            elif uniform_offs and not include_to and self._use_roll_gather():
+                roll = hood.roll_plan(
+                    L, cap=lambda n: self._sticky_cap(("rollW", neighborhood_id), n))
+            else:
+                roll = None
             if roll is not None:
                 tables = [hood.dev("roll_dummy",
                                    np.zeros((self.n_dev, 1, 1), np.int32), sh)]
@@ -1677,7 +1748,11 @@ class Grid:
                 tables.append(hood.dev("offs_const", hood.offs_const))
             else:
                 tables.append(hood.dev("nbr_offs", hood.nbr_offs, sh))
-            tables.append(hood.dev("nbr_mask", hood.nbr_mask, sh))
+            if cf is not None:
+                tables.append(hood.dev("mask_dummy",
+                                       np.zeros((self.n_dev, 1, 1), bool), sh))
+            else:
+                tables.append(hood.dev("nbr_mask", hood.nbr_mask, sh))
         r_shifts = tuple(int(s) for s in roll[0]) if roll is not None else None
         if roll is not None:
             tables.append(hood.dev("roll_wr", roll[1], sh))
@@ -1695,8 +1770,9 @@ class Grid:
             tables.append(hood.dev("to_offs", hood.to_offs, sh))
             tables.append(hood.dev("to_mask", hood.to_mask, sh))
 
+        synth = _synth_key(cf)
         key = ("stencil", kernel, fields_in, fields_out, include_to, n_extra,
-               L, R, uniform_offs, scaled, split, merged, r_shifts)
+               L, R, uniform_offs, scaled, split, merged, r_shifts, synth)
         fn = self._program_cache.get(key)
         if fn is not None:
             return fn, tables
@@ -1706,7 +1782,8 @@ class Grid:
         use_roll = r_shifts is not None
 
         def body(nrows, noffs, nmask, *args):
-            nrows, nmask = nrows[0], nmask[0]
+            nrows = nrows[0]
+            nmask = _synth_mask(synth, L) if synth is not None else nmask[0]
             if use_roll:
                 wr, ws, *args = args
                 wr, ws = wr[0], ws[0]
@@ -1825,9 +1902,14 @@ class Grid:
         sh = self._sharding()
         uniform_offs = hood.offs_const is not None
         split = hood.hard_nbr_rows is not None
-        roll = (hood.roll_plan(
-                    L, cap=lambda n: self._sticky_cap(("rollW", neighborhood_id), n))
-                if uniform_offs and self._use_roll_gather() else None)
+        cf = hood.closed_form
+        if cf is not None:
+            roll = hood.roll_plan(L)  # table-free plans always roll
+        elif uniform_offs and self._use_roll_gather():
+            roll = hood.roll_plan(
+                L, cap=lambda n: self._sticky_cap(("rollW", neighborhood_id), n))
+        else:
+            roll = None
         r_shifts = tuple(int(s) for s in roll[0]) if roll is not None else None
         use_roll = r_shifts is not None
         static_in = tuple(n for n in fields_in if n not in fields_out)
@@ -1845,7 +1927,11 @@ class Grid:
             tables.append(hood.dev("offs_const", hood.offs_const))
         else:
             tables.append(hood.dev("nbr_offs", hood.nbr_offs, sh))
-        tables.append(hood.dev("nbr_mask", hood.nbr_mask, sh))
+        if cf is not None:
+            tables.append(hood.dev("mask_dummy",
+                                   np.zeros((self.n_dev, 1, 1), bool), sh))
+        else:
+            tables.append(hood.dev("nbr_mask", hood.nbr_mask, sh))
         sends, recvs = self._pair_tables_device(
             neighborhood_id, tuple(fields_out[j] for j in exch_idx)
         )
@@ -1863,8 +1949,9 @@ class Grid:
             tables.append(hood.dev("hard_offs", hood.hard_offs, sh))
             tables.append(hood.dev("hard_mask", hood.hard_mask, sh))
 
+        synth = _synth_key(cf)
         key = ("steploop", kernel, fields_in, fields_out, exch_idx, n_extra,
-               L, R, uniform_offs, scaled, split, r_shifts)
+               L, R, uniform_offs, scaled, split, r_shifts, synth)
         fn = self._program_cache.get(key)
         if fn is not None:
             return fn, tables, static_in
@@ -1875,7 +1962,8 @@ class Grid:
             send_rs = [a[0] for a in args[:n_x]]
             recv_rs = [a[0] for a in args[n_x:2 * n_x]]
             args = args[2 * n_x:]
-            nrows, nmask = nrows[0], nmask[0]
+            nrows = nrows[0]
+            nmask = _synth_mask(synth, L) if synth is not None else nmask[0]
             if use_roll:
                 wr, ws, *args = args
                 wr, ws = wr[0], ws[0]

@@ -141,12 +141,15 @@ class GridAdvection:
         )
         cells = self.grid.plan.cells
         centers = self.grid.geometry.get_center(cells)
-        x, y = centers[:, 0], centers[:, 1]
+        # f32 throughout: the fields are f32, and f32 trig halves the
+        # host init time at the 512^3 scale
+        x = centers[:, 0].astype(np.float32)
+        y = centers[:, 1].astype(np.float32)
         self._xy = (x, y)
         self.grid.set_many(cells, {
             "density": np.asarray(hump_density(x, y), dtype=np.float32),
-            "vx": (0.5 - y).astype(np.float32),
-            "vy": (x - 0.5).astype(np.float32),
+            "vx": (np.float32(0.5) - y),
+            "vy": (x - np.float32(0.5)),
         }, preserve_ghosts=False)
         self.grid.update_copies_of_remote_neighbors()
         self._kernel = make_uniform_flux_kernel((dx, dx, 1.0 / nz))

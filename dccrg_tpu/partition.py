@@ -252,6 +252,8 @@ def partition_cells(
     n = len(cells)
     if method not in PARTITION_METHODS:
         raise ValueError(f"unknown partition method {method!r}, have {PARTITION_METHODS}")
+    if n_parts == 1:
+        return np.zeros(n, dtype=np.int32)  # nothing to order or cut
 
     if weights is None:
         w = np.ones(n, dtype=np.float64)

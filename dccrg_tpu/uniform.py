@@ -90,10 +90,16 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     size = 1 << mapping.max_refinement_level  # index units per cell
     periodic = tuple(topology.is_periodic(d) for d in range(3))
     owner = np.asarray(owner, dtype=np.int32)
-    maps = _NeighborMaps(dims, periodic)
 
     hoods = {hid: np.asarray(offs, dtype=np.int64).reshape(-1, 3)
              for hid, offs in neighborhoods.items()}
+
+    if n_dev == 1:
+        # closed-form: no lattice map, no tables
+        return _build_single_device_plan(
+            mapping, hoods, cells, dims, periodic, size, cap)
+
+    maps = _NeighborMaps(dims, periodic)
 
     # -- phase 1: boundary classification + ghost edges -------------
     outer_flag = np.zeros(n0, dtype=bool)
@@ -352,3 +358,145 @@ def _build_to_tables(maps, offs, size, owner, reader_rows, perm, n_dev, L, R):
         to_offs.reshape(n_dev, L, k, 3),
         to_mask.reshape(n_dev, L, k),
     )
+
+
+def _build_single_device_plan(mapping, hoods, cells, dims, periodic, size, cap):
+    """Closed-form plan for a single-device uniform grid: NO gather
+    tables are materialized. Rows are grid order; neighbor gathers
+    lower to rolls whose shifts and wrap-fixup sets are computed
+    arithmetically (the stencil paths read them via
+    _HoodPlan.roll_plan), and the validity mask is synthesized on
+    device from the row index (closed_form metadata). The full tables
+    and the neighbors_to tables exist as lazy thunks for host query /
+    introspection paths — a 512^3 grid plans in milliseconds instead
+    of building multi-GB tables."""
+    from .grid import bucket_capacity
+
+    if cap is None:
+        cap = lambda name, needed: bucket_capacity(needed)
+    nx, ny, nz = dims
+    n0 = nx * ny * nz
+    L = cap("L", n0)
+    R = L + 1
+    row_of_pos = np.arange(n0, dtype=np.int32)
+    _lazy = {}
+
+    def get_maps():
+        # the n0-sized lattice map exists only if an introspection
+        # thunk actually fires
+        if "maps" not in _lazy:
+            _lazy["maps"] = _NeighborMaps(dims, periodic)
+        return _lazy["maps"]
+
+    def band_rows(o):
+        """(wrong rows, true src rows) for one offset: the rows whose
+        flat roll crosses a periodic wrap (non-periodic edges are
+        masked invalid instead)."""
+        ox, oy, oz = int(o[0]), int(o[1]), int(o[2])
+        bands = []
+        for d, (ov, nd) in enumerate(((ox, nx), (oy, ny), (oz, nz))):
+            if ov == 0:
+                continue
+            # rows whose dim-d coordinate steps outside [0, nd); with
+            # |offset| >= nd every row wraps (tiny periodic dims)
+            if ov > 0:
+                lo, hi = max(nd - ov, 0), nd
+            else:
+                lo, hi = 0, min(-ov, nd)
+            coord = np.arange(lo, hi, dtype=np.int64)
+            other = [np.arange(dims[e], dtype=np.int64) for e in range(3)]
+            other[d] = coord
+            gx, gy, gz = np.meshgrid(other[0], other[1], other[2],
+                                     indexing="ij")
+            bands.append((gx + nx * (gy + ny * gz)).reshape(-1))
+        if not bands:
+            return (np.empty(0, np.int64),) * 2
+        rows = np.unique(np.concatenate(bands))
+        # validity: non-periodic crossings are masked, not fixed up
+        x = rows % nx
+        y = (rows // nx) % ny
+        z = rows // (nx * ny)
+        tx, valid = x + ox, np.ones(len(rows), dtype=bool)
+        ty, tz = y + oy, z + oz
+        for coord, nd, per in ((tx, nx, periodic[0]), (ty, ny, periodic[1]),
+                               (tz, nz, periodic[2])):
+            if per:
+                coord %= nd
+            else:
+                valid &= (coord >= 0) & (coord < nd)
+        rows, tx, ty, tz = rows[valid], tx[valid], ty[valid], tz[valid]
+        true_flat = tx + nx * (ty + ny * tz)
+        # only rows where the plain roll would be wrong need fixing
+        roll_val = (rows + (ox + nx * (oy + ny * oz))) % L
+        wrong = roll_val != true_flat
+        return rows[wrong], true_flat[wrong]
+
+    hood_data = {}
+    for hid, offs in hoods.items():
+        k = len(offs)
+        shifts = (offs[:, 0] + nx * (offs[:, 1] + ny * offs[:, 2])).astype(np.int64)
+        wrongs = [band_rows(o) for o in offs]
+        W = cap(("rollW", hid), max(1, max(len(w) for w, _ in wrongs)))
+        wrong_rows = np.full((1, k, W), L, dtype=np.int32)
+        wrong_src = np.zeros((1, k, W), dtype=np.int32)
+        for j, (w, s) in enumerate(wrongs):
+            wrong_rows[0, j, : len(w)] = w
+            wrong_src[0, j, : len(w)] = s
+        send_rows = np.full((1, 1, 16), -1, dtype=np.int32)
+        recv_rows = np.full((1, 1, 16), -1, dtype=np.int32)
+
+        def tables_thunk(offs=offs, k=k, memo={}):
+            """Materialize the dense [1, L, k] tables on demand (host
+            query / introspection paths only); memoized so nbr_rows,
+            nbr_mask and nbr_offs consumers share one build."""
+            if "t" in memo:
+                return memo["t"]
+            rows_t = np.full((L, k), R - 1, dtype=np.int32)
+            mask_t = np.zeros((L, k), dtype=bool)
+            for j, o in enumerate(offs):
+                ng, valid = get_maps().shift(o)
+                rows_t[:n0, j] = np.where(valid, ng, R - 1)
+                mask_t[:n0, j] = valid
+            memo["t"] = (rows_t.reshape(1, L, k), mask_t.reshape(1, L, k))
+            return memo["t"]
+
+        offs_const = (offs * size).astype(np.int32)
+
+        def offs_thunk(thunk=tables_thunk, offs_const=offs_const, k=k):
+            _rows, mask_t = thunk()
+            out = (mask_t.reshape(L, k)[:, :, None]
+                   * offs_const[None, :, :]).astype(np.int32)
+            return out.reshape(1, L, k, 3)
+
+        def reader_rows(ng, valid):
+            return np.where(valid, ng.astype(np.int32), R - 1).astype(np.int32)
+
+        def make_to_thunk(offs=offs):
+            def thunk():
+                owner = np.zeros(n0, dtype=np.int32)
+                perm = row_of_pos.astype(np.int64)
+                return _build_to_tables(
+                    get_maps(), offs, size, owner, reader_rows, perm, 1, L, R
+                )
+
+            return thunk
+
+        hood_data[hid] = {
+            "closed_form": {"dims": dims, "periodic": periodic, "n0": n0,
+                            "offsets": offs.copy()},
+            "roll_plan": (shifts, wrong_rows, wrong_src),
+            "tables_thunk": tables_thunk,
+            "nbr_offs": offs_thunk,
+            "offs_const": offs_const,
+            "send_rows": send_rows,
+            "recv_rows": recv_rows,
+            "to_thunk": make_to_thunk(),
+        }
+
+    layout = dict(
+        local_ids=[cells], ghost_ids=[np.empty(0, np.uint64)],
+        n_local=np.array([n0], dtype=np.int64),
+        n_inner=np.array([n0], dtype=np.int64),
+        L=L, R=R, row_of_pos=row_of_pos,
+    )
+    return layout, hood_data
