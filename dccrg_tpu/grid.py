@@ -1030,10 +1030,18 @@ class Grid:
         dev, rows = self._host_rows(ids)
         fresh = (not preserve_ghosts
                  and len(np.atleast_1d(np.asarray(ids))) == len(self.plan.cells))
+        # single-device full-cover writes: with no ghosts there is no
+        # inner/outer reorder, so rows are the identity and the scatter
+        # is a contiguous copy
+        identity = fresh and self.n_dev == 1 and len(rows) == len(self.plan.cells)
         for name, values in values_by_field.items():
             shape, dtype = self.fields[name]
             if fresh:
                 host = np.zeros((self.n_dev, self.plan.R) + shape, dtype=dtype)
+                if identity:
+                    host[0, : len(rows)] = np.asarray(values, dtype=dtype)
+                    self.data[name] = jnp.asarray(host, device=self._sharding())
+                    continue
             else:
                 host = np.asarray(self.data[name]).copy()
             host[dev, rows] = values
