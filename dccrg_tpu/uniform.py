@@ -445,20 +445,21 @@ def _build_single_device_plan(mapping, hoods, cells, dims, periodic, size, cap):
         send_rows = np.full((1, 1, 16), -1, dtype=np.int32)
         recv_rows = np.full((1, 1, 16), -1, dtype=np.int32)
 
-        def tables_thunk(offs=offs, k=k, memo={}):
+        def tables_thunk(offs=offs, k=k, hid=hid):
             """Materialize the dense [1, L, k] tables on demand (host
             query / introspection paths only); memoized so nbr_rows,
             nbr_mask and nbr_offs consumers share one build."""
-            if "t" in memo:
-                return memo["t"]
+            key = ("tables", hid)
+            if key in _lazy:
+                return _lazy[key]
             rows_t = np.full((L, k), R - 1, dtype=np.int32)
             mask_t = np.zeros((L, k), dtype=bool)
             for j, o in enumerate(offs):
                 ng, valid = get_maps().shift(o)
                 rows_t[:n0, j] = np.where(valid, ng, R - 1)
                 mask_t[:n0, j] = valid
-            memo["t"] = (rows_t.reshape(1, L, k), mask_t.reshape(1, L, k))
-            return memo["t"]
+            _lazy[key] = (rows_t.reshape(1, L, k), mask_t.reshape(1, L, k))
+            return _lazy[key]
 
         offs_const = (offs * size).astype(np.int32)
 
