@@ -1,0 +1,52 @@
+"""End-to-end endurance scenario: the reference's production pattern —
+long advection run with periodic adaptation, load balancing, and a
+mid-run checkpoint/restart — all through the public API, with physics
+invariants checked throughout (tests/advection + tests/restart
+combined)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from dccrg_tpu.grid import Grid
+from dccrg_tpu.models.advection_amr import AmrAdvection
+
+
+def mesh_of(n):
+    return Mesh(np.array(jax.devices()[:n]), ("dev",))
+
+
+def test_long_run_with_adapt_balance_restart(tmp_path):
+    app = AmrAdvection(length=(24, 24, 1), max_refinement_level=2,
+                       mesh=mesh_of(8))
+    m0 = app.total_mass()
+
+    # phase 1: 12 steps with adaptation every 3, balancing every 6
+    app.run(12, adapt_n=3, balance_n=6)
+    assert app.total_mass() == pytest.approx(m0, rel=1e-4)
+    lvl = app.grid.mapping.get_refinement_level(app.grid.get_cells())
+    assert lvl.max() >= 1  # the hump edge refined
+
+    # phase 2: checkpoint, keep running the original
+    fn = str(tmp_path / "mid.dc")
+    app.grid.save_grid_data(fn)
+    t_mid = app.time
+    app.run(9, adapt_n=3)
+    want = app.grid.get("density", app.grid.get_cells())
+    want_cells = app.grid.get_cells()
+
+    # phase 3: restart from nothing but the file; same trajectory
+    grid2, _ = Grid.from_file(fn, dict(app.grid.fields), mesh=mesh_of(8))
+    app2 = AmrAdvection.from_grid(grid2, time=t_mid)
+    app2.run(9, adapt_n=3)
+    np.testing.assert_array_equal(app2.grid.get_cells(), want_cells)
+    np.testing.assert_allclose(
+        app2.grid.get("density", want_cells), want, rtol=1e-5, atol=1e-6,
+    )
+    assert app2.total_mass() == pytest.approx(m0, rel=1e-4)
+
+    # phase 4: density stays physical through it all
+    rho = app.grid.get("density", app.grid.get_cells())
+    assert rho.min() >= -1e-5 and rho.max() <= 0.55
