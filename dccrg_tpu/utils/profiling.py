@@ -70,10 +70,11 @@ def halo_bytes_per_update(grid, neighborhood_id=None, fields=None) -> int:
     from ..grid import DEFAULT_NEIGHBORHOOD_ID
 
     hood_id = neighborhood_id if neighborhood_id is not None else DEFAULT_NEIGHBORHOOD_ID
-    n_cells = grid.get_number_of_update_send_cells(hood_id)
     names = fields if fields is not None else list(grid.fields)
-    per_cell = 0
+    total = 0
     for name in names:
         shape, dtype = grid.fields[name]
-        per_cell += int(np.prod(shape, dtype=np.int64) if shape else 1) * dtype.itemsize
-    return n_cells * per_cell
+        per_cell = int(np.prod(shape, dtype=np.int64) if shape else 1) * dtype.itemsize
+        # per-field count: a transfer predicate may thin this field's list
+        total += grid.get_number_of_update_send_cells(hood_id, field=name) * per_cell
+    return total
