@@ -153,6 +153,25 @@ def _synth_mask(synth, L):
     return jnp.stack(cols, axis=1)
 
 
+
+def _halo_send(fl, sr, delta, axis, n_dev):
+    """One halo send: gather the send rows and move them — a compact
+    per-peer ppermute when ``delta`` is given, the dense tiled
+    all_to_all otherwise."""
+    buf = fl[jnp.clip(sr, 0)]
+    if delta is None:
+        return jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+    perm = [(p, (p + delta) % n_dev) for p in range(n_dev)]
+    return jax.lax.ppermute(buf, axis, perm)
+
+
+def _halo_scatter(fl, rv, payload, R):
+    """Scatter a received payload into ghost rows (-1 slots drop)."""
+    rr = jnp.where(rv >= 0, rv, R - 1).reshape(-1)
+    return fl.at[rr].set(payload.reshape((-1,) + fl.shape[1:]), mode="drop")
+
+
 def _make_nbr_gather(use_roll, r_shifts, L, nrows, nmask, wr, ws):
     """Per-device neighbor gather for stencil bodies: a table gather,
     or S sequential rolls + a sparse fixup scatter when the table is
@@ -1447,7 +1466,9 @@ class Grid:
         # only the cached (host + device) tables need rebuilding
         for hood in self.plan.hoods.values():
             hood._pair_host.clear()
-            for k in [k for k in hood._dev if isinstance(k, tuple) and k[0] == "pair"]:
+            stale = [k for k in hood._dev
+                     if isinstance(k, tuple) and k[0] in ("pair", "peer")]
+            for k in stale:
                 del hood._dev[k]
 
     def _field_pair_tables(self, neighborhood_id, field):
@@ -1479,49 +1500,113 @@ class Grid:
         hood._pair_host[field] = (send, recv)
         return send, recv
 
+    # halo exchanges with at most this many peer offsets use one
+    # ppermute per offset instead of a dense all_to_all: each device
+    # typically talks to ~2 neighbors, so the all_to_all's [n_dev, M]
+    # buffer wastes ~n_dev/peers of the interconnect bandwidth
+    _MAX_PEER_OFFSETS = 8
+
+    def _peer_deltas(self, neighborhood_id):
+        """Sorted device-offset set {(q-p) mod n_dev} with halo
+        traffic, or None when the all_to_all fallback should be used
+        (too many distinct offsets)."""
+        hood = self.plan.hoods[neighborhood_id]
+        if ("deltas",) in hood._dev:
+            return hood._dev[("deltas",)]
+        send = hood.send_rows
+        pairs = np.argwhere((send >= 0).any(axis=2))
+        deltas = tuple(sorted({int((q - p) % self.n_dev) for p, q in pairs}))
+        if len(deltas) > self._MAX_PEER_OFFSETS:
+            deltas = None  # all_to_all fallback (memoized as None too)
+        hood._dev[("deltas",)] = deltas
+        return deltas
+
     def _pair_tables_device(self, neighborhood_id, field_names):
-        """Per-field (send, recv) device tables, hood-memoized."""
+        """Per-field (send, recv) device tables, hood-memoized.
+
+        With a small peer-offset set, tables are per-delta compact
+        slices ``[n_dev, M_delta]`` (one ppermute each); otherwise the
+        dense ``[n_dev, n_dev, M]`` all_to_all tables."""
         hood = self.plan.hoods[neighborhood_id]
         sh = self._sharding()
+        deltas = self._peer_deltas(neighborhood_id)
         sends, recvs = [], []
         for n in field_names:
             s, r = self._field_pair_tables(neighborhood_id, n)
-            sends.append(hood.dev(("pair", n, "s"), s, sh))
-            recvs.append(hood.dev(("pair", n, "r"), r, sh))
+            if deltas is None:
+                sends.append(hood.dev(("pair", n, "s"), s, sh))
+                recvs.append(hood.dev(("pair", n, "r"), r, sh))
+                continue
+            for d in deltas:
+                key_s, key_r = ("peer", n, d, "s"), ("peer", n, d, "r")
+                if key_s not in hood._dev:
+                    p = np.arange(self.n_dev)
+                    # device p SENDS s[p, p+d]; device p RECEIVES (from
+                    # p-d) into rows r[p, p-d] — both sharded by p
+                    sd = s[p, (p + d) % self.n_dev]  # [n_dev, M]
+                    rd = r[p, (p - d) % self.n_dev]
+                    # shrink to this delta's own (sticky) width; slots
+                    # may have predicate holes, so cover the LAST valid
+                    # slot, not the count
+                    vs = (sd >= 0).any(axis=0)
+                    vr = (rd >= 0).any(axis=0)
+                    need = 1
+                    if vs.any():
+                        need = max(need, int(np.nonzero(vs)[0][-1]) + 1)
+                    if vr.any():
+                        need = max(need, int(np.nonzero(vr)[0][-1]) + 1)
+                    Md = self._sticky_cap(("Md", neighborhood_id, d), need)
+                    Md = min(Md, sd.shape[1])
+                    hood.dev(key_s, sd[:, :Md], sh)
+                    hood.dev(key_r, rd[:, :Md], sh)
+                sends.append(hood._dev[key_s])
+                recvs.append(hood._dev[key_r])
         return tuple(sends), tuple(recvs)
 
-    def _exchange_programs(self, n_f):
-        """(start, finish, fused) jitted exchange programs for n_f
+    def _exchange_programs(self, neighborhood_id, n_f):
+        """(start, finish, fused, n_t) jitted exchange programs for n_f
         fields — tables and field arrays are arguments, so one program
-        serves every epoch whose (bucketed) shapes match."""
-        key = ("exchange", n_f, self.plan.R)
+        serves every epoch whose (bucketed) shapes match.
+
+        With a small peer-offset set the collective is one
+        ``lax.ppermute`` per offset over compact [n_dev, M_delta]
+        buffers (each device talks to its ~2 neighbors; a dense
+        all_to_all would move n_dev/peers times the bytes); otherwise
+        it falls back to the all_to_all over [n_dev, M]. ``n_t`` is
+        the number of table slots per field per direction."""
+        deltas = self._peer_deltas(neighborhood_id)
+        n_dev = self.n_dev
+        key = ("exchange", n_f, self.plan.R, deltas, n_dev)
         hit = self._program_cache.get(key)
         if hit is not None:
             return hit
         R = self.plan.R
         axis = self.axis
         mesh = self.mesh
+        n_t = 1 if deltas is None else len(deltas)
 
         def start_body(*args):
-            send_rs, fields = args[:n_f], args[n_f:]
+            sends = args[: n_f * n_t]
+            fields = args[n_f * n_t :]
             outs = []
-            for sr, f in zip(send_rs, fields):
-                sr = sr[0]  # [n_dev, M]
-                fl = f[0]  # [R, ...]
-                buf = fl[jnp.clip(sr, 0)]  # [n_dev, M, ...]
-                rbuf = jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=True)
-                outs.append(rbuf[None])  # per-device [1, n_dev, M, ...]
+            for i, f in enumerate(fields):
+                fl = f[0]
+                for j in range(n_t):
+                    sr = sends[i * n_t + j][0]
+                    dlt = None if deltas is None else deltas[j]
+                    outs.append(_halo_send(fl, sr, dlt, axis, n_dev)[None])
             return tuple(outs)
 
         def finish_body(*args):
-            recv_rs = args[:n_f]
-            bufs = args[n_f : 2 * n_f]
-            fields = args[2 * n_f :]
+            recvs = args[: n_f * n_t]
+            bufs = args[n_f * n_t : 2 * n_f * n_t]
+            fields = args[2 * n_f * n_t :]
             outs = []
-            for rv, rbuf, f in zip(recv_rs, bufs, fields):
-                rr = jnp.where(rv[0] >= 0, rv[0], R - 1).reshape(-1)
+            for i, f in enumerate(fields):
                 fl = f[0]
-                fl = fl.at[rr].set(rbuf[0].reshape((-1,) + fl.shape[1:]), mode="drop")
+                for j in range(n_t):
+                    fl = _halo_scatter(fl, recvs[i * n_t + j][0],
+                                       bufs[i * n_t + j][0], R)
                 fl = fl.at[R - 1].set(0)  # keep the zero pad row zero
                 outs.append(fl[None])
             return tuple(outs)
@@ -1529,13 +1614,13 @@ class Grid:
         start_mapped = _shard_map(
             start_body,
             mesh=mesh,
-            in_specs=(P(axis),) * (2 * n_f),
-            out_specs=(P(axis),) * n_f,
+            in_specs=(P(axis),) * (n_f * n_t + n_f),
+            out_specs=(P(axis),) * (n_f * n_t),
         )
         finish_mapped = _shard_map(
             finish_body,
             mesh=mesh,
-            in_specs=(P(axis),) * (3 * n_f),
+            in_specs=(P(axis),) * (2 * n_f * n_t + n_f),
             out_specs=(P(axis),) * n_f,
         )
 
@@ -1544,13 +1629,13 @@ class Grid:
 
         @jax.jit
         def fused(*args):
-            sends = args[:n_f]
-            recvs = args[n_f : 2 * n_f]
-            fields = args[2 * n_f :]
+            sends = args[: n_f * n_t]
+            recvs = args[n_f * n_t : 2 * n_f * n_t]
+            fields = args[2 * n_f * n_t :]
             bufs = start_mapped(*sends, *fields)
             return finish_mapped(*recvs, *bufs, *fields)
 
-        hit = (start, finish, fused)
+        hit = (start, finish, fused, n_t)
         self._program_cache[key] = hit
         return hit
 
@@ -1563,7 +1648,8 @@ class Grid:
         rows between start and wait must survive. Returns callables
         bound to this epoch's pair tables; the underlying compiled
         programs are shared across epochs."""
-        start_j, finish_j, _fused = self._exchange_programs(len(field_names))
+        start_j, finish_j, _fused, _n_t = self._exchange_programs(
+            neighborhood_id, len(field_names))
         sends, recvs = self._pair_tables_device(neighborhood_id, field_names)
 
         def start(*fields):
@@ -1585,7 +1671,8 @@ class Grid:
         if self.n_dev == 1:
             return
         names = tuple(sorted(fields)) if fields is not None else tuple(sorted(self.fields))
-        _start, _finish, fused = self._exchange_programs(len(names))
+        _start, _finish, fused, _n_t = self._exchange_programs(
+            neighborhood_id, len(names))
         sends, recvs = self._pair_tables_device(neighborhood_id, names)
         out = fused(*sends, *recvs, *(self.data[n] for n in names))
         for n, arr in zip(names, out):
@@ -1943,6 +2030,8 @@ class Grid:
         sends, recvs = self._pair_tables_device(
             neighborhood_id, tuple(fields_out[j] for j in exch_idx)
         )
+        deltas = self._peer_deltas(neighborhood_id)
+        n_t = 1 if deltas is None else len(deltas)
         tables.extend(sends)
         tables.extend(recvs)
         if use_roll:
@@ -1959,7 +2048,7 @@ class Grid:
 
         synth = _synth_key(cf)
         key = ("steploop", kernel, fields_in, fields_out, exch_idx, n_extra,
-               L, R, uniform_offs, scaled, split, r_shifts, synth)
+               L, R, uniform_offs, scaled, split, r_shifts, synth, deltas)
         fn = self._program_cache.get(key)
         if fn is not None:
             return fn, tables, static_in
@@ -1967,9 +2056,9 @@ class Grid:
         axis, mesh, n_dev = self.axis, self.mesh, self.n_dev
 
         def body(n_steps, nrows, noffs, nmask, *args):
-            send_rs = [a[0] for a in args[:n_x]]
-            recv_rs = [a[0] for a in args[n_x:2 * n_x]]
-            args = args[2 * n_x:]
+            send_rs = [a[0] for a in args[: n_x * n_t]]
+            recv_rs = [a[0] for a in args[n_x * n_t : 2 * n_x * n_t]]
+            args = args[2 * n_x * n_t:]
             nrows = nrows[0]
             nmask = _synth_mask(synth, L) if synth is not None else nmask[0]
             if use_roll:
@@ -1987,7 +2076,15 @@ class Grid:
                 hr, hnr, hof, hm, *args = args
                 hr, hnr, hof, hm = hr[0], hnr[0], hof[0], hm[0]
                 hrc = jnp.minimum(hr, L - 1)
-            rrs = [jnp.where(rv >= 0, rv, R - 1).reshape(-1) for rv in recv_rs]
+            def exchange_one(fl, xi):
+                # per-peer-offset ppermutes of compact buffers, or the
+                # dense all_to_all fallback (see _exchange_programs)
+                for j in range(n_t):
+                    dlt = None if deltas is None else deltas[j]
+                    payload = _halo_send(fl, send_rs[xi * n_t + j], dlt,
+                                         axis, n_dev)
+                    fl = _halo_scatter(fl, recv_rs[xi * n_t + j], payload, R)
+                return fl.at[R - 1].set(0)
             gather_nbr = _make_nbr_gather(
                 use_roll, r_shifts, L, nrows, nmask,
                 wr if use_roll else None, ws if use_roll else None,
@@ -2001,16 +2098,7 @@ class Grid:
                 state = list(state)
                 if n_dev > 1:
                     for xi, j in enumerate(exch_idx):
-                        fl = state[j]
-                        buf = fl[jnp.clip(send_rs[xi], 0)]
-                        rbuf = jax.lax.all_to_all(
-                            buf, axis, split_axis=0, concat_axis=0, tiled=True
-                        )
-                        fl = fl.at[rrs[xi]].set(
-                            rbuf.reshape((-1,) + fl.shape[1:]), mode="drop"
-                        )
-                        fl = fl.at[R - 1].set(0)
-                        state[j] = fl
+                        state[j] = exchange_one(state[j], xi)
                 full = dict(statics)
                 full.update(zip(fields_out, state))
                 cell_fields = {n: full[n][:L] for n in fields_in}
@@ -2036,7 +2124,7 @@ class Grid:
             mesh=mesh,
             in_specs=(P(), P(axis),
                       P() if uniform_offs else P(axis), P(axis))
-            + (P(axis),) * (2 * n_x)
+            + (P(axis),) * (2 * n_x * n_t)
             + ((P(axis), P(axis)) if use_roll else ())
             + ((P(axis),) if scaled else ())
             + ((P(axis),) * 4 if split else ())

@@ -296,9 +296,9 @@ class PoissonSolver:
         tr_fn, tr_tables = g._make_stencil(
             self._tr, tuple(fields_in_tr), ("r1",),
             POISSON_NEIGHBORHOOD_ID, False)
-        _s1, _f1, fused1 = g._exchange_programs(1)
+        _s1, _f1, fused1, _nt1 = g._exchange_programs(POISSON_NEIGHBORHOOD_ID, 1)
         sx1, rx1 = g._pair_tables_device(POISSON_NEIGHBORHOOD_ID, ("p0",))
-        _s2, _f2, fused2 = g._exchange_programs(2)
+        _s2, _f2, fused2, _nt2 = g._exchange_programs(POISSON_NEIGHBORHOOD_ID, 2)
         sx2, rx2 = g._pair_tables_device(POISSON_NEIGHBORHOOD_ID, ("p0", "p1"))
         statics = tuple(g.data[n] for n in fields_in_fwd[1:])
         mask = self._solve_mask
