@@ -70,3 +70,37 @@ def test_amr_advection_conserves_mass(n_dev):
     m0 = app.total_mass()
     app.run(6, adapt_n=3)
     assert abs(app.total_mass() - m0) < 1e-5 * max(m0, 1.0)
+
+
+@pytest.mark.parametrize("seed", [0, 3, 7, 11])
+def test_exchange_topology_fuzz(seed):
+    """Halo exchange across odd topologies: thin/tiny dims, every
+    partitioner, both exchange phases — exercises the per-peer
+    ppermute path and its all_to_all fallback."""
+    rng = np.random.default_rng(seed + 777)
+    dims = tuple(int(v) for v in rng.choice([1, 2, 3, 5, 9], 3))
+    if np.prod(dims) < 4:
+        dims = (3, 2, 2)
+    periodic = tuple(bool(b) for b in rng.integers(0, 2, 3))
+    n_dev = int(rng.choice([2, 3, 5, 7, 8]))
+    hood = int(rng.integers(0, 3))
+    part = str(rng.choice(["block", "morton", "hilbert", "rcb"]))
+    g = (Grid(cell_data={"v": jnp.float32})
+         .set_initial_length(dims).set_periodic(*periodic)
+         .set_neighborhood_length(hood)
+         .set_load_balancing_method(part)
+         .initialize(mesh_of(n_dev)))
+    cells = g.plan.cells
+    g.set("v", cells, cells.astype(np.float32))
+    g.update_copies_of_remote_neighbors()
+    host = np.asarray(g.data["v"])
+    for d in range(n_dev):
+        for r, cid in enumerate(g.plan.ghost_ids[d]):
+            assert host[d, g.plan.L + r] == float(cid)
+    g.set("v", cells, 2 * cells.astype(np.float32))
+    g.start_remote_neighbor_copy_updates()
+    g.wait_remote_neighbor_copy_updates()
+    host = np.asarray(g.data["v"])
+    for d in range(n_dev):
+        for r, cid in enumerate(g.plan.ghost_ids[d]):
+            assert host[d, g.plan.L + r] == 2 * float(cid)
