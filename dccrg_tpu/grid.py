@@ -2248,6 +2248,17 @@ class Grid:
         new_owner = getattr(self, "_pending_owner", None)
         if new_owner is None:
             raise RuntimeError("initialize_balance_load not called")
+        moved = self.plan.cells[new_owner != self.plan.owner]
+        # per-device view of the movement (reference
+        # get_cells_added/removed_by_balance_load, dccrg.hpp)
+        self._balance_added = {
+            d: moved[new_owner[np.searchsorted(self.plan.cells, moved)] == d]
+            for d in range(self.n_dev)
+        }
+        self._balance_removed = {
+            d: moved[self.plan.owner[np.searchsorted(self.plan.cells, moved)] == d]
+            for d in range(self.n_dev)
+        }
         self._pending_owner = None
         staged = self._staged_balance
         self._staged_balance = {}
@@ -2269,6 +2280,57 @@ class Grid:
                 fixed[(slice(None),) + sl] = vals[(slice(None),) + sl]
                 vals = fixed
             self.set(n, ids, vals)
+
+    def get_cells_added_by_balance_load(self, device: int | None = None):
+        """Cells the last balance_load moved ONTO a device (all moved
+        cells when device is None) — reference
+        get_cells_added_by_balance_load."""
+        added = getattr(self, "_balance_added", {})
+        if device is not None:
+            return added.get(int(device), np.empty(0, np.uint64)).copy()
+        return (np.sort(np.concatenate(list(added.values())))
+                if added else np.empty(0, np.uint64))
+
+    def get_cells_removed_by_balance_load(self, device: int | None = None):
+        """Cells the last balance_load moved OFF a device."""
+        removed = getattr(self, "_balance_removed", {})
+        if device is not None:
+            return removed.get(int(device), np.empty(0, np.uint64)).copy()
+        return (np.sort(np.concatenate(list(removed.values())))
+                if removed else np.empty(0, np.uint64))
+
+    def get_cells_to_send(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
+        """{(sender, receiver): cell ids} of one halo update — the
+        reference's per-peer send lists (dccrg.hpp get_cells_to_send)."""
+        hood = self.plan.hoods[neighborhood_id]
+        out = {}
+        for p in range(self.n_dev):
+            for q in range(self.n_dev):
+                rows = hood.send_rows[p, q]
+                rows = rows[rows >= 0]
+                if len(rows):
+                    out[(p, q)] = self.plan.local_ids[p][rows]
+        return out
+
+    def get_cells_to_receive(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
+        """{(sender, receiver): cell ids} mirrored from the receive
+        side (identical content by construction)."""
+        return self.get_cells_to_send(neighborhood_id)
+
+    def get_neighborhood_of(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
+        """The neighborhood's offset list (reference
+        get_neighborhood_of)."""
+        return np.asarray(self.neighborhoods[neighborhood_id]).copy()
+
+    def get_neighborhood_to(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
+        """Negated offsets (the to-direction items)."""
+        return -self.get_neighborhood_of(neighborhood_id)
+
+    def get_pin_requests(self) -> dict:
+        """Current pin requests {cell id: device} (reference
+        get_pin_requests; the new/committed distinction collapses on a
+        single controller)."""
+        return dict(self._pins)
 
     # pinning (dccrg.hpp:5913-6139)
 
@@ -2652,6 +2714,31 @@ class Grid:
                          header_size=header_size, variable=variable)
 
     # -- misc parity ---------------------------------------------------
+
+    def get_comm_size(self) -> int:
+        """Device count (the reference's MPI communicator size)."""
+        return self.n_dev
+
+    def get_number_of_cells(self) -> int:
+        return len(self.plan.cells)
+
+    def get_existing_cell_from_indices(self, indices,
+                                       minimum_refinement_level: int = 0,
+                                       maximum_refinement_level: int | None = None):
+        """Smallest existing cell containing the given smallest-cell
+        indices within a refinement-level range (reference
+        get_existing_cell(indices, min, max), dccrg.hpp:11414-11447)."""
+        if maximum_refinement_level is None:
+            maximum_refinement_level = self.mapping.max_refinement_level
+        idx = np.asarray(indices, dtype=np.uint64)
+        if np.any(idx >= self.mapping.get_index_length()):
+            return ERROR_CELL
+        for lvl in range(maximum_refinement_level,
+                         minimum_refinement_level - 1, -1):
+            c = self.mapping.get_cell_from_indices(idx, lvl)
+            if c != ERROR_CELL and self._cell_pos(c) is not None:
+                return np.uint64(c)
+        return ERROR_CELL
 
     def get_existing_cell(self, coordinate):
         """Smallest existing cell containing a coordinate (reference

@@ -235,3 +235,42 @@ def test_round3_api_surface(mesh8, tmp_path):
     np.testing.assert_array_equal(g2.plan.cells, g.plan.cells)
     # f64 Poisson parity mode constructs
     assert poisson_fields(np.float64)["solution"] == np.dtype(np.float64)
+
+
+def test_parity_accessors(mesh8):
+    """The reference's remaining introspection surface: balance-load
+    movement, per-peer send lists, neighborhood offsets, pin requests,
+    index-based existing-cell lookup."""
+    from dccrg_tpu.types import ERROR_CELL
+
+    g = make_grid(mesh8, length=(4, 4, 2), max_lvl=1)
+    # balance movement accounting
+    for c in g.get_cells()[:8]:
+        g.pin(int(c), (g.get_process(int(c)) + 1) % 8)
+    assert len(g.get_pin_requests()) == 8
+    g.balance_load(use_zoltan=False)
+    moved = g.get_cells_added_by_balance_load()
+    assert len(moved) >= 8
+    np.testing.assert_array_equal(
+        moved, g.get_cells_removed_by_balance_load())
+    per_dev = sum(len(g.get_cells_added_by_balance_load(d)) for d in range(8))
+    assert per_dev == len(moved)
+    # per-peer send lists match the counters
+    sends = g.get_cells_to_send()
+    assert sum(len(v) for v in sends.values()) == \
+        g.get_number_of_update_send_cells()
+    assert all(p != q for p, q in sends)
+    # neighborhood offsets
+    offs = g.get_neighborhood_of()
+    np.testing.assert_array_equal(-offs, g.get_neighborhood_to())
+    assert len(offs) == 26
+    # refine then look up by indices across levels
+    g.refine_completely(1)
+    g.stop_refining()
+    c = g.get_existing_cell_from_indices((0, 0, 0))
+    assert g.mapping.get_refinement_level(c) == 1
+    c0 = g.get_existing_cell_from_indices((0, 0, 0),
+                                          maximum_refinement_level=0)
+    assert c0 == ERROR_CELL  # level-0 cell 1 was replaced by children
+    assert g.get_comm_size() == 8
+    assert g.get_number_of_cells() == len(g.get_cells())
