@@ -181,19 +181,37 @@ def save_grid_data(grid, filename: str, header: bytes = b"",
             if not var_spec:
                 f.write(fixed.tobytes())
                 continue
-            # interleave fixed part and ragged variable rows per cell
+            # interleave fixed part and ragged variable rows per cell —
+            # vectorized (repeat/cumsum scatter), no per-cell Python loop
             dev, rows = grid._host_rows(ids)
             var_host = {
                 name: np.ascontiguousarray(np.asarray(grid.data[name][dev, rows]))
                 for name, *_ in var_spec
             }
-            out = bytearray()
-            for i in range(len(ids)):
-                out += fixed[i].tobytes()
-                for name, count_field, row_shape, dtype, row_bytes, cap in var_spec:
-                    c = int(counts[name][start + i])
-                    out += var_host[name][i, :c].tobytes()
-            f.write(bytes(out))
+            nc = len(ids)
+            var_nbytes = {
+                name: counts[name][start : start + nc].astype(np.int64) * row_bytes
+                for name, count_field, row_shape, dtype, row_bytes, cap in var_spec
+            }
+            cell_total = np.full(nc, fixed_bytes, dtype=np.int64)
+            for nb in var_nbytes.values():
+                cell_total += nb
+            out = np.empty(int(cell_total.sum()), dtype=np.uint8)
+            cell_off = np.cumsum(cell_total) - cell_total
+            out[cell_off[:, None] + np.arange(fixed_bytes, dtype=np.int64)] = fixed
+            field_off = cell_off + fixed_bytes
+            for name, *_ in var_spec:
+                nb = var_nbytes[name]
+                tot = int(nb.sum())
+                if tot:
+                    vb = var_host[name].reshape(nc, -1).view(np.uint8)
+                    pos = np.arange(tot, dtype=np.int64) - np.repeat(
+                        np.cumsum(nb) - nb, nb
+                    )
+                    src_row = np.repeat(np.arange(nc, dtype=np.int64), nb)
+                    out[np.repeat(field_off, nb) + pos] = vb[src_row, pos]
+                field_off = field_off + nb
+            f.write(out.tobytes())
 
 
 def _grid_skeleton_matches(grid, mapping, hood_len, topology, geometry):

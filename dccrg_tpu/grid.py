@@ -531,6 +531,24 @@ class Grid:
         self.mesh = mesh if mesh is not None else default_mesh()
         if len(self.mesh.axis_names) != 1:
             raise ValueError("Grid needs a 1-D mesh (axis 'dev')")
+        if any(d.process_index != jax.process_index()
+               for d in self.mesh.devices.flat):
+            # The host-side plan builder, get/set paths and checkpoint
+            # I/O address every device shard from one controller
+            # process (np.asarray over sharded arrays). Under
+            # jax.distributed multi-process execution those pulls
+            # would silently return only the local shard — fail loudly
+            # instead until a process-local plan exists. (A mesh built
+            # from only this process's devices is fine even under
+            # jax.distributed.) The reference runs whole-cluster MPI
+            # (dccrg.hpp:7738-7803); our multi-host story is
+            # documented in README "Multi-host scaling".
+            raise RuntimeError(
+                "dccrg_tpu.Grid is single-controller: every mesh device "
+                "must be addressable from this process, but the mesh "
+                "contains devices owned by other processes. Multi-host "
+                "meshes (jax.distributed) are not yet supported."
+            )
         self.axis = self.mesh.axis_names[0]
         self.n_dev = self.mesh.devices.size
 
@@ -671,9 +689,12 @@ class Grid:
     def _build_plan_impl(self, cells: np.ndarray, owner: np.ndarray):
         _tune_allocator()
         n_dev = self.n_dev
-        order = np.argsort(cells, kind="stable")
-        cells = cells[order]
-        owner = np.asarray(owner, dtype=np.int32)[order]
+        if len(cells) > 1 and not np.all(cells[:-1] < cells[1:]):
+            order = np.argsort(cells, kind="stable")
+            cells = cells[order]
+            owner = np.asarray(owner, dtype=np.int32)[order]
+        else:  # already sorted (every initialize(); most rebuilds)
+            owner = np.asarray(owner, dtype=np.int32)
 
         # all-level-0 grids take the closed-form fast path (uniform.py):
         # identical tables, no entry stream, bounded temporaries. Both
@@ -1009,6 +1030,72 @@ class Grid:
             self.data[name] = jnp.zeros(
                 (self.n_dev, self.plan.R) + shape, dtype=dtype, device=self._sharding()
             )
+
+    def device_row_ids(self) -> "jnp.ndarray":
+        """Sharded ``[n_dev, R] int32`` array of ``cell id - 1`` per
+        row (``-1`` on pad rows) — the device-side mirror of
+        ``plan.local_ids``/``ghost_ids``, for initializing fields ON
+        device instead of staging host arrays (on uniform grids the
+        geometry center is affine in this index, so e.g. a 512^3 field
+        init needs no host f64 centers at all; the reference
+        initializes in one pass over already-resident memory,
+        tests/advection/initialize.hpp:36-80). Cached per structure
+        epoch. On a complete single-device level-0 grid the array is
+        synthesized from an iota without any host staging."""
+        plan = self.plan
+        cached = getattr(plan, "_row_ids_dev", None)
+        if cached is not None:
+            return cached
+        n0 = self.mapping.length.total_level0_cells
+        if (self.n_dev == 1 and len(plan.cells) == n0
+                and int(plan.cells[-1]) == n0):
+            # complete level-0 grid, one device: rows are id order
+            idx = jnp.arange(plan.R, dtype=jnp.int32)[None, :]
+            arr = jnp.where(idx < n0, idx, jnp.int32(-1))
+            arr = jax.device_put(arr, self._sharding())
+        else:
+            if len(plan.cells) and int(plan.cells[-1]) > np.iinfo(np.int32).max:
+                raise ValueError(
+                    "cell ids exceed int32; device_row_ids() is for "
+                    "level-0-scale grids — initialize via set_many"
+                )
+            host = np.full((self.n_dev, plan.R), -1, dtype=np.int32)
+            for d in range(self.n_dev):
+                nl = int(plan.n_local[d])
+                host[d, :nl] = plan.local_ids[d].astype(np.int64) - 1
+                ng = len(plan.ghost_ids[d])
+                if ng:  # ghost rows sit at [L, L+ng) (see hybrid.py)
+                    host[d, plan.L : plan.L + ng] = (
+                        plan.ghost_ids[d].astype(np.int64) - 1
+                    )
+            arr = jax.device_put(jnp.asarray(host), self._sharding())
+        plan._row_ids_dev = arr
+        return arr
+
+    def local_row_mask(self) -> "jnp.ndarray":
+        """Sharded ``[n_dev, R] float32`` mask: 1 on local rows, 0 on
+        ghost and pad rows — the device-side reduction mask (masked
+        sums / dots over owned cells only). Built on device from an
+        iota and cached per structure epoch (on the plan object, so a
+        same-bucket repartition that keeps array shapes still
+        invalidates it)."""
+        plan = self.plan
+        cached = getattr(plan, "_local_mask_dev", None)
+        if cached is not None:
+            return cached
+        fn = getattr(self, "_local_mask_fn", None)
+        if fn is None:
+            @partial(jax.jit, static_argnames=("shape",),
+                     out_shardings=self._sharding())
+            def fn(nl, shape):
+                rows = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+                return (rows < nl).astype(jnp.float32)
+
+            self._local_mask_fn = fn
+        nl = jnp.asarray(np.asarray(plan.n_local)[:, None].astype(np.int32))
+        arr = fn(nl, shape=(self.n_dev, plan.R))
+        plan._local_mask_dev = arr
+        return arr
 
     def _host_rows(self, ids):
         """(device, row) for each cell id (host lookup)."""
@@ -1455,7 +1542,15 @@ class Grid:
         entry drops that cell's ``field`` payload for that pair (both
         sides skip it — the symmetric equivalent of the reference's
         requirement that sender and receiver datatypes agree). Pass
-        ``None`` to clear."""
+        ``None`` to clear.
+
+        Predicates are sampled into cached pair tables when set; a
+        closure whose behavior changes later must be re-registered via
+        this setter to invalidate those caches."""
+        if not self.initialized:
+            raise RuntimeError(
+                "set_transfer_predicate() requires initialize() first "
+                "(predicates are sampled against the built plan)")
         if fn is None:
             self._transfer_predicates.pop(field, None)
         else:

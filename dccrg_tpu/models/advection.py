@@ -18,8 +18,11 @@ scheduler overlaps the collectives with independent compute).
 
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -139,26 +142,40 @@ class GridAdvection:
                           level_0_cell_length=(dx, dx, 1.0 / nz))
             .initialize(mesh)
         )
-        cells = self.grid.plan.cells
-        centers = self.grid.geometry.get_center(cells)
-        # f32 throughout: the fields are f32, and f32 trig halves the
-        # host init time at the 512^3 scale
-        x = centers[:, 0].astype(np.float32)
-        y = centers[:, 1].astype(np.float32)
-        self._xy = (x, y)
-        self.grid.set_many(cells, {
-            "density": np.asarray(hump_density(x, y), dtype=np.float32),
-            "vx": (np.float32(0.5) - y),
-            "vy": (x - np.float32(0.5)),
-        }, preserve_ghosts=False)
-        self.grid.update_copies_of_remote_neighbors()
+        # init entirely ON device: the cell index is affine in the
+        # geometry center on this uniform grid, so density/vx/vy are
+        # computed from the sharded row-id array — no host f64 centers,
+        # no host trig, no bulk uploads (the reference initializes in
+        # one pass over resident memory, initialize.hpp:36-80; at 512^3
+        # this path took ~66 s through the host, VERDICT r3)
+        ridx = self.grid.device_row_ids()
+        nx = np.int32(n)
+
+        @partial(jax.jit, out_shardings=self.grid._sharding())
+        def _init_fields(ridx):
+            valid = ridx >= 0
+            xi = jnp.where(valid, ridx, 0) % nx
+            yi = (jnp.where(valid, ridx, 0) // nx) % nx
+            x = (xi.astype(jnp.float32) + 0.5) * jnp.float32(dx)
+            y = (yi.astype(jnp.float32) + 0.5) * jnp.float32(dx)
+            zero = jnp.float32(0.0)
+            return (
+                jnp.where(valid, hump_density(x, y).astype(jnp.float32), zero),
+                jnp.where(valid, jnp.float32(0.5) - y, zero),
+                jnp.where(valid, x - jnp.float32(0.5), zero),
+            )
+
+        rho, vx, vy = _init_fields(ridx)
+        self.grid.data["density"] = rho
+        self.grid.data["vx"] = vx
+        self.grid.data["vy"] = vy
         self._kernel = make_uniform_flux_kernel((dx, dx, 1.0 / nz))
         self.time = 0.0
 
     def max_time_step(self) -> float:
-        x, y = self._xy
-        vmax = max(np.abs(0.5 - y).max(), np.abs(x - 0.5).max())
-        return self.dx / float(vmax)
+        # centers span [dx/2, 1-dx/2], so max |v| over cell centers is
+        # 0.5 - dx/2 exactly — no host center arrays needed
+        return self.dx / (0.5 - 0.5 * self.dx)
 
     def run(self, n_steps: int, dt: float | None = None) -> float:
         if dt is None:
@@ -174,20 +191,39 @@ class GridAdvection:
         return self.grid.get("density", self.grid.plan.cells)
 
     def checksum(self) -> float:
-        """Forced scalar readback: sums the sharded density on device
-        and pulls ONE scalar — a synchronization point that cannot
+        """Forced scalar readback: sums the sharded density over LOCAL
+        rows only (ghost and pad rows masked out, so this is the true
+        total density — usable as a mass probe at unit cell volume) and
+        pulls ONE scalar — a synchronization point that cannot
         under-report elapsed time the way block_until_ready can when
         dispatch is remote."""
-        return float(jnp.sum(self.grid.data["density"]))
+        return float(jnp.sum(self.grid.data["density"] * self.grid.local_row_mask()))
 
     def l2_error(self) -> float:
         """L2 error vs the rotated analytic hump (BASELINE.json's
-        parity metric; same math as AdvectionSolver.l2_error)."""
-        x, y = self._xy
-        exact = np.asarray(analytic_density(x, y, self.time))
-        diff = self.density().astype(np.float64) - exact
+        parity metric; same math as AdvectionSolver.l2_error), computed
+        on device over local rows (XLA's tree reduction keeps the f32
+        sum well-conditioned; no host center arrays at 512^3)."""
+        g = self.grid
+        if not hasattr(self, "_sq_err_fn"):
+            nx = np.int32(self.n)
+            dx = jnp.float32(self.dx)
+
+            @jax.jit
+            def _sq_err(rho, ridx, mask, t):
+                valid = ridx >= 0
+                xi = jnp.where(valid, ridx, 0) % nx
+                yi = (jnp.where(valid, ridx, 0) // nx) % nx
+                x = (xi.astype(jnp.float32) + 0.5) * dx
+                y = (yi.astype(jnp.float32) + 0.5) * dx
+                exact = analytic_density(x, y, t).astype(jnp.float32)
+                return jnp.sum((rho - exact) ** 2 * mask)
+
+            self._sq_err_fn = _sq_err
+        sq = self._sq_err_fn(g.data["density"], g.device_row_ids(),
+                             g.local_row_mask(), jnp.float32(self.time))
         vol = self.dx * self.dx * (1.0 / self.nz)
-        return float(np.sqrt(np.sum(diff**2) * vol))
+        return float(np.sqrt(float(sq) * vol))
 
 
 class AdvectionSolver:

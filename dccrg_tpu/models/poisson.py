@@ -242,12 +242,9 @@ class PoissonSolver:
             neighborhood_id=POISSON_NEIGHBORHOOD_ID, fields=_GEOMETRY_FIELDS
         )
 
-        mask = np.zeros((g.n_dev, g.plan.R), dtype=self._np_dtype)
-        for d in range(g.n_dev):
-            mask[d, : g.plan.n_local[d]] = 1.0
-        self._solve_mask = jax.device_put(jnp.asarray(mask), g._sharding()) * (
-            g.data["ctype"] == SOLVE_CELL
-        )
+        self._solve_mask = g.local_row_mask().astype(
+            jnp.dtype(self._np_dtype)
+        ) * (g.data["ctype"] == SOLVE_CELL)
         self._prepared_epoch = self._cache_key(cells_to_solve, cells_to_skip)
 
     # -- reductions ----------------------------------------------------

@@ -252,17 +252,18 @@ def partition_cells(
     n = len(cells)
     if method not in PARTITION_METHODS:
         raise ValueError(f"unknown partition method {method!r}, have {PARTITION_METHODS}")
-    if n_parts == 1:
-        return np.zeros(n, dtype=np.int32)  # nothing to order or cut
 
-    if weights is None:
-        w = np.ones(n, dtype=np.float64)
-    else:
+    if weights is not None:
         w = np.asarray(weights, dtype=np.float64)
         if w.shape != (n,):
             raise ValueError(f"weights must have shape ({n},), got {w.shape}")
         if np.any(w < 0):
             raise ValueError("cell weights must be >= 0")
+
+    if n_parts == 1:
+        return np.zeros(n, dtype=np.int32)  # nothing to order or cut
+    if weights is None:
+        w = np.ones(n, dtype=np.float64)
 
     if method == "rcb":
         centers = _index_centers(mapping, cells)

@@ -131,3 +131,21 @@ def test_grid_path_convergence_with_resolution():
         s.run(12, dt)
         errs.append(s.l2_error())
     assert errs[1] < 0.75 * errs[0], errs
+
+
+def test_checksum_stable_across_balance():
+    """local_row_mask is cached per plan epoch: a repartition that
+    stays inside the same capacity bucket (identical array shapes)
+    must still refresh the mask, so checksum (= total density over
+    local rows) is unchanged by load balancing."""
+    from dccrg_tpu.models.advection import GridAdvection
+    from jax.sharding import Mesh
+    import jax
+
+    a = GridAdvection(n=8, nz=4,
+                      mesh=Mesh(np.array(jax.devices()[:4]), ("dev",)))
+    c0 = a.checksum()
+    a.grid.set_partitioning_option("method", "rcb")
+    a.grid.balance_load()
+    c1 = a.checksum()
+    assert np.isclose(c0, c1, rtol=1e-6), (c0, c1)

@@ -516,3 +516,48 @@ def test_peer_exchange_buffers_compact():
     dense_rows = g.n_dev * hood.send_rows.shape[2]
     peer_rows = sum(t.shape[1] for t in sends)
     assert dense_rows >= 3 * peer_rows  # ~4x fewer rows on the wire
+
+
+def test_multi_process_guard(monkeypatch):
+    """Grid is single-controller: a mesh containing another process's
+    devices must be refused loudly, not answered from partial shards.
+    (A mesh of this process's own devices stays fine under
+    jax.distributed — the check is addressability, not process count.)"""
+    monkeypatch.setattr(jax, "process_index", lambda backend=None: 99)
+    with pytest.raises(RuntimeError, match="single-controller"):
+        (Grid(cell_data={"v": jnp.float32})
+         .set_initial_length((4, 4, 4))
+         .initialize())
+
+
+def test_transfer_predicate_requires_initialize():
+    g = Grid(cell_data={"v": jnp.float32}).set_initial_length((4, 4, 4))
+    with pytest.raises(RuntimeError, match="initialize"):
+        g.set_transfer_predicate("v", lambda ids, s, r, h: ids >= 0)
+
+
+def test_device_row_ids_matches_plan():
+    """device_row_ids mirrors local_ids/ghost_ids row layout exactly
+    (local rows [0, n_local), ghosts at [L, L+n_ghost), -1 elsewhere)."""
+    g = (Grid(cell_data={"v": jnp.float32})
+         .set_initial_length((8, 8, 4))
+         .set_periodic(True, True, False)
+         .initialize(Mesh(np.array(jax.devices()[:8]), ("dev",)),
+                     partition="morton"))
+    arr = np.asarray(g.device_row_ids())
+    expect = np.full_like(arr, -1)
+    for d in range(g.n_dev):
+        nl = int(g.plan.n_local[d])
+        expect[d, :nl] = g.plan.local_ids[d].astype(np.int64) - 1
+        ng = len(g.plan.ghost_ids[d])
+        expect[d, g.plan.L:g.plan.L + ng] = (
+            g.plan.ghost_ids[d].astype(np.int64) - 1)
+    np.testing.assert_array_equal(arr, expect)
+    # single-device closed-form grid: synthesized from iota
+    g1 = (Grid(cell_data={"v": jnp.float32})
+          .set_initial_length((4, 4, 4))
+          .initialize(Mesh(np.array(jax.devices()[:1]), ("dev",))))
+    a1 = np.asarray(g1.device_row_ids())
+    assert a1.shape == (1, g1.plan.R)
+    np.testing.assert_array_equal(a1[0, :64], np.arange(64))
+    assert (a1[0, 64:] == -1).all()

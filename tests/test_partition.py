@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 from dccrg_tpu import Mapping
+
 from dccrg_tpu.partition import hilbert_key, morton_key, partition_cells
 
 
@@ -122,3 +123,14 @@ def test_rcb_on_refined_grid():
     counts = np.bincount(g.plan.owner, minlength=4)
     assert counts.min() > 0
     g.update_copies_of_remote_neighbors()
+
+
+def test_single_part_still_validates_weights():
+    """n_parts==1 takes an early return but bad weights must still
+    raise (advisor round 3)."""
+    mp = Mapping((4, 4, 1))
+    cells = np.arange(1, 17, dtype=np.uint64)
+    with pytest.raises(ValueError, match=">= 0"):
+        partition_cells(mp, cells, 1, weights=-np.ones(16))
+    with pytest.raises(ValueError, match="shape"):
+        partition_cells(mp, cells, 1, weights=np.ones(3))
