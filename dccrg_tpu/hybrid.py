@@ -356,6 +356,7 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
             dims, periodic, offs, row_of_pos0,
             owner0 if n_dev > 1 else None, R - 1,
         )
+        mark(f"tables[{hid}]: native uniform")
         if nat is not None:
             grows, gmask = nat  # [n0, k] grid order
             fr = grows[far_slots]
@@ -365,6 +366,7 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
                 nslot = (-2 - fr[ci, cj]).astype(np.int64)
                 fr[ci, cj] = resolve_rows(pos0[nslot], far_dev[ci])
             del grows, gmask
+            mark(f"tables[{hid}]: far gather+fixup")
         else:
             fr = np.empty((len(far_slots), k), dtype=np.int32)
             fm = np.empty((len(far_slots), k), dtype=bool)
@@ -381,6 +383,7 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
         rows_t[far_rowidx] = fr
         mask_t[far_rowidx] = fm
         del fr, fm
+        mark(f"tables[{hid}]: far scatter")
 
         # easy rows: level-l index arithmetic, all offsets batched
         for blk, easy in blocks:
@@ -403,6 +406,7 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
                 )
             rows_t[ridx] = rows.reshape(E, k)
             mask_t[ridx] = validm
+            mark(f"tables[{hid}]: easy block l{blk.level}")
 
         # hard rows: compact per-device tables from the stream
         hard_rows_dev = hard_nbr_dev = hard_offs_dev = hard_mask_dev = None
@@ -414,24 +418,34 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
             gstart = np.maximum.accumulate(np.where(changed, np.arange(nE), 0))
             slot = np.arange(nE) - gstart
             S_hard = cap(("S_hard", hid), max(1, int(slot.max()) + 1))
-            hdev = owner[s_p].astype(np.int64)
-            hrow = hdev * L + row_of_pos[s_p]
-            urow, uinv = np.unique(hrow, return_inverse=True)
-            ud = urow // L
-            dev_start = np.searchsorted(ud, np.arange(n_dev))
-            dense_idx = np.arange(len(urow)) - dev_start[ud]
-            counts = np.bincount(ud, minlength=n_dev)
+            # the stream is grouped by source cell (contiguous runs),
+            # so the unique (dev, row) set falls out of the run starts —
+            # no O(nE log nE) sort over the 26x-larger entry stream
+            grp = np.cumsum(changed) - 1  # entry -> group [0, nG)
+            gsel = np.nonzero(changed)[0]  # one entry per source cell
+            g_dev = owner[s_p[gsel]].astype(np.int64)
+            g_row = row_of_pos[s_p[gsel]]
+            counts = np.bincount(g_dev, minlength=n_dev)
+            # per-device dense position: consecutive per device in
+            # stream (= cell-id) order
+            gorder = np.argsort(g_dev, kind="stable")  # nG only
+            dense_idx = np.empty(len(gsel), dtype=np.int64)
+            dev_first = np.concatenate([[0], np.cumsum(counts)[:-1]])
+            dense_idx[gorder] = (
+                np.arange(len(gsel)) - dev_first[g_dev[gorder]]
+            )
             Hmax = cap(("Hmax", hid), max(1, int(counts.max())))
             hard_rows_dev = np.full((n_dev, Hmax), L, dtype=np.int32)  # pad=L: dropped
             hard_nbr_dev = np.full((n_dev, Hmax, S_hard), R - 1, dtype=np.int32)
             hard_offs_dev = np.zeros((n_dev, Hmax, S_hard, 3), dtype=np.int32)
             hard_mask_dev = np.zeros((n_dev, Hmax, S_hard), dtype=bool)
-            hard_rows_dev[ud, dense_idx] = (urow - ud * L).astype(np.int32)
-            e_dev = ud[uinv]
-            e_pos = dense_idx[uinv]
+            hard_rows_dev[g_dev, dense_idx] = g_row.astype(np.int32)
+            e_dev = g_dev[grp]
+            e_pos = dense_idx[grp]
             hard_nbr_dev[e_dev, e_pos, slot] = resolve_rows(s_n, owner[s_p])
             hard_offs_dev[e_dev, e_pos, slot] = s_off.astype(np.int32)
             hard_mask_dev[e_dev, e_pos, slot] = True
+            mark(f"tables[{hid}]: hard assembly")
 
         offs_const = offs.astype(np.int32)  # [k, 3], CELL units (x scale_rows)
 
