@@ -16,9 +16,12 @@ Logical equivalent of the reference's .dc file format
 The reference writes with collective MPI-IO file views; here the host
 owns the replicated structure and payloads stream through bounded
 chunks: each chunk is gathered ON DEVICE for the chunk's cells and only
-that slice crosses to the host (save), or is scattered from a memory
-map that pages in on demand (load) — a >=64^3 multi-field grid never
-materializes the full interleaved payload matrix. The per-cell payload
+that slice crosses to the host (save, with a one-deep prefetch pipeline
+overlapping chunk k+1's device pull with chunk k's file write), or is
+scattered from a memory map that pages in on demand (load) — a >=64^3
+multi-field grid never materializes the full interleaved payload
+matrix. The format itself is pinned by a golden-file fixture
+(tests/data/golden.dc + tests/test_golden.py: byte-identical re-save). The per-cell payload
 is the grid's fields in sorted-name order — the same role as the
 user's ``get_mpi_datatype()`` serialization boundary (sender/receiver
 = -1 during save/load, dccrg.hpp:1106-1107).
@@ -135,12 +138,60 @@ def _chunk_payload(grid, ids, fixed_spec, cell_bytes):
     return payload
 
 
+def _chunk_bytes(grid, cells, counts, start, fixed_spec, fixed_bytes,
+                 var_spec):
+    """Serialize one chunk of cells to bytes (device gather + host
+    assembly) — runs on the prefetch thread so the NEXT chunk's device
+    pull overlaps the file write of the current one."""
+    ids = cells[start : start + CHUNK]
+    fixed = _chunk_payload(grid, ids, fixed_spec, fixed_bytes)
+    if not var_spec:
+        return fixed.tobytes()
+    # interleave fixed part and ragged variable rows per cell —
+    # vectorized (repeat/cumsum scatter), no per-cell Python loop
+    dev, rows = grid._host_rows(ids)
+    var_host = {
+        name: np.ascontiguousarray(np.asarray(grid.data[name][dev, rows]))
+        for name, *_ in var_spec
+    }
+    nc = len(ids)
+    var_nbytes = {
+        name: counts[name][start : start + nc].astype(np.int64) * row_bytes
+        for name, count_field, row_shape, dtype, row_bytes, cap in var_spec
+    }
+    cell_total = np.full(nc, fixed_bytes, dtype=np.int64)
+    for nb in var_nbytes.values():
+        cell_total += nb
+    out = np.empty(int(cell_total.sum()), dtype=np.uint8)
+    cell_off = np.cumsum(cell_total) - cell_total
+    out[cell_off[:, None] + np.arange(fixed_bytes, dtype=np.int64)] = fixed
+    field_off = cell_off + fixed_bytes
+    for name, *_ in var_spec:
+        nb = var_nbytes[name]
+        tot = int(nb.sum())
+        if tot:
+            vb = var_host[name].reshape(nc, -1).view(np.uint8)
+            pos = np.arange(tot, dtype=np.int64) - np.repeat(
+                np.cumsum(nb) - nb, nb
+            )
+            src_row = np.repeat(np.arange(nc, dtype=np.int64), nb)
+            out[np.repeat(field_off, nb) + pos] = vb[src_row, pos]
+        field_off = field_off + nb
+    return out.tobytes()
+
+
 def save_grid_data(grid, filename: str, header: bytes = b"",
                    variable=None) -> None:
     """Write the grid and all cell data (dccrg.hpp:1109-1736), payloads
-    streamed in bounded chunks. ``variable={"field": "count_field"}``
-    stores that field truncated to each cell's count (two-pass loadable
+    streamed in bounded chunks with the device pull of chunk k+1
+    overlapping the file write of chunk k (the reference overlaps via
+    collective MPI-IO file views, dccrg.hpp:1594-1659; here a one-deep
+    prefetch pipeline gives the same pull/write concurrency on the
+    single controller). ``variable={"field": "count_field"}`` stores
+    that field truncated to each cell's count (two-pass loadable
     ragged payloads, dccrg.hpp:2108-2123)."""
+    from concurrent.futures import ThreadPoolExecutor
+
     cells = grid.get_cells()
     fixed_spec, fixed_bytes, var_spec = _payload_spec(grid, variable)
 
@@ -169,49 +220,24 @@ def save_grid_data(grid, filename: str, header: bytes = b"",
         [[np.uint64(0)], np.cumsum(sizes)[:-1]]
     ).astype(np.uint64)
 
-    with open(filename, "wb") as f:
+    starts = list(range(0, len(cells), CHUNK))
+    with open(filename, "wb") as f, ThreadPoolExecutor(1) as pool:
         f.write(bytes(meta))
         pairs = np.empty((len(cells), 2), dtype=np.uint64)
         pairs[:, 0] = cells
         pairs[:, 1] = offsets
         f.write(pairs.tobytes())
-        for start in range(0, len(cells), CHUNK):
-            ids = cells[start : start + CHUNK]
-            fixed = _chunk_payload(grid, ids, fixed_spec, fixed_bytes)
-            if not var_spec:
-                f.write(fixed.tobytes())
-                continue
-            # interleave fixed part and ragged variable rows per cell —
-            # vectorized (repeat/cumsum scatter), no per-cell Python loop
-            dev, rows = grid._host_rows(ids)
-            var_host = {
-                name: np.ascontiguousarray(np.asarray(grid.data[name][dev, rows]))
-                for name, *_ in var_spec
-            }
-            nc = len(ids)
-            var_nbytes = {
-                name: counts[name][start : start + nc].astype(np.int64) * row_bytes
-                for name, count_field, row_shape, dtype, row_bytes, cap in var_spec
-            }
-            cell_total = np.full(nc, fixed_bytes, dtype=np.int64)
-            for nb in var_nbytes.values():
-                cell_total += nb
-            out = np.empty(int(cell_total.sum()), dtype=np.uint8)
-            cell_off = np.cumsum(cell_total) - cell_total
-            out[cell_off[:, None] + np.arange(fixed_bytes, dtype=np.int64)] = fixed
-            field_off = cell_off + fixed_bytes
-            for name, *_ in var_spec:
-                nb = var_nbytes[name]
-                tot = int(nb.sum())
-                if tot:
-                    vb = var_host[name].reshape(nc, -1).view(np.uint8)
-                    pos = np.arange(tot, dtype=np.int64) - np.repeat(
-                        np.cumsum(nb) - nb, nb
-                    )
-                    src_row = np.repeat(np.arange(nc, dtype=np.int64), nb)
-                    out[np.repeat(field_off, nb) + pos] = vb[src_row, pos]
-                field_off = field_off + nb
-            f.write(out.tobytes())
+        fut = None
+        for i, start in enumerate(starts):
+            if fut is None:
+                fut = pool.submit(_chunk_bytes, grid, cells, counts, start,
+                                  fixed_spec, fixed_bytes, var_spec)
+            buf = fut.result()
+            fut = (pool.submit(_chunk_bytes, grid, cells, counts,
+                               starts[i + 1], fixed_spec, fixed_bytes,
+                               var_spec)
+                   if i + 1 < len(starts) else None)
+            f.write(buf)
 
 
 def _grid_skeleton_matches(grid, mapping, hood_len, topology, geometry):

@@ -129,20 +129,28 @@ def _synth_key(cf):
     if cf is None:
         return None
     return (cf["dims"], cf["periodic"], cf["n0"],
-            tuple(map(tuple, cf["offsets"])))
+            tuple(map(tuple, cf["offsets"])), bool(cf.get("multi")))
 
 
-def _synth_mask(synth, L):
-    """Closed-form [L, S] validity mask from the row index alone (the
-    single-device uniform plan has no mask table)."""
-    (nx_, ny_, nz_), per_, n0_, offs_cells = synth
-    r_idx = jnp.arange(L, dtype=jnp.int32)
-    xc = r_idx % nx_
-    yc = (r_idx // nx_) % ny_
-    zc = r_idx // (nx_ * ny_)
+def _synth_mask(synth, L, row_gidx=None):
+    """Closed-form [L, S] validity mask: from the row index alone on
+    single-device plans (rows ARE grid order), or from the per-row
+    grid index array on multi-device closed-form plans (rows are
+    [inner|outer] per device; ``row_gidx`` is ``device_row_ids[:L]``
+    for this device's shard, -1 on pad rows)."""
+    (nx_, ny_, nz_), per_, n0_, offs_cells, *_ = synth
+    if row_gidx is None:
+        gidx = jnp.arange(L, dtype=jnp.int32)
+        base_valid = (gidx < n0_) if L > n0_ else jnp.ones((L,), bool)
+    else:
+        base_valid = row_gidx >= 0
+        gidx = jnp.maximum(row_gidx, 0)
+    xc = gidx % nx_
+    yc = (gidx // nx_) % ny_
+    zc = gidx // (nx_ * ny_)
     cols = []
     for (ox, oy, oz) in offs_cells:
-        v = (r_idx < n0_) if L > n0_ else jnp.ones((L,), bool)
+        v = base_valid
         for coord, o, nd, per in ((xc, ox, nx_, per_[0]),
                                   (yc, oy, ny_, per_[1]),
                                   (zc, oz, nz_, per_[2])):
@@ -1939,8 +1947,15 @@ class Grid:
             else:
                 tables.append(hood.dev("nbr_offs", hood.nbr_offs, sh))
             if cf is not None:
-                tables.append(hood.dev("mask_dummy",
-                                       np.zeros((self.n_dev, 1, 1), bool), sh))
+                if cf.get("multi"):
+                    # multi-device closed-form: the mask is synthesized
+                    # from the per-row grid index (rows are NOT grid
+                    # order), shipped in the mask slot
+                    tables.append(self.device_row_ids())
+                else:
+                    tables.append(hood.dev("mask_dummy",
+                                           np.zeros((self.n_dev, 1, 1), bool),
+                                           sh))
             else:
                 tables.append(hood.dev("nbr_mask", hood.nbr_mask, sh))
         r_shifts = tuple(int(s) for s in roll[0]) if roll is not None else None
@@ -1973,7 +1988,12 @@ class Grid:
 
         def body(nrows, noffs, nmask, *args):
             nrows = nrows[0]
-            nmask = _synth_mask(synth, L) if synth is not None else nmask[0]
+            if synth is not None:
+                nmask = _synth_mask(
+                    synth, L,
+                    row_gidx=(nmask[0][:L] if synth[4] else None))
+            else:
+                nmask = nmask[0]
             if use_roll:
                 wr, ws, *args = args
                 wr, ws = wr[0], ws[0]
@@ -2118,8 +2138,12 @@ class Grid:
         else:
             tables.append(hood.dev("nbr_offs", hood.nbr_offs, sh))
         if cf is not None:
-            tables.append(hood.dev("mask_dummy",
-                                   np.zeros((self.n_dev, 1, 1), bool), sh))
+            if cf.get("multi"):
+                tables.append(self.device_row_ids())
+            else:
+                tables.append(hood.dev("mask_dummy",
+                                       np.zeros((self.n_dev, 1, 1), bool),
+                                       sh))
         else:
             tables.append(hood.dev("nbr_mask", hood.nbr_mask, sh))
         sends, recvs = self._pair_tables_device(
@@ -2155,7 +2179,12 @@ class Grid:
             recv_rs = [a[0] for a in args[n_x * n_t : 2 * n_x * n_t]]
             args = args[2 * n_x * n_t:]
             nrows = nrows[0]
-            nmask = _synth_mask(synth, L) if synth is not None else nmask[0]
+            if synth is not None:
+                nmask = _synth_mask(
+                    synth, L,
+                    row_gidx=(nmask[0][:L] if synth[4] else None))
+            else:
+                nmask = nmask[0]
             if use_roll:
                 wr, ws, *args = args
                 wr, ws = wr[0], ws[0]
@@ -2288,16 +2317,37 @@ class Grid:
                     pos = np.searchsorted(cells, np.uint64(cid))
                     if pos < len(cells) and cells[pos] == np.uint64(cid):
                         weights[pos] = w
+            # connectivity edges for the "cut" method (the role of
+            # Zoltan's graph callbacks, dccrg.hpp:12091-12252). On
+            # closed-form plans the of-lists are a lazy thunk whose
+            # first build is O(grid); the edge arrays only depend on
+            # the CELL SET (not the partition), so they are cached on
+            # the grid and survive repeated balances until an AMR
+            # commit changes the cells.
+            edges = None
+            methods = [lv.get("method") for lv in self._partitioning_levels]
+            if self._lb_method == "cut" or "cut" in methods:
+                ck = (len(cells), int(cells[0]) if len(cells) else 0,
+                      int(cells[-1]) if len(cells) else 0,
+                      int(np.bitwise_xor.reduce(cells)) if len(cells) else 0)
+                cached = getattr(self, "_cut_edges", None)
+                if cached is not None and cached[0] == ck:
+                    edges = cached[1]
+                else:
+                    nl = self.plan.hoods[DEFAULT_NEIGHBORHOOD_ID].lists
+                    edges = (nl.of_source.astype(np.int64),
+                             np.searchsorted(cells, nl.of_neighbor))
+                    self._cut_edges = (ck, edges)
             if self._partitioning_levels:
                 new_owner = partition_cells_hierarchical(
                     self.mapping, cells, self.n_dev,
                     self._partitioning_levels,
-                    weights=weights, pins=self._pins or None,
+                    weights=weights, pins=self._pins or None, edges=edges,
                 )
             else:
                 new_owner = partition_cells(
                     self.mapping, cells, self.n_dev, self._lb_method,
-                    weights=weights, pins=self._pins or None,
+                    weights=weights, pins=self._pins or None, edges=edges,
                 )
         else:
             new_owner = self.plan.owner.copy()

@@ -140,7 +140,10 @@ class GridAdvection:
             .set_neighborhood_length(0)
             .set_geometry("cartesian", start=(0.0, 0.0, 0.0),
                           level_0_cell_length=(dx, dx, 1.0 / nz))
-            .initialize(mesh)
+            # block partition: contiguous slabs take the closed-form
+            # multi-device plan (no dense gather tables) and the
+            # compact +-1-peer ppermute exchange
+            .initialize(mesh, partition="block")
         )
         # init entirely ON device: the cell index is affine in the
         # geometry center on this uniform grid, so density/vx/vy are

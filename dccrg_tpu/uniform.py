@@ -30,6 +30,8 @@ are valid paddings of the same neighbor multiset.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 
@@ -72,6 +74,171 @@ class _NeighborMaps:
             sl[axis] = slice(n - o, None) if o > 0 else slice(None, -o)
             valid[tuple(sl)] = False
         return ng.reshape(-1), valid.reshape(-1)
+
+
+def _wrap_band(dims, periodic, o):
+    """Sorted grid indices of cells whose neighbor at cell offset ``o``
+    crosses a grid boundary in some dimension (periodic wrap or
+    non-periodic invalid) — the only cells besides partition-boundary
+    bands whose flat neighbor index differs from ``gidx + flat_delta``.
+    ~O(surface) cells."""
+    nx, ny, nz = dims
+    bands = []
+    for d, (ov, nd) in enumerate(((int(o[0]), nx), (int(o[1]), ny),
+                                  (int(o[2]), nz))):
+        if ov == 0:
+            continue
+        if ov > 0:
+            lo, hi = max(nd - ov, 0), nd
+        else:
+            lo, hi = 0, min(-ov, nd)
+        coord = np.arange(lo, hi, dtype=np.int64)
+        other = [np.arange(dims[e], dtype=np.int64) for e in range(3)]
+        other[d] = coord
+        gx, gy, gz = np.meshgrid(other[0], other[1], other[2], indexing="ij")
+        bands.append((gx + nx * (gy + ny * gz)).reshape(-1))
+    if not bands:
+        return np.empty(0, np.int64)
+    return np.unique(np.concatenate(bands))
+
+
+def _closed_form_hoods(hoods, dims, periodic, size, n_dev, owner,
+                       local_ids, ghost_gidx, n_inner, L, R,
+                       row_of_pos, send_rows, recv_rows, cap, dense_tables,
+                       maps, reader_rows, perm):
+    """Closed-form hood data for a multi-device partition contiguous in
+    cell-id order (block slabs, incl. weighted cuts).
+
+    Rows are [inner|outer] per device, but for a contiguous partition
+    the outer cells cluster in bands at the slab ends (plus wrap
+    bands), so every cell OUTSIDE the candidate bands has an affine
+    row: row(c) = c - slab_start - n_head_outer, and its same-slab
+    unwrapped neighbor satisfies row(n) = row(c) + flat_delta. The
+    roll decomposition (grid._make_nbr_gather) therefore only needs
+    exact fixups for the candidate bands — computed here in
+    O(bands * k), never materializing the [n_dev, L, S] tables the
+    dense path builds (the validity mask is synthesized ON DEVICE from
+    the row-id array, grid._synth_mask). Dense tables remain available
+    as memoized thunks for host query paths."""
+    nx, ny, nz = dims
+    n0 = nx * ny * nz
+    nxy = nx * ny
+    a = np.searchsorted(owner, np.arange(n_dev)).astype(np.int64)
+    b = np.append(a[1:], n0).astype(np.int64)
+    # mid-region bounds from the ACTUAL outer sets: everything outside
+    # [head_end, tail_start) is re-checked exactly, so a pathological
+    # outer cell in the middle just widens the candidate set
+    head_end, tail_start = a.copy(), b.copy()
+    for d in range(n_dev):
+        og = local_ids[d][n_inner[d]:].astype(np.int64) - 1
+        if len(og):
+            mid = (a[d] + b[d]) // 2
+            h, t = og[og < mid], og[og >= mid]
+            head_end[d] = (h.max() + 1) if len(h) else a[d]
+            tail_start[d] = t.min() if len(t) else b[d]
+
+    _memo = {}
+
+    def dense_memo(hid, offs):
+        if hid not in _memo:
+            _memo[hid] = dense_tables(offs)
+        return _memo[hid]
+
+    hood_data = {}
+    for hid, offs in hoods.items():
+        k = len(offs)
+        shifts = (offs[:, 0] + nx * (offs[:, 1] + ny * offs[:, 2])
+                  ).astype(np.int64)
+        maxD = int(np.abs(shifts).max()) if k else 0
+        bands = [_wrap_band(dims, periodic, o) for o in offs]
+        wrong_per = [[None] * k for _ in range(n_dev)]
+        W = 1
+        for d in range(n_dev):
+            lo, hi = int(a[d]), int(b[d])
+            he = min(int(head_end[d]) + maxD, hi)
+            ts = max(int(tail_start[d]) - maxD, lo)
+            endcands = np.concatenate([
+                np.arange(lo, he, dtype=np.int64),
+                np.arange(max(ts, he), hi, dtype=np.int64),
+            ])
+            for j, o in enumerate(offs):
+                bj = bands[j]
+                cand = np.unique(np.concatenate(
+                    [endcands, bj[(bj >= lo) & (bj < hi)]]
+                ))
+                if len(cand) == 0:
+                    wrong_per[d][j] = (np.empty(0, np.int32),
+                                       np.empty(0, np.int32))
+                    continue
+                x = cand % nx
+                y = (cand // nx) % ny
+                z = cand // nxy
+                tx, ty, tz = x + int(o[0]), y + int(o[1]), z + int(o[2])
+                valid = np.ones(len(cand), dtype=bool)
+                for coord, ndim, per in ((tx, nx, periodic[0]),
+                                         (ty, ny, periodic[1]),
+                                         (tz, nz, periodic[2])):
+                    if per:
+                        coord %= ndim
+                    else:
+                        valid &= (coord >= 0) & (coord < ndim)
+                cv = cand[valid]
+                ngi = (tx + nx * (ty + ny * tz))[valid]
+                row_c = row_of_pos[cv].astype(np.int64)
+                row_n = np.empty(len(ngi), dtype=np.int64)
+                loc = owner[ngi] == d
+                row_n[loc] = row_of_pos[ngi[loc]]
+                if (~loc).any():
+                    row_n[~loc] = L + np.searchsorted(
+                        ghost_gidx[d], ngi[~loc]
+                    )
+                # ghost reads must always go through the fixup even if
+                # the shift coincidentally matches (the roll never
+                # reaches rows >= L)
+                wrong = (row_n != row_c + shifts[j]) | (row_n >= L)
+                wrong_per[d][j] = (row_c[wrong].astype(np.int32),
+                                   row_n[wrong].astype(np.int32))
+                W = max(W, int(wrong.sum()))
+        Wc = cap(("rollW", hid), W)
+        wrong_rows = np.full((n_dev, k, Wc), L, dtype=np.int32)
+        wrong_src = np.zeros((n_dev, k, Wc), dtype=np.int32)
+        for d in range(n_dev):
+            for j in range(k):
+                wr, ws = wrong_per[d][j]
+                wrong_rows[d, j, : len(wr)] = wr
+                wrong_src[d, j, : len(ws)] = ws
+        offs_const = (offs * size).astype(np.int32)
+
+        def tables_thunk(hid=hid, offs=offs, k=k):
+            rows_t, mask_t = dense_memo(hid, offs)
+            return rows_t.reshape(n_dev, L, k), mask_t.reshape(n_dev, L, k)
+
+        def offs_thunk(hid=hid, offs=offs, k=k, offs_const=offs_const):
+            _rows, mask_t = dense_memo(hid, offs)
+            out = (mask_t.reshape(n_dev * L, k)[:, :, None]
+                   * offs_const[None, :, :]).astype(np.int32)
+            return out.reshape(n_dev, L, k, 3)
+
+        def make_to_thunk(offs=offs):
+            def thunk():
+                return _build_to_tables(
+                    maps, offs, size, owner, reader_rows, perm, n_dev, L, R
+                )
+
+            return thunk
+
+        hood_data[hid] = {
+            "closed_form": {"dims": dims, "periodic": periodic, "n0": n0,
+                            "offsets": offs.copy(), "multi": True},
+            "roll_plan": (shifts, wrong_rows, wrong_src),
+            "tables_thunk": tables_thunk,
+            "nbr_offs": offs_thunk,
+            "offs_const": offs_const,
+            "send_rows": send_rows,
+            "recv_rows": recv_rows,
+            "to_thunk": make_to_thunk(),
+        }
+    return hood_data
 
 
 def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
@@ -247,8 +414,8 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     # -- phase 2: gather tables ------------------------------------
     from . import native
 
-    hood_data = {}
-    for hid, offs in hoods.items():
+    def dense_tables(offs):
+        """[n_dev*L, k] (rows, mask) in row order — the dense build."""
         k = len(offs)
         nat = (native.uniform_tables(
             dims, periodic, offs, row_of_pos,
@@ -285,6 +452,30 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
         if len(pad_rows):
             rows_t[pad_rows] = R - 1
             mask_t[pad_rows] = False
+        return rows_t, mask_t
+
+    # a partition contiguous in cell-id order (block, incl. weighted)
+    # takes the closed-form path: rows are piecewise-affine in the grid
+    # index, so roll shifts + fixup sets come from candidate bands and
+    # NO [n_dev, L, S] table is materialized (VERDICT r3 item 4)
+    contiguous = bool(np.all(owner[1:] >= owner[:-1])) if len(owner) else True
+    if contiguous and os.environ.get("DCCRG_FORCE_TABLES") != "1":
+        hood_data = _closed_form_hoods(
+            hoods, dims, periodic, size, n_dev, owner,
+            local_ids, ghost_gidx, n_inner, L, R,
+            row_of_pos, send_rows, recv_rows, cap, dense_tables,
+            maps, reader_rows, perm,
+        )
+        layout = dict(
+            local_ids=local_ids, ghost_ids=ghost_ids, n_local=n_local,
+            n_inner=n_inner, L=L, R=R, row_of_pos=row_of_pos,
+        )
+        return layout, hood_data
+
+    hood_data = {}
+    for hid, offs in hoods.items():
+        k = len(offs)
+        rows_t, mask_t = dense_tables(offs)
         # offsets are per-slot constants (offset * cell size in index
         # units): stencils synthesize them on device from the mask, so
         # no [n_dev, L, k, 3] array is built here (offs_thunk serves

@@ -1,0 +1,65 @@
+"""Builder for the golden .dc fixture (tests/data/golden.dc).
+
+The fixture pins the on-disk checkpoint format: the test re-saves the
+loaded grid and asserts byte identity, so ANY change to the .dc layout
+(metadata records, offset table, payload interleaving, variable-field
+encoding) fails loudly instead of silently breaking old checkpoints.
+
+Regenerate (only on a DELIBERATE format change) with:
+    python tests/golden_fixture.py
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+GOLDEN_SCHEMA = {
+    "density": jnp.float32,
+    "flag": jnp.int32,
+    "count": jnp.int32,
+    "pos": ((4, 3), jnp.float32),  # variable, truncated by "count"
+}
+GOLDEN_VARIABLE = {"pos": "count"}
+
+
+def build_golden_grid(mesh=None):
+    """Deterministic small AMR grid: (4, 4, 2) level-0, two refined
+    cells, partition-independent per-cell values derived from ids."""
+    from dccrg_tpu.grid import Grid
+
+    g = (Grid(cell_data=GOLDEN_SCHEMA)
+         .set_initial_length((4, 4, 2))
+         .set_periodic(True, False, False)
+         .set_maximum_refinement_level(1)
+         .set_neighborhood_length(1)
+         .set_geometry("cartesian", start=(0.0, 0.0, 0.0),
+                       level_0_cell_length=(0.25, 0.25, 0.5))
+         .initialize(mesh))
+    g.refine_completely(np.uint64(1))
+    g.refine_completely(np.uint64(22))
+    g.stop_refining()
+    cells = g.plan.cells
+    ids = cells.astype(np.float64)
+    g.set_many(cells, {
+        "density": (ids * 0.5).astype(np.float32),
+        "flag": (cells % np.uint64(7)).astype(np.int32),
+        "count": (cells % np.uint64(5)).astype(np.int32),
+    })
+    pos = np.zeros((len(cells), 4, 3), dtype=np.float32)
+    for r in range(4):
+        for c in range(3):
+            pos[:, r, c] = (ids * (r + 1) + c).astype(np.float32)
+    g.set("pos", cells, pos)
+    return g
+
+
+if __name__ == "__main__":
+    import os, sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    g = build_golden_grid()
+    out = os.path.join(os.path.dirname(__file__), "data", "golden.dc")
+    g.save_grid_data(out, header=b"golden-v1\n", variable=GOLDEN_VARIABLE)
+    print(f"wrote {out} ({os.path.getsize(out)} bytes, "
+          f"{len(g.plan.cells)} cells)")

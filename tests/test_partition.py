@@ -134,3 +134,38 @@ def test_single_part_still_validates_weights():
         partition_cells(mp, cells, 1, weights=-np.ones(16))
     with pytest.raises(ValueError, match="shape"):
         partition_cells(mp, cells, 1, weights=np.ones(3))
+
+
+def test_cut_without_edges_is_rcb():
+    mp = Mapping((8, 8, 1))
+    cells = np.arange(1, 65, dtype=np.uint64)
+    a = partition_cells(mp, cells, 4, method="cut")
+    b = partition_cells(mp, cells, 4, method="rcb")
+    np.testing.assert_array_equal(a, b)
+
+
+def test_refine_cut_reduces_edge_cut_within_balance():
+    """A jagged 1-D chain partition: refinement should heal boundary
+    cells surrounded by the other device without wrecking balance."""
+    from dccrg_tpu.partition import refine_cut
+
+    n = 64
+    owner = np.zeros(n, dtype=np.int32)
+    owner[n // 2:] = 1
+    # isolated wrong-side islands (the jagged-boundary case the greedy
+    # majority sweep exists to heal)
+    owner[20] = 1
+    owner[44] = 0
+    src = np.concatenate([np.arange(n - 1), np.arange(1, n)])
+    dst = np.concatenate([np.arange(1, n), np.arange(n - 1)])
+    w = np.ones(n)
+
+    def cut(o):
+        return int(np.sum(o[src] != o[dst]))
+
+    before = cut(owner)
+    out = refine_cut(owner, w, src, dst, 2)
+    assert cut(out) < before
+    loads = np.bincount(out, minlength=2)
+    assert loads.max() <= 1.1 * n / 2 + 1
+    assert loads.min() >= 0.9 * n / 2 - 1
