@@ -265,8 +265,10 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     hoods = {hid: np.asarray(offs, dtype=np.int64).reshape(-1, 3)
              for hid, offs in neighborhoods.items()}
 
-    if n_dev == 1:
-        # closed-form: no lattice map, no tables
+    if n_dev == 1 and os.environ.get("DCCRG_FORCE_TABLES") != "1":
+        # closed-form: no lattice map, no tables (DCCRG_FORCE_TABLES=1
+        # falls through to the dense builder — the bench's roll-vs-
+        # table A/B leg and the cross-check path)
         return _build_single_device_plan(
             mapping, hoods, cells, dims, periodic, size, cap)
 
