@@ -167,13 +167,15 @@ _GATHER_VARS = ("DCCRG_FORCE_TABLES", "DCCRG_ROLL_STENCIL")
 
 
 def _set_gather_mode(mode):
-    """Force one gather mode: 'roll' (closed-form plan) or 'tables'
-    (dense gather tables, random gathers)."""
-    for v in _GATHER_VARS:
-        os.environ.pop(v, None)
+    """Force one gather mode: 'roll' (closed-form plan, rolls forced
+    even where the platform default is tables — e.g. the CPU backend)
+    or 'tables' (dense gather tables, random gathers)."""
     if mode == "tables":
         os.environ["DCCRG_FORCE_TABLES"] = "1"
         os.environ["DCCRG_ROLL_STENCIL"] = "0"
+    else:
+        os.environ.pop("DCCRG_FORCE_TABLES", None)
+        os.environ["DCCRG_ROLL_STENCIL"] = "1"
 
 
 def ab_roll_vs_tables():
@@ -186,7 +188,7 @@ def ab_roll_vs_tables():
     explicit settings."""
     if os.environ.get("BENCH_SKIP_AB") == "1" or any(
             v in os.environ for v in _GATHER_VARS):
-        return None, None, None
+        return None, None, None, None
     try:
         _set_gather_mode("roll")
         roll_ups, _ = bench_grid_path(AB_N, AB_STEPS, label="A/B roll")
@@ -196,27 +198,29 @@ def ab_roll_vs_tables():
         print(f"A/B leg failed ({e!r}); keeping roll default",
               file=sys.stderr)
         _set_gather_mode("roll")
-        return None, None, None
+        return None, None, None, None
     winner = "roll" if roll_ups >= table_ups else "tables"
     if winner == "tables":
         # dense tables at the main size cost ~5 bytes x cells x slots
         # plus same-size build temporaries; a host OOM kill would skip
-        # the JSON line entirely, so cap the mode at a measured budget
+        # the JSON line entirely, so cap the mode at a memory budget
+        # (default 16 GiB — a TPU-VM host comfortably holds the 512^3
+        # build; the override is recorded in the JSON when it fires)
         est = GRID_N ** 3 * 6 * 5 * 2
-        cap = int(os.environ.get("BENCH_TABLES_MEM_CAP", str(6 << 30)))
+        cap = int(os.environ.get("BENCH_TABLES_MEM_CAP", str(16 << 30)))
         if est > cap:
             print(
                 f"A/B picked tables but {GRID_N}^3 table build (~{est>>30}"
                 f" GiB) exceeds BENCH_TABLES_MEM_CAP; keeping roll",
                 file=sys.stderr,
             )
-            winner = "roll"
+            return "roll", roll_ups, table_ups, "tables-won-but-mem-capped"
     print(
         f"A/B at {AB_N}^3: roll {roll_ups:.3g}/s vs tables "
         f"{table_ups:.3g}/s -> {winner}",
         file=sys.stderr,
     )
-    return winner, roll_ups, table_ups
+    return winner, roll_ups, table_ups, None
 
 
 def probe_backend(timeout_s: int = 150) -> bool:
@@ -263,11 +267,19 @@ def main() -> None:
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
     user_env = {v: os.environ[v] for v in _GATHER_VARS if v in os.environ}
-    winner, ab_roll, ab_tables = ab_roll_vs_tables()
-    mode_used = winner or ("tables" if user_env.get("DCCRG_FORCE_TABLES")
-                           else "roll")
+    winner, ab_roll, ab_tables, ab_note = ab_roll_vs_tables()
     if winner is not None:
+        mode_used, mode_source = winner, ("ab" if ab_note is None
+                                          else "ab-mem-capped")
         _set_gather_mode(winner)
+    else:
+        # user-exported overrides (A/B skipped): tables when dense
+        # tables or table gathers were explicitly requested
+        mode_used = ("tables"
+                     if (user_env.get("DCCRG_FORCE_TABLES") == "1"
+                         or user_env.get("DCCRG_ROLL_STENCIL") == "0")
+                     else "roll")
+        mode_source = "user-env" if user_env else "default"
     try:
         grid_ups, grid_l2 = bench_grid_path()
     except Exception as e:
@@ -275,7 +287,7 @@ def main() -> None:
         print(f"grid path bench failed ({e!r}); retrying with "
               f"{other} gathers", file=sys.stderr)
         _set_gather_mode(other)
-        mode_used = other
+        mode_used, mode_source = other, "fallback-after-failure"
         try:
             grid_ups, grid_l2 = bench_grid_path()
         except Exception as e2:  # keep the JSON line flowing for the driver
@@ -309,6 +321,7 @@ def main() -> None:
                                           if grid_ups is not None else None),
                 "l2_error": grid_l2,
                 "gather_mode": mode_used,
+                "gather_mode_source": mode_source,
                 "ab_roll_updates_per_sec": ab_roll,
                 "ab_tables_updates_per_sec": ab_tables,
                 "pallas_updates_per_sec": pallas_ups,

@@ -1148,6 +1148,11 @@ class Grid:
         # inner/outer reorder, so rows are the identity and the scatter
         # is a contiguous copy
         identity = fresh and self.n_dev == 1 and len(rows) == len(self.plan.cells)
+        # partial writes scatter ON DEVICE: only the written rows cross
+        # the host boundary, instead of a full array pull + re-upload
+        # per field (the staged-balance landing path and every host
+        # set() ride this)
+        partial = (not fresh) and len(rows) < len(self.plan.cells)
         for name, values in values_by_field.items():
             shape, dtype = self.fields[name]
             if fresh:
@@ -1156,10 +1161,60 @@ class Grid:
                     host[0, : len(rows)] = np.asarray(values, dtype=dtype)
                     self.data[name] = jnp.asarray(host, device=self._sharding())
                     continue
+            elif partial:
+                self.data[name] = self._device_scatter(
+                    name, dev, rows, np.asarray(values, dtype=dtype))
+                continue
             else:
                 host = np.asarray(self.data[name]).copy()
             host[dev, rows] = values
             self.data[name] = jnp.asarray(host, device=self._sharding())
+
+    def _device_scatter(self, name, dev, rows, values):
+        """Masked per-device scatter of ``values`` into rows
+        ``(dev, rows)`` of field ``name``: indices and values are
+        padded to a bucketed capacity (pad writes land as zeros on the
+        permanent zero pad row), broadcast to every device, and each
+        device applies only its own writes under shard_map — no
+        collective and no full-array host round trip."""
+        shape, dtype = self.fields[name]
+        n = len(rows)
+        # fixed small tier, then buckets: adapt-epoch projection writes
+        # (new children / unrefined parents, surface-sized) all land in
+        # ONE program per field regardless of their per-epoch drift
+        # (the zero-new-programs invariant, test_advection_amr); only
+        # rare large landings (balance restructure) take bucketed caps
+        cap = 4096 if n <= 4096 else bucket_capacity(n)
+        R = self.plan.R
+        dev_p = np.zeros(cap, dtype=np.int32)
+        row_p = np.full(cap, R - 1, dtype=np.int32)
+        val_p = np.zeros((cap,) + shape, dtype=dtype)
+        dev_p[:n] = dev
+        row_p[:n] = rows
+        if n:
+            val_p[:n] = np.broadcast_to(values, (n,) + shape)
+        # keyed by (shape, dtype), not field name: same-shaped fields
+        # share one compiled scatter
+        key = ("devscatter", shape, str(dtype), cap, R)
+        fn = self._program_cache.get(key)
+        if fn is None:
+            mesh, axis = self.mesh, self.axis
+
+            def body(arr, dv, rw, vl):
+                mine = dv == jax.lax.axis_index(axis)
+                r = jnp.where(mine, rw, R - 1)
+                mexp = mine.reshape(mine.shape + (1,) * len(shape))
+                safe = jnp.where(mexp, vl, jnp.zeros((), arr.dtype))
+                return arr.at[0, r].set(safe, mode="drop")
+
+            fn = jax.jit(_shard_map(
+                body, mesh=mesh,
+                in_specs=(P(self.axis), P(), P(), P()),
+                out_specs=P(self.axis),
+            ))
+            self._program_cache[key] = fn
+        return fn(self.data[name], jnp.asarray(dev_p), jnp.asarray(row_p),
+                  jnp.asarray(val_p))
 
     # -- iteration views (dccrg.hpp:7594-7718) -------------------------
 
