@@ -1,0 +1,73 @@
+"""Pallas Poisson (7-point Laplacian) kernel tests.
+
+Same contract as test_pallas_kernel.py: on TPU the kernel runs
+natively; on the CPU mesh it runs under Pallas's interpret mode, so CI
+exercises the real kernel body (DMAs, semaphores, grid pipeline), not
+only a numpy mirror."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dccrg_tpu.ops.poisson_kernel import (
+    PallasPoissonSolver, make_laplacian_matvec,
+)
+
+
+def on_tpu():
+    return jax.devices()[0].platform == "tpu"
+
+
+def reference_laplacian(p, periodic, cell_length):
+    rd = [1.0 / c**2 for c in cell_length]
+    want = np.zeros_like(p)
+    for d in range(3):
+        for sgn in (-1, 1):
+            t = np.roll(p, -sgn, axis=d) - p
+            if not periodic[d]:
+                idx = np.arange(p.shape[d])
+                edge = (idx == p.shape[d] - 1) if sgn > 0 else (idx == 0)
+                shape = [-1 if dd == d else 1 for dd in range(3)]
+                t = np.where(edge.reshape(shape), 0.0, t)
+            want += rd[d] * t
+    return want
+
+
+@pytest.mark.parametrize("periodic", [
+    (True, True, True), (False, True, True), (False, False, False),
+])
+def test_matvec_matches_reference(periodic):
+    X, Y, Z = (32, 16, 256) if on_tpu() else (16, 8, 128)
+    rng = np.random.default_rng(3)
+    p = rng.random((X, Y, Z)).astype(np.float32)
+    mv = make_laplacian_matvec((X, Y, Z), periodic=periodic,
+                               interpret=not on_tpu())
+    got = np.asarray(mv(p))
+    want = reference_laplacian(
+        p, periodic, (1.0 / X, 1.0 / Y, 1.0 / Z)).astype(np.float32)
+    scale = max(1.0, np.abs(want).max())
+    np.testing.assert_allclose(got / scale, want / scale, atol=5e-6)
+
+
+def test_cg_solve_matches_dense_path():
+    """Full CG through the Pallas matvec lands on the same solution as
+    DensePoissonSolver (the XLA dense path) on a manufactured RHS."""
+    from dccrg_tpu.models.poisson import DensePoissonSolver
+
+    X, Y, Z = 16, 8, 128
+    rng = np.random.default_rng(5)
+    rhs = rng.random((X, Y, Z)).astype(np.float32)
+    rhs -= rhs.mean()
+    pal = PallasPoissonSolver((X, Y, Z), interpret=not on_tpu())
+    xs, info = pal.solve(rhs, rtol=1e-5)
+    dense = DensePoissonSolver((X, Y, Z))
+    xd, info_d = dense.solve(rhs, rtol=1e-5)
+    assert info["iterations"] > 0
+    # both solve the same SPD system to the same tolerance: compare
+    # against each other after gauge fixing (both are zero-mean)
+    na = np.asarray(xs, dtype=np.float64)
+    nb = np.asarray(xd, dtype=np.float64)
+    denom = max(np.abs(nb).max(), 1e-9)
+    np.testing.assert_allclose(na / denom, nb / denom, atol=5e-4)

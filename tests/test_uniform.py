@@ -294,3 +294,42 @@ class TestMultiDeviceClosedForm:
             return g.get("v", cells)
 
         np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
+
+
+def test_closed_form_weighted_contiguous_partition(monkeypatch):
+    """Weighted cuts keep owner contiguous in id order, so the
+    closed-form multi-device plan must activate and agree with the
+    dense build under skewed weights too."""
+    import jax
+    from jax.sharding import Mesh
+    from dccrg_tpu.grid import DEFAULT_NEIGHBORHOOD_ID, Grid
+
+    def mk(force):
+        if force:
+            monkeypatch.setenv("DCCRG_FORCE_TABLES", "1")
+        else:
+            monkeypatch.delenv("DCCRG_FORCE_TABLES", raising=False)
+        g = (Grid(cell_data={"v": jnp.float32})
+             .set_initial_length((6, 6, 6))
+             .set_periodic(True, True, True)
+             .initialize(Mesh(np.array(jax.devices()[:4]), ("dev",)),
+                         partition="block"))
+        cells = g.plan.cells
+        # skewed weights -> uneven but still contiguous cuts
+        for c in cells[: len(cells) // 3]:
+            g.set_cell_weight(c, 5.0)
+        g.set_load_balancing_method("block")
+        g.balance_load()
+        return g
+
+    ga, gb = mk(False), mk(True)
+    ha = ga.plan.hoods[DEFAULT_NEIGHBORHOOD_ID]
+    assert ha.closed_form is not None and ha.closed_form.get("multi")
+    assert np.asarray([len(x) for x in ga.plan.local_ids]).std() > 0
+    for d in range(4):
+        np.testing.assert_array_equal(ga.plan.local_ids[d], gb.plan.local_ids[d])
+    hb = gb.plan.hoods[DEFAULT_NEIGHBORHOOD_ID]
+    np.testing.assert_array_equal(np.asarray(ha.nbr_rows),
+                                  np.asarray(hb.nbr_rows))
+    np.testing.assert_array_equal(np.asarray(ha.nbr_mask),
+                                  np.asarray(hb.nbr_mask))
