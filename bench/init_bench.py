@@ -9,11 +9,27 @@ Run: python bench/init_bench.py [--max 256]
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# --devices N (parsed pre-jax): virtual CPU device count, so the
+# multi-device closed-form rows below measure a real n-device mesh
+_n_dev = 1
+for _i, _a in enumerate(sys.argv):
+    if _a == "--devices":
+        _n_dev = int(sys.argv[_i + 1])
+    elif _a.startswith("--devices="):
+        _n_dev = int(_a.split("=", 1)[1])
+if _n_dev > 1:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={_n_dev}"
+        )
 
 import jax
 
@@ -90,10 +106,48 @@ def time_field_init(n):
     return construct, synced, n_cells
 
 
+def time_multi_device_init(n, n_dev):
+    """n-device uniform init + first roll plan: block partitions take
+    the closed-form multi-device plan (no dense tables); morton takes
+    the dense path — the two rows bound the closed-form win."""
+    from jax.sharding import Mesh
+
+    from dccrg_tpu.grid import DEFAULT_NEIGHBORHOOD_ID
+
+    if len(jax.devices()) < n_dev:
+        raise RuntimeError(
+            f"--devices {n_dev} requested but only {len(jax.devices())} "
+            "devices exist (inherited XLA_FLAGS already pins "
+            "xla_force_host_platform_device_count?)"
+        )
+    out = []
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("dev",))
+    for part in ("block", "morton"):
+        t0 = time.time()
+        g = (
+            dt.Grid(cell_data={"density": jnp.float32})
+            .set_initial_length((n, n, n))
+            .set_maximum_refinement_level(0)
+            .set_neighborhood_length(0)
+            .initialize(mesh, partition=part)
+        )
+        hood = g.plan.hoods[DEFAULT_NEIGHBORHOOD_ID]
+        hood.roll_plan(g.plan.L)
+        secs = time.time() - t0
+        closed = hood.closed_form is not None
+        out.append({
+            "size": f"{n}^3 x {n_dev} devices", "partition": part,
+            "seconds": round(secs, 2), "closed_form": closed,
+        })
+        del g
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--max", type=int, default=256)
     ap.add_argument("--amr-max", type=int, default=128)
+    ap.add_argument("--devices", type=int, default=1)
     args = ap.parse_args()
     sizes = [s for s in (64, 128, 256, 512) if s <= args.max]
     results = []
@@ -115,6 +169,10 @@ def main():
         "cells": n_cells,
     })
     print(json.dumps(results[-1]))
+    if args.devices > 1:
+        for row in time_multi_device_init(min(args.max, 256), args.devices):
+            results.append(row)
+            print(json.dumps(row))
     for n in (s for s in (64, 128, 256) if s <= args.amr_max):
         first, second, n_cells = time_amr_commit(n)
         results.append({

@@ -48,6 +48,7 @@ def main():
         return dense._matvec(arrays)["Ap"]
 
     results = {"size": f"{n}^3", "platform": jax.devices()[0].platform}
+    float(jnp.sum(p))  # pre-compile the sync reduction OUTSIDE timing
     for name, mv in (("pallas", mv_pallas), ("xla_dense", dense_mv)):
         out = mv(p)
         out.block_until_ready()  # compile + warm

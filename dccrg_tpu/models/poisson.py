@@ -526,31 +526,42 @@ class DensePoissonSolver:
         self._matvec = self.grid.make_step(lap_kernel, ("p",), ("Ap",), halo=1)
 
     def solve(self, rhs, rtol=1e-5, max_iterations=1000):
-        singular = all(self.periodic)
-        rhs = jnp.asarray(rhs, dtype=self.dtype)
-        if singular:
-            rhs = rhs - jnp.mean(rhs)
-        x = jnp.zeros_like(rhs)
-        arrays = {"p": x, "Ap": x}  # working set for the matvec step
-        r = rhs
-        p = r
-        rs = float(jnp.sum(r * r))
-        target = max(rtol * rtol * float(jnp.sum(rhs * rhs)), 1e-30)
-        it = 0
-        while rs > target and it < max_iterations:
-            arrays["p"] = p
-            arrays = self._matvec(arrays)
-            Ap = arrays["Ap"]
-            pAp = float(jnp.sum(p * Ap))
-            if pAp == 0.0:
-                break
-            alpha = rs / pAp
-            x = x + alpha * p
-            r = r - alpha * Ap
-            rs_new = float(jnp.sum(r * r))
-            p = r + (rs_new / rs) * p
-            rs = rs_new
-            it += 1
-        if singular:
-            x = x - jnp.mean(x)
-        return x, {"iterations": it, "residual": float(np.sqrt(max(rs, 0.0)))}
+        def mv(p):
+            arrays = {"p": p, "Ap": p}
+            return self._matvec(arrays)["Ap"]
+
+        return cg_solve(mv, rhs, singular=all(self.periodic),
+                        dtype=self.dtype, rtol=rtol,
+                        max_iterations=max_iterations)
+
+
+def cg_solve(matvec, rhs, singular, dtype, rtol=1e-5, max_iterations=1000):
+    """Plain conjugate gradients over an SPD ``matvec`` callable —
+    shared by DensePoissonSolver (XLA dense step) and
+    PallasPoissonSolver (Pallas kernel matvec). ``singular`` removes
+    the constant null space (all-periodic Laplacian): the RHS and the
+    solution are projected to zero mean."""
+    rhs = jnp.asarray(rhs, dtype=dtype)
+    if singular:
+        rhs = rhs - jnp.mean(rhs)
+    x = jnp.zeros_like(rhs)
+    r = rhs
+    p = r
+    rs = float(jnp.sum(r * r))
+    target = max(rtol * rtol * float(jnp.sum(rhs * rhs)), 1e-30)
+    it = 0
+    while rs > target and it < max_iterations:
+        Ap = matvec(p)
+        pAp = float(jnp.sum(p * Ap))
+        if pAp == 0.0:
+            break
+        alpha = rs / pAp
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rs_new = float(jnp.sum(r * r))
+        p = r + (rs_new / rs) * p
+        rs = rs_new
+        it += 1
+    if singular:
+        x = x - jnp.mean(x)
+    return x, {"iterations": it, "residual": float(np.sqrt(max(rs, 0.0)))}

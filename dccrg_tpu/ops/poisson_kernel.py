@@ -196,28 +196,8 @@ class PallasPoissonSolver:
         )
 
     def solve(self, rhs, rtol=1e-5, max_iterations=1000):
-        singular = all(self.periodic)
-        rhs = jnp.asarray(rhs, dtype=self.dtype)
-        if singular:
-            rhs = rhs - jnp.mean(rhs)
-        x = jnp.zeros_like(rhs)
-        r = rhs
-        p = r
-        rs = float(jnp.sum(r * r))
-        target = max(rtol * rtol * float(jnp.sum(rhs * rhs)), 1e-30)
-        it = 0
-        while rs > target and it < max_iterations:
-            Ap = self._matvec(p)
-            pAp = float(jnp.sum(p * Ap))
-            if pAp == 0.0:
-                break
-            alpha = rs / pAp
-            x = x + alpha * p
-            r = r - alpha * Ap
-            rs_new = float(jnp.sum(r * r))
-            p = r + (rs_new / rs) * p
-            rs = rs_new
-            it += 1
-        if singular:
-            x = x - jnp.mean(x)
-        return x, {"iterations": it, "residual": float(np.sqrt(max(rs, 0.0)))}
+        from ..models.poisson import cg_solve
+
+        return cg_solve(self._matvec, rhs, singular=all(self.periodic),
+                        dtype=self.dtype, rtol=rtol,
+                        max_iterations=max_iterations)
