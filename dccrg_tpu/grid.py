@@ -107,6 +107,11 @@ def _trim_allocator():
         pass
 
 
+# fixed tier for small host get/set transfers: one compiled
+# gather/scatter program per field shape regardless of query-size drift
+_GATHER_TIER = 4096
+
+
 def bucket_capacity(n: int) -> int:
     """Round a capacity up to a quarter-power-of-two bucket (16, 20,
     24, 28, 32, 40, ...): structure changes that stay within a bucket
@@ -1123,12 +1128,59 @@ class Grid:
         return dev, rows
 
     def get(self, field: str, ids) -> np.ndarray:
-        """Host read of per-cell data (reference operator[] access)."""
+        """Host read of per-cell data (reference operator[] access).
+        Small queries gather ON device and pull only the requested
+        rows (a full 512^3 field is half a GB; a few cells should not
+        cost a whole-array transfer); large/whole-grid reads pull the
+        array once."""
         scalar = np.isscalar(ids) or np.asarray(ids).ndim == 0
         dev, rows = self._host_rows(ids)
-        host = np.asarray(self.data[field])
-        out = host[dev, rows]
+        if (0 < len(rows) <= _GATHER_TIER
+                and len(rows) < len(self.plan.cells) // 4):
+            out = self._device_gather(field, dev, rows)
+        else:
+            host = np.asarray(self.data[field])
+            out = host[dev, rows]
         return out[0] if scalar else out
+
+    def _device_gather(self, name, dev, rows, cap=None):
+        """Compact device-side gather of rows ``(dev, rows)`` of field
+        ``name``: indices pad to a fixed tier (pad reads hit the zero
+        pad row), every device extracts its own rows under shard_map,
+        a psum merges them, and only [cap] rows cross to the host.
+        One compiled program per (shape, dtype, R)."""
+        shape, dtype = self.fields[name]
+        n = len(rows)
+        if cap is None:
+            cap = _GATHER_TIER if n <= _GATHER_TIER else bucket_capacity(n)
+        R = self.plan.R
+        dev_p = np.zeros(cap, dtype=np.int32)
+        row_p = np.full(cap, R - 1, dtype=np.int32)
+        dev_p[:n] = dev
+        row_p[:n] = rows
+        key = ("devgather", shape, str(dtype), cap, R)
+        fn = self._program_cache.get(key)
+        if fn is None:
+            mesh, axis = self.mesh, self.axis
+
+            def body(arr, dv, rw):
+                mine = dv == jax.lax.axis_index(axis)
+                r = jnp.where(mine, rw, R - 1)  # zero pad row
+                vals = arr[0, r]
+                mexp = mine.reshape(mine.shape + (1,) * len(shape))
+                vals = jnp.where(mexp, vals, jnp.zeros((), arr.dtype))
+                return jax.lax.psum(vals, axis)
+
+            fn = jax.jit(_shard_map(
+                body, mesh=mesh,
+                in_specs=(P(self.axis), P(), P()),
+                out_specs=P(),
+            ))
+            self._program_cache[key] = fn
+        out = np.asarray(fn(self.data[name], jnp.asarray(dev_p),
+                            jnp.asarray(row_p)))
+        # psum promotes bool to int; keep the field dtype for both paths
+        return out[:n].astype(dtype, copy=False)
 
     def set(self, field: str, ids, values) -> None:
         """Host write of per-cell data (init / tests / boundary setup)."""
@@ -1184,7 +1236,7 @@ class Grid:
         # ONE program per field regardless of their per-epoch drift
         # (the zero-new-programs invariant, test_advection_amr); only
         # rare large landings (balance restructure) take bucketed caps
-        cap = 4096 if n <= 4096 else bucket_capacity(n)
+        cap = _GATHER_TIER if n <= _GATHER_TIER else bucket_capacity(n)
         R = self.plan.R
         dev_p = np.zeros(cap, dtype=np.int32)
         row_p = np.full(cap, R - 1, dtype=np.int32)
