@@ -1,7 +1,10 @@
 """Parallel checkpoint / restart.
 
-Logical equivalent of the reference's .dc file format
-(dccrg.hpp:1109-2426; layout documented at :1125-1142):
+BYTE-COMPATIBLE implementation of the reference's .dc file format
+(dccrg.hpp:1109-2426; layout documented at :1125-1142; conformance
+pinned by tests/test_golden.py::test_reference_write_sequence_loads,
+which replays the reference's write calls with struct.pack and loads
+the result):
 
     [user header bytes]
     uint64 endianness magic 0x1234567890abcdef        (:1243)
@@ -95,7 +98,7 @@ def parse_metadata(data, header_size: int = 0):
     returns (mapping, hood_len, topology, geometry, cells, offsets,
     payload_start). Shared by load paths and dc_to_vtk. ``data`` is a
     bytes-like (a memory map works)."""
-    from .geometry import geometry_from_bytes
+    from .geometry import geometry_from_buffer
     from .mapping import Mapping
     from .topology import GridTopology
 
@@ -113,9 +116,46 @@ def parse_metadata(data, header_size: int = 0):
     pos += 4
     topology = GridTopology.from_bytes(bytes(data[pos : pos + 3]))
     pos += 3
-    (geom_len,) = struct.unpack_from("<I", data, pos)
-    pos += 4
-    geometry = geometry_from_bytes(bytes(data[pos : pos + geom_len]), mapping, topology)
+    # the geometry record is self-describing via its id — no length
+    # prefix, exactly the reference's layout (dccrg.hpp:1312-1323)
+    try:
+        geometry, geom_len = geometry_from_buffer(data, pos, mapping, topology)
+    except ValueError:
+        # legacy files from this repo before round 4 carried a u32
+        # record-length prefix here; its value (>= 4) can never be a
+        # valid geometry id, so falling back on that signature is
+        # unambiguous
+        (legacy_len,) = struct.unpack_from("<I", data, pos)
+        (legacy_gid,) = struct.unpack_from("<i", data, pos + 4)
+        if legacy_gid == 2:
+            # legacy stretched records carried no coordinate counts;
+            # sizes come from the mapping's level-0 lengths
+            from .geometry import StretchedCartesianGeometry
+
+            coords, off = [], pos + 8
+            for d in range(3):
+                n = int(mapping.length.get()[d]) + 1
+                coords.append(np.frombuffer(
+                    data, dtype=np.float64, count=n, offset=off).copy())
+                off += 8 * n
+            geometry = StretchedCartesianGeometry(mapping, topology, coords)
+            geom_len = off - pos - 4
+        else:
+            try:
+                geometry, geom_len = geometry_from_buffer(
+                    data, pos + 4, mapping, topology)
+            except (ValueError, struct.error):
+                raise ValueError(
+                    "unrecognized geometry record (neither the reference "
+                    ".dc layout nor this repo's legacy length-prefixed "
+                    "form)"
+                )
+        if geom_len != legacy_len:
+            raise ValueError(
+                f"legacy geometry length prefix {legacy_len} does not "
+                f"match the parsed record ({geom_len} bytes)"
+            )
+        geom_len += 4
     pos += geom_len
     (n_cells,) = struct.unpack_from("<Q", data, pos)
     pos += 8
@@ -202,7 +242,7 @@ def save_grid_data(grid, filename: str, header: bytes = b"",
     meta += struct.pack("<I", grid._hood_len)
     meta += grid.topology.to_bytes()
     geom = grid.geometry.to_bytes()
-    meta += struct.pack("<I", len(geom)) + geom
+    meta += geom  # self-describing record, no length prefix
     meta += struct.pack("<Q", len(cells))
 
     # per-cell byte sizes (variable fields contribute count * row)

@@ -371,7 +371,12 @@ class StretchedCartesianGeometry(_GeometryBase):
         return self.coordinates[dimension]
 
     def to_bytes(self) -> bytes:
-        out = [struct.pack("<i", self.geometry_id)]
+        # id, 3 x u64 coordinate counts, then the coordinate arrays —
+        # byte-identical to the reference's record
+        # (dccrg_stretched_cartesian_geometry.hpp:652-713)
+        out = [struct.pack("<i", self.geometry_id),
+               struct.pack("<3Q", *(len(self.coordinates[d])
+                                    for d in range(3)))]
         for d in range(3):
             out.append(self.coordinates[d].tobytes())
         return b"".join(out)
@@ -381,22 +386,37 @@ class StretchedCartesianGeometry(_GeometryBase):
         return "stretched", {"coordinates": [c.copy() for c in self.coordinates]}
 
 
-def geometry_from_bytes(data: bytes, mapping: Mapping, topology: GridTopology):
-    """Reconstruct a geometry from its file record (inverse of
-    ``to_bytes``; geometry ids per dccrg_no_geometry.hpp:55,
-    dccrg_cartesian_geometry.hpp:106, dccrg_stretched_...hpp:78)."""
-    (gid,) = struct.unpack_from("<i", data, 0)
+def geometry_from_buffer(data, offset: int, mapping: Mapping,
+                         topology: GridTopology):
+    """Parse the geometry record starting at ``offset``: returns
+    ``(geometry, record_size)``. The record is self-describing via its
+    id — NO length prefix, exactly the reference's layout (geometry
+    ids per dccrg_no_geometry.hpp:55, dccrg_cartesian_geometry.hpp:106,
+    dccrg_stretched_...hpp:78; write sequences :620-672 and
+    :652-713)."""
+    (gid,) = struct.unpack_from("<i", data, offset)
     if gid == 0:
-        return NoGeometry(mapping, topology)
+        return NoGeometry(mapping, topology), 4
     if gid == 1:
-        vals = np.frombuffer(data, dtype=np.float64, count=6, offset=4)
-        return CartesianGeometry(mapping, topology, vals[:3], vals[3:])
+        vals = np.frombuffer(data, dtype=np.float64, count=6,
+                             offset=offset + 4)
+        return CartesianGeometry(mapping, topology, vals[:3], vals[3:]), 52
     if gid == 2:
+        counts = struct.unpack_from("<3Q", data, offset + 4)
         coords = []
-        off = 4
+        off = offset + 4 + 24
         for d in range(3):
-            n = int(mapping.length.get()[d]) + 1
-            coords.append(np.frombuffer(data, dtype=np.float64, count=n, offset=off).copy())
+            n = int(counts[d])
+            coords.append(np.frombuffer(data, dtype=np.float64, count=n,
+                                        offset=off).copy())
             off += 8 * n
-        return StretchedCartesianGeometry(mapping, topology, coords)
+        return (StretchedCartesianGeometry(mapping, topology, coords),
+                off - offset)
     raise ValueError(f"unknown geometry id {gid}")
+
+
+def geometry_from_bytes(data: bytes, mapping: Mapping, topology: GridTopology):
+    """Reconstruct a geometry from exactly its file record (inverse of
+    ``to_bytes``)."""
+    geom, _size = geometry_from_buffer(data, 0, mapping, topology)
+    return geom
