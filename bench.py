@@ -33,18 +33,21 @@ STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 
 
 def measure_baseline() -> float:
-    """Single-node reference throughput, MEASURED: the C++ upwind loop
+    """Single-node reference throughput: the C++ upwind loop
     (bench/baseline_advection.cpp, the reference's solve.hpp math) at
     the bench's own per-core problem size, fork-parallel across the
-    host's cores (capped at a nominal node width). No perfect-scaling
-    assumption: the figure is total updates / wall time of the
-    concurrently running processes, and the cache records the core
-    count actually used."""
+    host's cores. When the host has fewer cores than the nominal
+    32-core node, the concurrent measurement is extrapolated to
+    NODE_CORES at perfect MPI scaling — deliberately generous to the
+    reference (tests/advection/2d.cpp:453-503 reports per-rank sums) —
+    so a 1-core build host still yields a full-node bar. The cache
+    records both the measured aggregate and the node figure; the bench
+    compares against the node figure."""
     cache = ROOT / "bench" / "baseline_measured.json"
     if cache.exists():
         got = json.loads(cache.read_text())
-        if "node_cores_used" in got:  # new-format cache only
-            return got["single_node_cell_updates_per_sec"]
+        if "node_cell_updates_per_sec" in got:  # current-format cache only
+            return got["node_cell_updates_per_sec"]
     exe = ROOT / "bench" / "baseline_advection"
     src = ROOT / "bench" / "baseline_advection.cpp"
     subprocess.run(
@@ -56,27 +59,41 @@ def measure_baseline() -> float:
     # least a few z-planes per rank
     nzp = max(8, NZ // cores)
     steps = 3
-    t0 = time.perf_counter()
-    procs = [
-        subprocess.Popen([str(exe), str(N), str(nzp), str(steps)],
-                         stdout=subprocess.PIPE, text=True)
-        for _ in range(cores)
-    ]
-    for p in procs:
-        p.wait()
-    wall = time.perf_counter() - t0
-    for p in procs:
-        if p.returncode != 0:
-            raise RuntimeError("baseline_advection failed")
-    per_core_internal = [float(p.stdout.read().strip()) for p in procs]
+
+    def trial():
+        t0 = time.perf_counter()
+        procs = [
+            subprocess.Popen([str(exe), str(N), str(nzp), str(steps)],
+                             stdout=subprocess.PIPE, text=True)
+            for _ in range(cores)
+        ]
+        for p in procs:
+            p.wait()
+        wall = time.perf_counter() - t0
+        for p in procs:
+            if p.returncode != 0:
+                raise RuntimeError("baseline_advection failed")
+        return [float(p.stdout.read().strip()) for p in procs], wall
+
+    # best of 3: the baseline must not be deflated by transient load on
+    # a shared host (that would flatter vs_baseline)
+    trials = [trial() for _ in range(3)]
+    per_core_internal, wall = max(trials, key=lambda t: sum(t[0]))
     # each process times its own stepping loop while all run
-    # concurrently: the sum is the node throughput under real memory
+    # concurrently: the sum is the host throughput under real memory
     # contention, without charging process startup to the reference
-    node_rate = sum(per_core_internal)
+    measured_rate = sum(per_core_internal)
+    # extrapolate to the nominal node width at perfect scaling when the
+    # host is narrower than a node (generous to the reference: real MPI
+    # scaling is sublinear under shared-memory-bandwidth contention)
+    node_rate = measured_rate * (NODE_CORES / cores)
     result = {
         "single_core_cell_updates_per_sec": max(per_core_internal),
-        "single_node_cell_updates_per_sec": node_rate,
+        "measured_aggregate_cell_updates_per_sec": measured_rate,
+        "node_cell_updates_per_sec": node_rate,
         "node_cores_used": cores,
+        "node_cores_nominal": NODE_CORES,
+        "node_extrapolated": cores < NODE_CORES,
         "per_core_size": [N, nzp, steps],
         "wall_seconds": wall,
     }
@@ -328,6 +345,11 @@ def main() -> None:
                 "pallas_l2_error": pallas_l2,
                 "pallas_note": ("specialized temporal-blocked kernel bound, "
                                 f"{N}^2x{NZ}; not the framework path"),
+                "baseline_node_updates_per_sec": baseline,
+                "baseline_note": (f"measured C++ upwind loop, extrapolated "
+                                  f"to a {NODE_CORES}-core node at perfect "
+                                  "MPI scaling (bench/baseline_measured"
+                                  ".json has the raw measurement)"),
                 "error": (None if grid_ups is not None else
                           ("grid path failed; value is the Pallas bound"
                            if pallas_ups is not None
@@ -337,7 +359,7 @@ def main() -> None:
     )
     # diagnostics on stderr only
     print(
-        f"baseline {baseline:.3g}/s (single-core x {NODE_CORES}); "
+        f"baseline {baseline:.3g}/s ({NODE_CORES}-core node equivalent); "
         f"devices {jax.devices()}",
         file=sys.stderr,
     )

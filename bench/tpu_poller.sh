@@ -1,0 +1,19 @@
+#!/bin/bash
+# Polls the axon TPU tunnel. Appends one line per probe to /tmp/tpu_poll.log;
+# writes /tmp/tpu_up when a probe succeeds, then keeps polling (so a flap is visible).
+while true; do
+  ts=$(date +%s)
+  out=$(timeout -k 5 90 python - <<'EOF' 2>&1
+import jax
+devs = jax.devices()
+print("OK", devs)
+EOF
+)
+  if [[ "$out" == OK* ]]; then
+    echo "$ts UP $out" >> /tmp/tpu_poll.log
+    echo "$ts" > /tmp/tpu_up
+  else
+    echo "$ts DOWN $(echo "$out" | tail -1 | head -c 200)" >> /tmp/tpu_poll.log
+  fi
+  sleep 300
+done
