@@ -120,13 +120,20 @@ def parse_metadata(data, header_size: int = 0):
     # prefix, exactly the reference's layout (dccrg.hpp:1312-1323)
     try:
         geometry, geom_len = geometry_from_buffer(data, pos, mapping, topology)
-    except ValueError:
+    except (ValueError, struct.error):
+        # struct.error covers a truncated record so the fallback (or
+        # its 'unrecognized geometry record' diagnostic) still fires
         # legacy files from this repo before round 4 carried a u32
         # record-length prefix here; its value (>= 4) can never be a
         # valid geometry id, so falling back on that signature is
         # unambiguous
-        (legacy_len,) = struct.unpack_from("<I", data, pos)
-        (legacy_gid,) = struct.unpack_from("<i", data, pos + 4)
+        try:
+            (legacy_len,) = struct.unpack_from("<I", data, pos)
+            (legacy_gid,) = struct.unpack_from("<i", data, pos + 4)
+        except struct.error:
+            raise ValueError(
+                "unrecognized geometry record (file truncated mid-record)"
+            ) from None
         if legacy_gid == 2:
             # legacy stretched records carried no coordinate counts;
             # sizes come from the mapping's level-0 lengths
@@ -342,15 +349,30 @@ def _scatter_payloads(grid, raw, cells, offsets, fixed_spec, fixed_bytes,
                 if vn == name:
                     break
                 base = base + hosts[vcf][dev, rows].astype(np.int64) * vrb
-            for i in range(len(ids)):
-                ci = int(c[i])
-                if ci == 0:
-                    continue
-                b = int(base[i])
-                vals = np.frombuffer(
-                    raw[b : b + ci * row_bytes], dtype=dtype
-                ).reshape((ci,) + row_shape)
-                hosts[name][dev[i], rows[i], :ci] = vals
+            # vectorized ragged read: fancy-index gathers over row
+            # sub-blocks (repeat/cumsum, the save side's pattern) —
+            # no per-cell Python (the reference's multi-pass collective
+            # read has no serial tail either, dccrg.hpp:2108-2390).
+            # The byte-index matrix costs index-dtype-size bytes per
+            # payload byte, so it is built in bounded sub-blocks with
+            # the narrowest index dtype the file size allows.
+            total = int(c.sum())
+            if total == 0:
+                continue
+            cell_of_row = np.repeat(np.arange(len(ids)), c)
+            row_within = (np.arange(total, dtype=np.int64)
+                          - np.repeat(np.cumsum(c) - c, c))
+            starts = base[cell_of_row] + row_within * row_bytes
+            idt = np.uint32 if raw.size < (1 << 32) else np.int64
+            span = np.arange(row_bytes, dtype=idt)[None, :]
+            blk = max(1, (8 << 20) // row_bytes)  # <=64 MB of u32 idx
+            for s in range(0, total, blk):
+                e = min(s + blk, total)
+                idx = starts[s:e, None].astype(idt) + span
+                vals = raw[idx].copy().view(dtype).reshape(
+                    (e - s,) + row_shape)
+                hosts[name][dev[cell_of_row[s:e]], rows[cell_of_row[s:e]],
+                            row_within[s:e]] = vals
 
     for name in grid.fields:
         grid.data[name] = jnp.asarray(hosts[name], device=grid._sharding())

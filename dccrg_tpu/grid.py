@@ -1067,12 +1067,22 @@ class Grid:
             arr = jnp.where(idx < n0, idx, jnp.int32(-1))
             arr = jax.device_put(arr, self._sharding())
         else:
-            if len(plan.cells) and int(plan.cells[-1]) > np.iinfo(np.int32).max:
+            # int64 rows when ids exceed int32 (deeply refined AMR
+            # grids): the closed-form multi stencil path can never get
+            # here (build_uniform_plan is gated at < 2^31 cells), so
+            # only field-init consumers see the wide dtype. Without
+            # x64, jnp.asarray would silently WRAP int64 to int32 —
+            # keep the loud failure in that configuration.
+            wide = bool(len(plan.cells)
+                        and int(plan.cells[-1]) > np.iinfo(np.int32).max)
+            if wide and not jax.config.jax_enable_x64:
                 raise ValueError(
-                    "cell ids exceed int32; device_row_ids() is for "
-                    "level-0-scale grids — initialize via set_many"
+                    "cell ids exceed int32 and JAX x64 is disabled; "
+                    "enable jax_enable_x64 for device_row_ids() on "
+                    "deeply refined grids, or initialize via set_many"
                 )
-            host = np.full((self.n_dev, plan.R), -1, dtype=np.int32)
+            host = np.full((self.n_dev, plan.R), -1,
+                           dtype=np.int64 if wide else np.int32)
             for d in range(self.n_dev):
                 nl = int(plan.n_local[d])
                 host[d, :nl] = plan.local_ids[d].astype(np.int64) - 1
@@ -1230,6 +1240,16 @@ class Grid:
         device applies only its own writes under shard_map — no
         collective and no full-array host round trip."""
         shape, dtype = self.fields[name]
+        # duplicate targets in one set_many: keep the LAST write, the
+        # host path's (numpy) semantics — XLA scatter leaves the winner
+        # among duplicate indices unspecified
+        flat = dev.astype(np.int64) * self.plan.R + rows
+        if len(np.unique(flat)) != len(flat):
+            _, last_rev = np.unique(flat[::-1], return_index=True)
+            keep = np.sort(len(flat) - 1 - last_rev)
+            dev, rows = dev[keep], rows[keep]
+            values = np.broadcast_to(
+                values, (len(flat),) + self.fields[name][0])[keep]
         n = len(rows)
         # fixed small tier, then buckets: adapt-epoch projection writes
         # (new children / unrefined parents, surface-sized) all land in
@@ -2007,6 +2027,79 @@ class Grid:
             return env == "1"
         return self._on_accelerator()
 
+    def _use_overlap(self) -> bool:
+        """Overlapped fused steps: start the halo collectives, run the
+        bulk kernel on pre-exchange state (inner rows' results are
+        final — they read no ghosts), then redo just the outer rows
+        after the scatter. Removes the collective -> kernel dependency
+        so XLA's async collective-permute runs under the MXU work —
+        the reference's solve-inner-while-messages-fly
+        (dccrg.hpp:5046-5413, tests/advection/2d.cpp:327-343). Costs a
+        surface-sized second kernel pass, so default on for
+        accelerators only; override with DCCRG_OVERLAP=0/1."""
+        env = os.environ.get("DCCRG_OVERLAP")
+        if env in ("0", "1"):
+            return env == "1"
+        return self._on_accelerator()
+
+    def _outer_tables(self, neighborhood_id, hood, use_roll, r_shifts, roll):
+        """Host tables for the overlapped step's outer re-pass:
+        ``(outer_rows [n_dev, Wo] int32, pad R-1;
+        outer_nbr_rows [n_dev, Wo, S] int32)`` — the rows
+        [n_inner, n_local) per device and their neighbor rows in the
+        full (local+ghost) array. None when overlap can't pay: no
+        outer rows, or outer is the majority of the grid (the re-pass
+        would cost more than the hidden collective). Memoized on the
+        hood (one structure epoch); capacity is sticky-bucketed so the
+        compiled program survives epochs."""
+        if getattr(hood, "_outer_skip", False):
+            return None
+        cached = getattr(hood, "_outer_host", None)
+        if cached is not None:
+            return cached
+        plan = self.plan
+        L, R = plan.L, plan.R
+        n_inner = np.asarray(hood.n_inner, dtype=np.int64)
+        n_local = np.asarray(plan.n_local, dtype=np.int64)
+        n_out_d = n_local - n_inner
+        if int(n_out_d.max(initial=0)) == 0 or (
+                2 * int(n_out_d.sum()) > int(n_local.sum())):
+            hood._outer_skip = True
+            return None
+        W = self._sticky_cap(("outerW", neighborhood_id), int(n_out_d.max()))
+        orow = np.full((self.n_dev, W), R - 1, dtype=np.int32)
+        for d in range(self.n_dev):
+            k = int(n_out_d[d])
+            orow[d, :k] = np.arange(n_inner[d], n_local[d], dtype=np.int32)
+        if use_roll:
+            # neighbor row = row + shift_j, overridden by the roll
+            # plan's fixups (ghost reads are always fixups); masked
+            # slots may hold junk — the outer gather re-applies the
+            # mask exactly as _make_nbr_gather does
+            shifts = np.asarray(r_shifts, dtype=np.int64)
+            S = len(shifts)
+            onr64 = orow.astype(np.int64)[:, :, None] + shifts[None, None, :]
+            wr = np.asarray(roll[1])
+            ws = np.asarray(roll[2])
+            for d in range(self.n_dev):
+                lo, hi = int(n_inner[d]), int(n_local[d])
+                for j in range(S):
+                    wrow = wr[d, j]
+                    sel = (wrow >= lo) & (wrow < hi)
+                    onr64[d, wrow[sel] - lo, j] = ws[d, j][sel]
+            onr = np.clip(onr64, 0, R - 1).astype(np.int32)
+            for d in range(self.n_dev):
+                onr[d, int(n_out_d[d]):] = R - 1
+        else:
+            nbr = np.asarray(hood.nbr_rows)
+            S = nbr.shape[2]
+            onr = np.full((self.n_dev, W, S), R - 1, dtype=np.int32)
+            for d in range(self.n_dev):
+                k = int(n_out_d[d])
+                onr[d, :k] = nbr[d, orow[d, :k]]
+        hood._outer_host = (orow, onr)
+        return hood._outer_host
+
     def _make_stencil(self, kernel, fields_in, fields_out, neighborhood_id, include_to,
                       n_extra=0):
         """(program, bound tables) for a gather stencil. The jitted
@@ -2271,10 +2364,21 @@ class Grid:
             tables.append(hood.dev("hard_nbr_rows", hood.hard_nbr_rows, sh))
             tables.append(hood.dev("hard_offs", hood.hard_offs, sh))
             tables.append(hood.dev("hard_mask", hood.hard_mask, sh))
+        overlap = (self.n_dev > 1 and hood.n_inner is not None
+                   and n_x > 0 and self._use_overlap())
+        if overlap:
+            ot = self._outer_tables(neighborhood_id, hood, use_roll,
+                                    r_shifts, roll)
+            if ot is None:
+                overlap = False
+            else:
+                tables.append(hood.dev("outer_rows", ot[0], sh))
+                tables.append(hood.dev("outer_nbr_rows", ot[1], sh))
 
         synth = _synth_key(cf)
         key = ("steploop", kernel, fields_in, fields_out, exch_idx, n_extra,
-               L, R, uniform_offs, scaled, split, r_shifts, synth, deltas)
+               L, R, uniform_offs, scaled, split, r_shifts, synth, deltas,
+               overlap)
         fn = self._program_cache.get(key)
         if fn is not None:
             return fn, tables, static_in
@@ -2307,6 +2411,10 @@ class Grid:
                 hr, hnr, hof, hm, *args = args
                 hr, hnr, hof, hm = hr[0], hnr[0], hof[0], hm[0]
                 hrc = jnp.minimum(hr, L - 1)
+            if overlap:
+                orow_t, onr_t, *args = args
+                orow, onr = orow_t[0], onr_t[0]
+                orc = jnp.minimum(orow, L - 1)
             def exchange_one(fl, xi):
                 # per-peer-offset ppermutes of compact buffers, or the
                 # dense all_to_all fallback (see _exchange_programs)
@@ -2327,14 +2435,66 @@ class Grid:
 
             def step(_, state):
                 state = list(state)
-                if n_dev > 1:
+                if overlap:
+                    # sends read only local rows: every round's
+                    # collective starts BEFORE the bulk kernel, with no
+                    # data dependency between them, so the scheduler
+                    # can fly the halos under the stencil compute
+                    # (async collective-permute) — the reference's
+                    # solve-inner-while-messages-fly overlap
+                    # (dccrg.hpp:5046-5413, 2d.cpp:327-343)
+                    payloads = [
+                        _halo_send(state[j], send_rs[xi * n_t + t],
+                                   None if deltas is None else deltas[t],
+                                   axis, n_dev)
+                        for xi, j in enumerate(exch_idx)
+                        for t in range(n_t)
+                    ]
+                    # bulk pass on pre-exchange state: rows
+                    # [0, n_inner) read no ghosts, so their results
+                    # are final; outer rows are redone below
+                    full = dict(statics)
+                    full.update(zip(fields_out, state))
+                    cell_fields = {n: full[n][:L] for n in fields_in}
+                    nbr_fields = {n: gather_nbr(full[n]) for n in fields_in}
+                    result = kernel(cell_fields, nbr_fields, noffs, nmask,
+                                    *extra)
+                    # land the halos, then redo just the outer rows
                     for xi, j in enumerate(exch_idx):
-                        state[j] = exchange_one(state[j], xi)
-                full = dict(statics)
-                full.update(zip(fields_out, state))
-                cell_fields = {n: full[n][:L] for n in fields_in}
-                nbr_fields = {n: gather_nbr(full[n]) for n in fields_in}
-                result = kernel(cell_fields, nbr_fields, noffs, nmask, *extra)
+                        fl = state[j]
+                        for t in range(n_t):
+                            fl = _halo_scatter(fl, recv_rs[xi * n_t + t],
+                                               payloads[xi * n_t + t], R)
+                        state[j] = fl.at[R - 1].set(0)
+                    full = dict(statics)
+                    full.update(zip(fields_out, state))
+                    cell_fields = {n: full[n][:L] for n in fields_in}
+                    om = nmask[orc]
+                    o_cell = {n: cell_fields[n][orc] for n in fields_in}
+                    o_nbr = {}
+                    for n in fields_in:
+                        g = full[n][onr]
+                        if use_roll:
+                            # mirror _make_nbr_gather's mask-zeroing
+                            mexp = om.reshape(om.shape
+                                              + (1,) * (g.ndim - 2))
+                            g = jnp.where(mexp, g,
+                                          jnp.zeros((), g.dtype))
+                        o_nbr[n] = g
+                    o_res = kernel(o_cell, o_nbr, noffs[orc], om, *extra)
+                    for n in fields_out:
+                        result[n] = result[n].at[orow].set(
+                            o_res[n].astype(result[n].dtype), mode="drop")
+                else:
+                    if n_dev > 1:
+                        for xi, j in enumerate(exch_idx):
+                            state[j] = exchange_one(state[j], xi)
+                    full = dict(statics)
+                    full.update(zip(fields_out, state))
+                    cell_fields = {n: full[n][:L] for n in fields_in}
+                    nbr_fields = {n: gather_nbr(full[n]) for n in fields_in}
+                    result = kernel(cell_fields, nbr_fields, noffs, nmask,
+                                    *extra)
                 if split:
                     h_cell = {n: cell_fields[n][hrc] for n in fields_in}
                     h_nbr = {n: full[n][hnr] for n in fields_in}
@@ -2359,6 +2519,7 @@ class Grid:
             + ((P(axis), P(axis)) if use_roll else ())
             + ((P(axis),) if scaled else ())
             + ((P(axis),) * 4 if split else ())
+            + ((P(axis), P(axis)) if overlap else ())
             + (P(axis),) * (n_static + n_out) + (P(),) * n_extra,
             out_specs=(P(axis),) * n_out,
             check_vma=False,
@@ -2434,9 +2595,10 @@ class Grid:
             edges = None
             methods = [lv.get("method") for lv in self._partitioning_levels]
             if self._lb_method == "cut" or "cut" in methods:
-                ck = (len(cells), int(cells[0]) if len(cells) else 0,
-                      int(cells[-1]) if len(cells) else 0,
-                      int(np.bitwise_xor.reduce(cells)) if len(cells) else 0)
+                # keyed on the grid's cell-set epoch (bumped by
+                # _restructure whenever the cell set changes) — a
+                # content fingerprint could collide across AMR commits
+                ck = getattr(self, "_cells_epoch", 0)
                 cached = getattr(self, "_cut_edges", None)
                 if cached is not None and cached[0] == ck:
                     edges = cached[1]
@@ -2809,6 +2971,12 @@ class Grid:
         pulling every field to host and re-uploading."""
         old_plan = self.plan
         old_R = old_plan.R
+        if (len(new_cells) != len(old_plan.cells)
+                or not np.array_equal(new_cells, old_plan.cells)):
+            # cell-set epoch: caches keyed on the cell SET (not the
+            # partition) — e.g. the cut partitioner's edge arrays —
+            # invalidate here and nowhere else
+            self._cells_epoch = getattr(self, "_cells_epoch", 0) + 1
         surviving = new_cells[np.isin(new_cells, old_plan.cells)]
         old_dev, old_rows = self._host_rows(surviving)
         old_flat = old_dev.astype(np.int64) * old_R + old_rows
@@ -2925,6 +3093,7 @@ class Grid:
         owner = partition_cells(
             self.mapping, cells, self.n_dev, self._lb_method, pins=self._pins or None
         )
+        self._cells_epoch = getattr(self, "_cells_epoch", 0) + 1
         self._build_plan(cells, owner)
         self._allocate_fields()
         if self._debug:

@@ -76,12 +76,13 @@ class _NeighborMaps:
         return ng.reshape(-1), valid.reshape(-1)
 
 
-def _wrap_band(dims, periodic, o):
+def _wrap_band(dims, o):
     """Sorted grid indices of cells whose neighbor at cell offset ``o``
-    crosses a grid boundary in some dimension (periodic wrap or
-    non-periodic invalid) — the only cells besides partition-boundary
-    bands whose flat neighbor index differs from ``gidx + flat_delta``.
-    ~O(surface) cells."""
+    crosses a grid boundary in some dimension — the only cells besides
+    partition-boundary bands whose flat neighbor index differs from
+    ``gidx + flat_delta``. Periodicity doesn't matter here: a periodic
+    wrap changes the flat index and a non-periodic crossing must be
+    masked, so both land in the band. ~O(surface) cells."""
     nx, ny, nz = dims
     bands = []
     for d, (ov, nd) in enumerate(((int(o[0]), nx), (int(o[1]), ny),
@@ -150,7 +151,7 @@ def _closed_form_hoods(hoods, dims, periodic, size, n_dev, owner,
         shifts = (offs[:, 0] + nx * (offs[:, 1] + ny * offs[:, 2])
                   ).astype(np.int64)
         maxD = int(np.abs(shifts).max()) if k else 0
-        bands = [_wrap_band(dims, periodic, o) for o in offs]
+        bands = [_wrap_band(dims, o) for o in offs]
         wrong_per = [[None] * k for _ in range(n_dev)]
         W = 1
         for d in range(n_dev):

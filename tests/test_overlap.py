@@ -1,0 +1,152 @@
+"""Overlapped fused steps (DCCRG_OVERLAP) must be bit-compatible with
+the sequential exchange -> kernel path.
+
+The overlap restructures compile_step_loop's step body: halo sends
+launch first, the bulk kernel runs on pre-exchange state (inner rows
+read no ghosts, so their results are final), and outer rows are redone
+after the scatter — the reference's solve-inner-while-messages-fly
+overlap (dccrg.hpp:5046-5413, tests/advection/2d.cpp:327-343) inside
+one XLA program.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dccrg_tpu.grid import Grid, DEFAULT_NEIGHBORHOOD_ID
+
+
+def _mk(monkeypatch, overlap, *, partition="block", force_tables=False,
+        refine=False, periodic=(True, True, False)):
+    monkeypatch.setenv("DCCRG_OVERLAP", "1" if overlap else "0")
+    if force_tables:
+        monkeypatch.setenv("DCCRG_FORCE_TABLES", "1")
+    else:
+        monkeypatch.delenv("DCCRG_FORCE_TABLES", raising=False)
+    # 8x8x40 over 8 devices: block slabs 5 cells thick, so the outer
+    # fraction (2 boundary planes of 5) stays under the overlap
+    # heuristic's half-grid cutoff and the overlap genuinely engages
+    g = (
+        Grid(cell_data={"v": jnp.float32, "w": jnp.float32})
+        .set_initial_length((8, 8, 40))
+        .set_periodic(*periodic)
+        .set_maximum_refinement_level(2 if refine else 0)
+        .set_neighborhood_length(1)
+        .initialize(partition=partition)
+    )
+    if refine:
+        for cid in g.local_cells().ids[:6:2]:
+            g.refine_completely(int(cid))
+        g.stop_refining()
+    cells = g.plan.cells
+    rng = np.random.default_rng(7)
+    g.set("v", cells, rng.random(len(cells)).astype(np.float32))
+    g.set("w", cells, rng.random(len(cells)).astype(np.float32))
+    g.update_copies_of_remote_neighbors()
+    return g
+
+
+def _engaged(g):
+    hood = g.plan.hoods[DEFAULT_NEIGHBORHOOD_ID]
+    return getattr(hood, "_outer_host", None) is not None
+
+
+def _kern(cell, nbr, offs, mask):
+    s = jnp.sum(jnp.where(mask, nbr["v"], 0.0), axis=1)
+    return {"v": 0.5 * cell["v"] + 0.125 * s}
+
+
+def _kern2(cell, nbr, offs, mask):
+    # non-power-of-two coefficients: the outer re-pass may fuse/round
+    # differently than the bulk pass (FMA contraction differs between
+    # the [W, S] and [L, S] layouts), so comparisons for THIS kernel
+    # use tight allclose; the power-of-two kernels above stay bitwise
+    sv = jnp.sum(jnp.where(mask, nbr["v"], 0.0), axis=1)
+    sw = jnp.sum(jnp.where(mask, nbr["w"], 0.0), axis=1)
+    return {"v": 0.5 * cell["v"] + 0.125 * sw,
+            "w": 0.9 * cell["w"] + 0.05 * sv}
+
+
+@pytest.mark.parametrize("partition", ["block", "morton", "rcb"])
+def test_overlap_matches_sequential(monkeypatch, partition):
+    results = []
+    for ov in (False, True):
+        g = _mk(monkeypatch, ov, partition=partition)
+        g.run_steps(_kern, ["v"], ["v"], 5)
+        if ov and partition == "block":
+            assert _engaged(g), "overlap should engage on thick slabs"
+        results.append(g.get("v", g.plan.cells))
+    np.testing.assert_array_equal(results[0], results[1])
+
+
+def test_overlap_matches_with_tables(monkeypatch):
+    results = []
+    for ov in (False, True):
+        g = _mk(monkeypatch, ov, force_tables=True)
+        g.run_steps(_kern, ["v"], ["v"], 5)
+        if ov:
+            assert _engaged(g), "overlap should engage in table mode"
+        results.append(g.get("v", g.plan.cells))
+    np.testing.assert_array_equal(results[0], results[1])
+
+
+def test_overlap_matches_on_refined_grid(monkeypatch):
+    """Hybrid (split-table) plans: hard rows rerun post-exchange too."""
+    results = []
+    for ov in (False, True):
+        g = _mk(monkeypatch, ov, refine=True)
+        g.run_steps(_kern, ["v"], ["v"], 4)
+        results.append(g.get("v", g.plan.cells))
+    np.testing.assert_array_equal(results[0], results[1])
+
+
+def test_overlap_multi_field_exchange(monkeypatch):
+    """Two exchanged fields, cross-coupled kernel."""
+    for what in ("v", "w"):
+        results = []
+        for ov in (False, True):
+            g = _mk(monkeypatch, ov)
+            g.run_steps(_kern2, ["v", "w"], ["v", "w"], 4)
+            results.append(g.get(what, g.plan.cells))
+        np.testing.assert_allclose(results[0], results[1],
+                                   rtol=2e-6, atol=2e-6)
+
+
+def test_overlap_static_field(monkeypatch):
+    """A static (non-exchanged) input field keeps its epoch ghosts."""
+    def kern(cell, nbr, offs, mask):
+        sw = jnp.sum(jnp.where(mask, nbr["w"], 0.0), axis=1)
+        return {"v": cell["v"] + 0.015625 * sw * cell["w"]}
+
+    results = []
+    for ov in (False, True):
+        g = _mk(monkeypatch, ov)
+        g.run_steps(kern, ["v", "w"], ["v"], 3)
+        results.append(g.get("v", g.plan.cells))
+    np.testing.assert_allclose(results[0], results[1],
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_overlap_nonperiodic(monkeypatch):
+    results = []
+    for ov in (False, True):
+        g = _mk(monkeypatch, ov, periodic=(False, False, False))
+        g.run_steps(_kern, ["v"], ["v"], 5)
+        results.append(g.get("v", g.plan.cells))
+    np.testing.assert_array_equal(results[0], results[1])
+
+
+def test_overlap_survives_balance(monkeypatch):
+    """Partition changes rebuild the outer tables per epoch."""
+    results = []
+    for ov in (False, True):
+        g = _mk(monkeypatch, ov)
+        g.run_steps(_kern, ["v"], ["v"], 2)
+        g.set_partitioning_option("method", "morton")
+        g.balance_load()
+        g.update_copies_of_remote_neighbors()
+        g.run_steps(_kern, ["v"], ["v"], 2)
+        results.append(g.get("v", g.plan.cells))
+    np.testing.assert_array_equal(results[0], results[1])
