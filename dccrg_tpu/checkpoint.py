@@ -172,40 +172,53 @@ def parse_metadata(data, header_size: int = 0):
     return mapping, hood_len, topology, geometry, cells, offsets, pos + 16 * n_cells
 
 
-def _chunk_payload(grid, ids, fixed_spec, cell_bytes):
+def _chunk_payload(grid, ids, fixed_spec, cell_bytes, reader=None):
     """The interleaved fixed-field payload for one chunk of cells,
-    gathered on device so only the chunk crosses to the host."""
+    gathered on device so only the chunk crosses to the host.
+    ``reader`` overrides the row source (the multi-process save passes
+    grid._shard_read so reads stay on addressable shards)."""
+    read = reader or (lambda n, d, r: np.asarray(grid.data[n][d, r]))
     dev, rows = grid._host_rows(ids)
     payload = np.empty((len(ids), cell_bytes), dtype=np.uint8)
     col = 0
     for name, shape, dtype, nbytes in fixed_spec:
-        vals = np.ascontiguousarray(np.asarray(grid.data[name][dev, rows]))
+        vals = np.ascontiguousarray(read(name, dev, rows))
         payload[:, col : col + nbytes] = vals.reshape(len(ids), -1).view(np.uint8)
         col += nbytes
     return payload
 
 
 def _chunk_bytes(grid, cells, counts, start, fixed_spec, fixed_bytes,
-                 var_spec):
+                 var_spec, reader=None, idx=None):
     """Serialize one chunk of cells to bytes (device gather + host
     assembly) — runs on the prefetch thread so the NEXT chunk's device
-    pull overlaps the file write of the current one."""
-    ids = cells[start : start + CHUNK]
-    fixed = _chunk_payload(grid, ids, fixed_spec, fixed_bytes)
+    pull overlaps the file write of the current one. The multi-process
+    save passes explicit cell positions (``idx``) and a shard-local
+    ``reader`` so its slice writes share THIS byte-layout code — the
+    two paths cannot drift apart."""
+    idx = (np.arange(start, min(start + CHUNK, len(cells)))
+           if idx is None else idx)
+    ids = cells[idx]
+    fixed = _chunk_payload(grid, ids, fixed_spec, fixed_bytes, reader)
     if not var_spec:
         return fixed.tobytes()
-    # interleave fixed part and ragged variable rows per cell —
-    # vectorized (repeat/cumsum scatter), no per-cell Python loop
+    read = reader or (lambda n, d, r: np.asarray(grid.data[n][d, r]))
     dev, rows = grid._host_rows(ids)
     var_host = {
-        name: np.ascontiguousarray(np.asarray(grid.data[name][dev, rows]))
+        name: np.ascontiguousarray(read(name, dev, rows))
         for name, *_ in var_spec
     }
     nc = len(ids)
     var_nbytes = {
-        name: counts[name][start : start + nc].astype(np.int64) * row_bytes
+        name: counts[name][idx].astype(np.int64) * row_bytes
         for name, count_field, row_shape, dtype, row_bytes, cap in var_spec
     }
+    return _interleave(nc, fixed, var_host, var_nbytes, fixed_bytes, var_spec)
+
+
+def _interleave(nc, fixed, var_host, var_nbytes, fixed_bytes, var_spec):
+    """Interleave fixed parts and ragged variable rows per cell —
+    vectorized (repeat/cumsum scatter), no per-cell Python loop."""
     cell_total = np.full(nc, fixed_bytes, dtype=np.int64)
     for nb in var_nbytes.values():
         cell_total += nb
@@ -252,11 +265,14 @@ def save_grid_data(grid, filename: str, header: bytes = b"",
     meta += geom  # self-describing record, no length prefix
     meta += struct.pack("<Q", len(cells))
 
-    # per-cell byte sizes (variable fields contribute count * row)
+    # per-cell byte sizes (variable fields contribute count * row).
+    # Counts must be REPLICATED for the offset table; on multi-process
+    # meshes the psum device gather with identical (plan-derived) args
+    # on every process is globally consistent, unlike host get()
     sizes = np.full(len(cells), fixed_bytes, dtype=np.uint64)
     counts = {}
     for name, count_field, row_shape, dtype, row_bytes, cap in var_spec:
-        c = grid.get(count_field, cells).astype(np.int64)
+        c = _replicated_pull(grid, count_field, cells).astype(np.int64)
         if np.any(c < 0) or np.any(c > cap):
             raise ValueError(f"count field {count_field!r} out of range for {name!r}")
         counts[name] = c
@@ -266,6 +282,11 @@ def save_grid_data(grid, filename: str, header: bytes = b"",
     offsets = offset0 + np.concatenate(
         [[np.uint64(0)], np.cumsum(sizes)[:-1]]
     ).astype(np.uint64)
+
+    if grid._multiproc:
+        _save_process_slice(grid, filename, bytes(meta), cells, offsets,
+                            sizes, counts, fixed_spec, fixed_bytes, var_spec)
+        return
 
     starts = list(range(0, len(cells), CHUNK))
     with open(filename, "wb") as f, ThreadPoolExecutor(1) as pool:
@@ -285,6 +306,66 @@ def save_grid_data(grid, filename: str, header: bytes = b"",
                                var_spec)
                    if i + 1 < len(starts) else None)
             f.write(buf)
+
+
+def _replicated_pull(grid, field, cells):
+    """Per-cell host values with identical results on every process:
+    single-controller grids read directly; multi-process grids use the
+    chunked psum device gather, whose (replicated) index args make the
+    collective consistent across processes — the role of the
+    reference's allgathered cell lists (dccrg.hpp:1109-1736)."""
+    if not grid._multiproc:
+        return grid.get(field, cells)
+    out = []
+    for start in range(0, len(cells), CHUNK):
+        ids = cells[start : start + CHUNK]
+        dev, rows = grid._host_rows(ids)
+        out.append(grid._device_gather(field, dev, rows))
+    return np.concatenate(out)
+
+
+def _save_process_slice(grid, filename, meta, cells, offsets, sizes, counts,
+                        fixed_spec, fixed_bytes, var_spec):
+    """Multi-process save: every process writes its OWN cells' payload
+    ranges into the shared file — the reference's collective MPI-IO
+    write with per-rank file views (dccrg.hpp:1594-1659). Process 0
+    writes the (replicated) metadata and cell/offset table; payload
+    ranges are grouped into contiguous runs (one run per process under
+    block partitions) so writes are large and few."""
+    import jax
+
+    writes_meta = getattr(grid, "_ckpt_writes_meta",
+                          jax.process_index() == 0)
+    local = grid._proc_local_dev[grid.plan.owner]
+    my = np.flatnonzero(local)
+    end = int(offsets[-1] + sizes[-1]) if len(cells) else len(meta) + 16 * len(cells)
+    if writes_meta:
+        with open(filename, "wb") as f:
+            f.write(meta)
+            pairs = np.empty((len(cells), 2), dtype=np.uint64)
+            pairs[:, 0] = cells
+            pairs[:, 1] = offsets
+            f.write(pairs.tobytes())
+            f.truncate(end)  # pre-size so every process can pwrite
+    if jax.process_count() > 1:  # not under a faked test split
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"dccrg_save:{filename}")
+    with open(filename, "r+b") as f:
+        # runs of consecutive local cells share one write
+        if len(my):
+            brk = np.flatnonzero(np.diff(my) != 1) + 1
+            for run in np.split(my, brk):
+                f.seek(int(offsets[run[0]]))
+                for s in range(0, len(run), CHUNK):
+                    f.write(_chunk_bytes(grid, cells, counts, 0,
+                                         fixed_spec, fixed_bytes, var_spec,
+                                         reader=grid._shard_read,
+                                         idx=run[s : s + CHUNK]))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"dccrg_save_done:{filename}")
 
 
 def _grid_skeleton_matches(grid, mapping, hood_len, topology, geometry):
@@ -310,11 +391,21 @@ def _scatter_payloads(grid, raw, cells, offsets, fixed_spec, fixed_bytes,
     """Stream payloads from ``raw`` (memory map) into fresh device
     arrays. Two passes when variable fields exist: fixed parts (incl.
     counts) first, then the ragged rows (dccrg.hpp:2108-2123)."""
-    import jax.numpy as jnp
+    from .grid import put_sharded
 
     hosts = {}
     for name, (shape, dtype) in grid.fields.items():
         hosts[name] = np.zeros((grid.n_dev, grid.plan.R) + shape, dtype=dtype)
+
+    if grid._multiproc:
+        # each process scatters only its own cells' payloads: the final
+        # put_sharded serves only addressable shards, so foreign rows
+        # in `hosts` are never consumed (per-rank collective read,
+        # dccrg.hpp:2108-2390)
+        keep = grid._proc_local_dev[grid.plan.owner[
+            np.searchsorted(grid.plan.cells, cells)]]
+        cells = cells[keep]
+        offsets = offsets[keep]
 
     # pass 1: fixed-size parts at each cell's offset
     for start in range(0, len(cells), CHUNK):
@@ -375,7 +466,7 @@ def _scatter_payloads(grid, raw, cells, offsets, fixed_spec, fixed_bytes,
                             row_within[s:e]] = vals
 
     for name in grid.fields:
-        grid.data[name] = jnp.asarray(hosts[name], device=grid._sharding())
+        grid.data[name] = put_sharded(hosts[name], grid._sharding())
 
 
 def load_grid_data(grid, filename: str, header_size: int = 0,

@@ -185,6 +185,19 @@ def _halo_scatter(fl, rv, payload, R):
     return fl.at[rr].set(payload.reshape((-1,) + fl.shape[1:]), mode="drop")
 
 
+def put_sharded(host_array, sharding):
+    """Host -> device upload of a replicatedly-computed array onto a
+    (possibly multi-process) sharding: each process serves only the
+    shards it can address (``jax.make_array_from_callback``), so the
+    same call works on a single controller and under
+    ``jax.distributed`` SPMD — the analogue of every MPI rank uploading
+    its slice of the replicated structure (dccrg.hpp:7738-7803)."""
+    arr = np.asarray(host_array)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
 def _make_nbr_gather(use_roll, r_shifts, L, nrows, nmask, wr, ws):
     """Per-device neighbor gather for stencil bodies: a table gather,
     or S sequential rolls + a sparse fixup scatter when the table is
@@ -322,7 +335,7 @@ class _HoodPlan:
         hit = self._dev.get(name)
         if hit is None:
             hit = (jnp.asarray(host_array) if sharding is None
-                   else jax.device_put(jnp.asarray(host_array), sharding))
+                   else put_sharded(host_array, sharding))
             self._dev[name] = hit
         return hit
 
@@ -544,24 +557,23 @@ class Grid:
         self.mesh = mesh if mesh is not None else default_mesh()
         if len(self.mesh.axis_names) != 1:
             raise ValueError("Grid needs a 1-D mesh (axis 'dev')")
-        if any(d.process_index != jax.process_index()
-               for d in self.mesh.devices.flat):
-            # The host-side plan builder, get/set paths and checkpoint
-            # I/O address every device shard from one controller
-            # process (np.asarray over sharded arrays). Under
-            # jax.distributed multi-process execution those pulls
-            # would silently return only the local shard — fail loudly
-            # instead until a process-local plan exists. (A mesh built
-            # from only this process's devices is fine even under
-            # jax.distributed.) The reference runs whole-cluster MPI
-            # (dccrg.hpp:7738-7803); our multi-host story is
-            # documented in README "Multi-host scaling".
-            raise RuntimeError(
-                "dccrg_tpu.Grid is single-controller: every mesh device "
-                "must be addressable from this process, but the mesh "
-                "contains devices owned by other processes. Multi-host "
-                "meshes (jax.distributed) are not yet supported."
-            )
+        # Multi-process (jax.distributed) meshes are supported: every
+        # process runs the same program over the same replicated inputs,
+        # so each computes the SAME plan (all partitioners are
+        # deterministic numpy) — exactly how every MPI rank in the
+        # reference holds the same cell_process map
+        # (dccrg.hpp:7311, 7738-7803). What changes per process is only
+        # which shards the HOST paths may touch: uploads go through
+        # put_sharded (each process serves its addressable shards),
+        # get/set are restricted to cells on addressable devices (the
+        # reference's rank-local access semantics), and checkpoint I/O
+        # writes per-process slices. Collectives (ppermute halo
+        # exchange, psum reductions) are mesh-shape agnostic.
+        self._proc_local_dev = np.fromiter(
+            (d.process_index == jax.process_index()
+             for d in self.mesh.devices.flat),
+            dtype=bool, count=self.mesh.devices.size,
+        )
         self.axis = self.mesh.axis_names[0]
         self.n_dev = self.mesh.devices.size
 
@@ -627,6 +639,7 @@ class Grid:
         other.mesh = self.mesh
         other.axis = self.axis
         other.n_dev = self.n_dev
+        other._proc_local_dev = self._proc_local_dev.copy()
         other.mapping = Mapping(
             tuple(int(v) for v in self.mapping.length.get()),
             self.mapping.max_refinement_level,
@@ -996,29 +1009,34 @@ class Grid:
         # --- halo send/receive lists (dccrg.hpp:8729-8891) ---
         # device q receives every remote neighbor it reads; sender p is
         # that cell's owner. Lists sorted by cell id (reference sorts
-        # by id for tag assignment).
-        pair_ids = [[np.empty(0, np.uint64)] * n_dev for _ in range(n_dev)]
-        for q in range(n_dev):
-            gids = plan.ghost_ids[q]
-            if len(gids) == 0:
-                continue
-            gowner = owner[np.searchsorted(cells, gids)]
-            for p in range(n_dev):
-                pair_ids[p][q] = gids[gowner == p]
-        M = self._sticky_cap(
-            ("M", hid),
-            max(1, max(len(pair_ids[p][q]) for p in range(n_dev) for q in range(n_dev))),
-        )
-        send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-        recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-        for p in range(n_dev):
-            for q in range(n_dev):
-                ids = pair_ids[p][q]
-                if len(ids) == 0:
-                    continue
-                pair_gidx = np.searchsorted(cells, ids)
-                send_rows[p, q, : len(ids)] = row_by_gidx[p, pair_gidx]
-                recv_rows[q, p, : len(ids)] = row_by_gidx[q, pair_gidx]
+        # by id for tag assignment). Built by ONE lexsort-grouping over
+        # the concatenated ghost arrays — O(ghosts log ghosts), no
+        # n_dev^2 Python loop (pod-scale table-build time is linear in
+        # devices; the dense [n_dev, n_dev, M] arrays themselves remain
+        # the all_to_all-fallback format)
+        g_all = np.concatenate([plan.ghost_ids[q] for q in range(n_dev)]) \
+            if n_dev else np.empty(0, np.uint64)
+        q_all = np.repeat(np.arange(n_dev),
+                          [len(plan.ghost_ids[q]) for q in range(n_dev)])
+        total = len(g_all)
+        if total:
+            gidx_all = np.searchsorted(cells, g_all)
+            p_all = owner[gidx_all]
+            order = np.lexsort((g_all, q_all, p_all))
+            p_s, q_s, gx_s = p_all[order], q_all[order], gidx_all[order]
+            pq = p_s.astype(np.int64) * n_dev + q_s
+            starts = np.r_[0, np.flatnonzero(np.diff(pq)) + 1]
+            lens = np.diff(np.r_[starts, total])
+            pos = np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
+            M = self._sticky_cap(("M", hid), max(1, int(lens.max())))
+            send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+            recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+            send_rows[p_s, q_s, pos] = row_by_gidx[p_s, gx_s]
+            recv_rows[q_s, p_s, pos] = row_by_gidx[q_s, gx_s]
+        else:
+            M = self._sticky_cap(("M", hid), 1)
+            send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+            recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
 
         return _HoodPlan(
             offsets=offsets,
@@ -1037,12 +1055,52 @@ class Grid:
     def _sharding(self):
         return NamedSharding(self.mesh, P(self.axis))
 
+    @property
+    def _multiproc(self) -> bool:
+        """True when the mesh spans processes this controller cannot
+        address (jax.distributed SPMD, or a test faking it)."""
+        return not bool(self._proc_local_dev.all())
+
+    def _require_local(self, dev, what):
+        """Multi-process host access is rank-local, as in the
+        reference: a process touches only cells on its own devices
+        (dccrg.hpp operator[] is valid for local cells)."""
+        if self._multiproc and not self._proc_local_dev[dev].all():
+            raise KeyError(
+                f"{what}: cell(s) live on devices owned by another "
+                "process; host access is process-local on multi-process "
+                "meshes (like the reference's rank-local operator[])"
+            )
+
+    def _shard_read(self, field, dev, rows):
+        """Host read via per-device addressable shards — no collective,
+        valid under multi-process for process-local cells. Rows are
+        sliced ON the device shard before the host copy, so a few-cell
+        read transfers only those rows, not the whole shard."""
+        arr = self.data[field]
+        by_dev = {}
+        for s in arr.addressable_shards:
+            by_dev[s.index[0].start] = s.data
+        out = np.empty((len(dev),) + arr.shape[2:], dtype=arr.dtype)
+        for d in np.unique(dev):
+            m = dev == d
+            out[m] = np.asarray(by_dev[int(d)][0, rows[m]])
+        return out
+
     def _allocate_fields(self):
         self.data = {}
+        sh = self._sharding()
         for name, (shape, dtype) in self.fields.items():
-            self.data[name] = jnp.zeros(
-                (self.n_dev, self.plan.R) + shape, dtype=dtype, device=self._sharding()
-            )
+            full = (self.n_dev, self.plan.R) + shape
+            # jit-produced zeros (not a host transfer): valid on
+            # multi-process meshes where device_put of host zeros isn't
+            key = ("zeros", full, str(dtype))
+            fn = self._program_cache.get(key)
+            if fn is None:
+                fn = jax.jit(partial(jnp.zeros, full, dtype),
+                             out_shardings=sh)
+                self._program_cache[key] = fn
+            self.data[name] = fn()
 
     def device_row_ids(self) -> "jnp.ndarray":
         """Sharded ``[n_dev, R] int32`` array of ``cell id - 1`` per
@@ -1091,7 +1149,7 @@ class Grid:
                     host[d, plan.L : plan.L + ng] = (
                         plan.ghost_ids[d].astype(np.int64) - 1
                     )
-            arr = jax.device_put(jnp.asarray(host), self._sharding())
+            arr = put_sharded(host, self._sharding())
         plan._row_ids_dev = arr
         return arr
 
@@ -1145,7 +1203,12 @@ class Grid:
         array once."""
         scalar = np.isscalar(ids) or np.asarray(ids).ndim == 0
         dev, rows = self._host_rows(ids)
-        if (0 < len(rows) <= _GATHER_TIER
+        if self._multiproc:
+            # rank-local access, via addressable shards (no collective:
+            # other processes may be get()ing different cells)
+            self._require_local(dev, "get")
+            out = self._shard_read(field, dev, rows)
+        elif (0 < len(rows) <= _GATHER_TIER
                 and len(rows) < len(self.plan.cells) // 4):
             out = self._device_gather(field, dev, rows)
         else:
@@ -1213,15 +1276,49 @@ class Grid:
         # partial writes scatter ON DEVICE: only the written rows cross
         # the host boundary, instead of a full array pull + re-upload
         # per field (the staged-balance landing path and every host
-        # set() ride this)
-        partial = (not fresh) and len(rows) < len(self.plan.cells)
+        # set() ride this). On multi-process meshes every non-full
+        # write rides this tier: the scatter has no collective and each
+        # device applies only its own process's writes (rank-local set,
+        # like the reference's operator[] assignment)
+        full_cover = (len(np.atleast_1d(np.asarray(ids)))
+                      == len(self.plan.cells))
+        if self._multiproc and full_cover and not fresh:
+            # replicated full-cover write with ghost preservation:
+            # upload the new values (put_sharded serves local shards),
+            # then merge ON DEVICE so old ghost rows survive — no
+            # foreign-shard host read needed
+            mask = self.local_row_mask() > 0
+            sh = self._sharding()
+            for name, values in values_by_field.items():
+                shape, dtype = self.fields[name]
+                host = np.zeros((self.n_dev, self.plan.R) + shape,
+                                dtype=dtype)
+                host[dev, rows] = values
+                new = put_sharded(host, sh)
+                key = ("covermerge", shape, str(dtype))
+                fn = self._program_cache.get(key)
+                if fn is None:
+                    def _merge(old, nw, m, _nd=len(shape)):
+                        mx = m.reshape(m.shape + (1,) * _nd)
+                        return jnp.where(mx, nw, old)
+                    fn = jax.jit(_merge, out_shardings=sh)
+                    self._program_cache[key] = fn
+                self.data[name] = fn(self.data[name], new, mask)
+            return
+        partial = ((not fresh) and len(rows) < len(self.plan.cells)
+                   ) or (self._multiproc and not fresh)
+        if self._multiproc and not fresh:
+            self._require_local(dev, "set")
         for name, values in values_by_field.items():
             shape, dtype = self.fields[name]
             if fresh:
+                # full-cover init: values are replicated across
+                # processes (every process passes the whole grid's
+                # values), so each process uploads its own shards
                 host = np.zeros((self.n_dev, self.plan.R) + shape, dtype=dtype)
                 if identity:
                     host[0, : len(rows)] = np.asarray(values, dtype=dtype)
-                    self.data[name] = jnp.asarray(host, device=self._sharding())
+                    self.data[name] = put_sharded(host, self._sharding())
                     continue
             elif partial:
                 self.data[name] = self._device_scatter(
@@ -1230,7 +1327,7 @@ class Grid:
             else:
                 host = np.asarray(self.data[name]).copy()
             host[dev, rows] = values
-            self.data[name] = jnp.asarray(host, device=self._sharding())
+            self.data[name] = put_sharded(host, self._sharding())
 
     def _device_scatter(self, name, dev, rows, values):
         """Masked per-device scatter of ``values`` into rows
@@ -1713,20 +1810,21 @@ class Grid:
             return cached
         send = hood.send_rows.copy()
         recv = hood.recv_rows.copy()
-        for p in range(self.n_dev):
-            for q in range(self.n_dev):
-                valid = np.nonzero(send[p, q] >= 0)[0]
-                if len(valid) == 0:
-                    continue
-                ids = self.plan.local_ids[p][send[p, q, valid]]
-                keep = np.asarray(fn(ids, p, q, neighborhood_id), dtype=bool)
-                if keep.shape != ids.shape:
-                    raise ValueError(
-                        "transfer predicate must return one bool per cell"
-                    )
-                drop = valid[~keep]
-                send[p, q, drop] = -1
-                recv[q, p, drop] = -1
+        # only pairs with traffic (O(devices x peers), not n_dev^2);
+        # the predicate contract is per-(sender, receiver) so each live
+        # pair still gets its own call
+        for p, q in np.argwhere((send >= 0).any(axis=2)):
+            valid = np.nonzero(send[p, q] >= 0)[0]
+            ids = self.plan.local_ids[p][send[p, q, valid]]
+            keep = np.asarray(fn(ids, int(p), int(q), neighborhood_id),
+                              dtype=bool)
+            if keep.shape != ids.shape:
+                raise ValueError(
+                    "transfer predicate must return one bool per cell"
+                )
+            drop = valid[~keep]
+            send[p, q, drop] = -1
+            recv[q, p, drop] = -1
         hood._pair_host[field] = (send, recv)
         return send, recv
 
@@ -2643,8 +2741,14 @@ class Grid:
         for n in names:
             if n not in self.fields:
                 raise KeyError(f"unknown field {n!r}")
+            # DEVICE-side staging: jax arrays are immutable, so the
+            # stage is a zero-copy snapshot reference — the captured
+            # version survives later set()s (which install new arrays)
+            # and the landing at finish is an on-device gather; moved
+            # payloads never leave HBM (the reference moves balance
+            # payloads rank-to-rank, dccrg.hpp:3932-3964)
             self._staged_balance[n] = (
-                moving.copy(), self.get(n, moving) if len(moving) else None
+                moving.copy(), self.data[n] if len(moving) else None
             )
 
     def staged_balance_data(self, field: str):
@@ -2652,8 +2756,11 @@ class Grid:
         for a field — the receiver-side peek between stages (the
         reference's receivers see arrived data in their cell_data
         before finish)."""
-        ids, vals = self._staged_balance[field]
-        return ids.copy(), (None if vals is None else vals.copy())
+        ids, snap = self._staged_balance[field]
+        if snap is None:
+            return ids.copy(), None
+        dev, rows = self._host_rows(ids)  # plan unchanged since staging
+        return ids.copy(), np.asarray(snap[dev, rows])
 
     def finish_balance_load(self) -> None:
         """Stage 3: install the new partition, rebuild all derived
@@ -2676,24 +2783,57 @@ class Grid:
         self._pending_owner = None
         staged = self._staged_balance
         self._staged_balance = {}
+        # old row positions of every staged group, before the plan is
+        # rebuilt: the landing gathers straight from the device
+        # snapshots (no host copy of moved payloads; the reference
+        # moves them rank-to-rank, dccrg.hpp:3932-3964)
+        old_pos = {n: self._host_rows(ids)
+                   for n, (ids, snap) in staged.items() if snap is not None}
+        old_R = self.plan.R
         self._restructure(self.plan.cells.copy(), new_owner)
         if self._debug:
             from . import verify as _verify
 
             _verify.pin_requests_succeeded(self)
-        for n, (ids, vals) in staged.items():
-            if vals is None or n not in self.fields:
+        sh = self._sharding()
+        # all staged groups share one moving-id set per balance: build
+        # the relocation index tables once, not once per field
+        tbl_ids, src_dev, mask_dev = None, None, None
+        for n, (ids, snap) in staged.items():
+            if snap is None or n not in self.fields:
                 continue
-            shape = self.fields[n][0]
-            if vals.shape[1:] != shape:
-                # a stage in between grew/shrank the field (the
-                # particles resize-by-count flow): pad or truncate the
-                # staged rows to the current capacity
-                fixed = np.zeros((len(ids),) + shape, dtype=vals.dtype)
-                sl = tuple(slice(0, min(a, b)) for a, b in zip(vals.shape[1:], shape))
-                fixed[(slice(None),) + sl] = vals[(slice(None),) + sl]
-                vals = fixed
-            self.set(n, ids, vals)
+            shape, dtype = self.fields[n]
+            if tbl_ids is None or not np.array_equal(ids, tbl_ids):
+                od, orw = old_pos[n]
+                nd, nrw = self._host_rows(ids)
+                src = np.full(self.n_dev * self.plan.R, -1, dtype=np.int64)
+                src[nd.astype(np.int64) * self.plan.R + nrw] = (
+                    od.astype(np.int64) * old_R + orw)
+                src2 = src.reshape(self.n_dev, self.plan.R)
+                src_dev = put_sharded(src2, sh)
+                mask_dev = put_sharded(src2 >= 0, sh)
+                tbl_ids = ids
+            snap_shape = tuple(snap.shape[2:])
+            key = ("balance_land", snap_shape, shape, str(dtype))
+            fn = self._program_cache.get(key)
+            if fn is None:
+                @partial(jax.jit, out_shardings=sh)
+                def fn(cur, snp, srcs, mask, _ss=snap_shape, _ts=shape):
+                    flat = snp.reshape((-1,) + snp.shape[2:])
+                    g = flat[jnp.clip(srcs, 0)]
+                    if _ss != _ts:
+                        # a stage in between grew/shrank the field (the
+                        # particles resize-by-count flow): pad/truncate
+                        # the staged rows to the current capacity
+                        fixed = jnp.zeros(g.shape[:2] + _ts, g.dtype)
+                        sl = tuple(slice(0, min(a, b))
+                                   for a, b in zip(_ss, _ts))
+                        ix = (slice(None), slice(None)) + sl
+                        g = fixed.at[ix].set(g[ix])
+                    mexp = mask.reshape(mask.shape + (1,) * len(_ts))
+                    return jnp.where(mexp, g.astype(cur.dtype), cur)
+                self._program_cache[key] = fn
+            self.data[n] = fn(self.data[n], snap, src_dev, mask_dev)
 
     def get_cells_added_by_balance_load(self, device: int | None = None):
         """Cells the last balance_load moved ONTO a device (all moved
@@ -2718,18 +2858,26 @@ class Grid:
         reference's per-peer send lists (dccrg.hpp get_cells_to_send)."""
         hood = self.plan.hoods[neighborhood_id]
         out = {}
-        for p in range(self.n_dev):
-            for q in range(self.n_dev):
-                rows = hood.send_rows[p, q]
-                rows = rows[rows >= 0]
-                if len(rows):
-                    out[(p, q)] = self.plan.local_ids[p][rows]
+        send = hood.send_rows
+        for p, q in np.argwhere((send >= 0).any(axis=2)):  # live pairs only
+            rows = send[p, q]
+            out[(int(p), int(q))] = self.plan.local_ids[p][rows[rows >= 0]]
         return out
 
     def get_cells_to_receive(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
-        """{(sender, receiver): cell ids} mirrored from the receive
-        side (identical content by construction)."""
-        return self.get_cells_to_send(neighborhood_id)
+        """{(sender, receiver): cell ids} computed from the RECEIVE
+        tables (ghost rows on the receiver), independently of
+        get_cells_to_send — the two must agree, and tests cross-check
+        them (reference get_cells_to_receive)."""
+        hood = self.plan.hoods[neighborhood_id]
+        out = {}
+        recv = hood.recv_rows  # [receiver, sender, M] ghost rows
+        L = self.plan.L
+        for q, p in np.argwhere((recv >= 0).any(axis=2)):
+            rows = recv[q, p]
+            rows = rows[rows >= 0]
+            out[(int(p), int(q))] = self.plan.ghost_ids[q][rows - L]
+        return out
 
     def get_neighborhood_of(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
         """The neighborhood's offset list (reference
@@ -2992,10 +3140,11 @@ class Grid:
         # — move data with an on-device gather. On the CPU backend the
         # "transfer" is a memcpy and the host scatter is cheaper than
         # compiling a per-epoch-shape gather program.
-        if self._on_accelerator() or os.environ.get("DCCRG_DEVICE_RESTRUCTURE") == "1":
+        if (self._on_accelerator() or self._multiproc
+                or os.environ.get("DCCRG_DEVICE_RESTRUCTURE") == "1"):
             src2 = src.reshape(self.n_dev, self.plan.R)
-            src_dev = jax.device_put(jnp.asarray(src2), sh)
-            mask_dev = jax.device_put(jnp.asarray(src2 >= 0), sh)
+            src_dev = put_sharded(src2, sh)
+            mask_dev = put_sharded(src2 >= 0, sh)
             n_dev = self.n_dev
 
             def move_for(n_extra_dims):

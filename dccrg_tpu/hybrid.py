@@ -469,26 +469,34 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
         mark(f"tables hood {hid}")
 
     # --- send / receive lists -----------------------------------------
-    M = 1
-    pair_pos = [[np.empty(0, np.int64)] * n_dev for _ in range(n_dev)]
-    for q in range(n_dev):
-        gp = ghost_pos_sorted[q]
-        if len(gp) == 0:
-            continue
-        gowner = owner[gp]
-        for p in range(n_dev):
-            pair_pos[p][q] = gp[gowner == p]
-            M = max(M, len(pair_pos[p][q]))
-    M = cap(("M", "hybrid"), M)
-    send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-    recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-    for p in range(n_dev):
-        for q in range(n_dev):
-            pp = pair_pos[p][q]
-            if len(pp) == 0:
-                continue
-            send_rows[p, q, : len(pp)] = row_of_pos[pp]
-            recv_rows[q, p, : len(pp)] = L + np.searchsorted(ghost_pos_sorted[q], pp)
+    # one lexsort-grouping over the concatenated ghost positions — no
+    # n_dev^2 Python loop (see uniform.py's identical construction)
+    gg_all = (np.concatenate(ghost_pos_sorted) if n_dev
+              else np.empty(0, np.int64))
+    q_all = np.repeat(np.arange(n_dev),
+                      [len(g) for g in ghost_pos_sorted])
+    total = len(gg_all)
+    if total:
+        p_all = owner[gg_all]
+        order = np.lexsort((gg_all, q_all, p_all))
+        p_s, q_s, g_s = p_all[order], q_all[order], gg_all[order]
+        pq = p_s.astype(np.int64) * n_dev + q_s
+        starts = np.r_[0, np.flatnonzero(np.diff(pq)) + 1]
+        lens = np.diff(np.r_[starts, total])
+        pos = np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
+        M = cap(("M", "hybrid"), max(1, int(lens.max())))
+        send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+        recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+        send_rows[p_s, q_s, pos] = row_of_pos[g_s]
+        lens_q = np.array([len(g) for g in ghost_pos_sorted],
+                          dtype=np.int64)
+        q_starts = np.cumsum(lens_q) - lens_q
+        gpos = np.arange(total, dtype=np.int64) - q_starts[q_all]
+        recv_rows[q_s, p_s, pos] = (L + gpos[order]).astype(np.int32)
+    else:
+        M = cap(("M", "hybrid"), 1)
+        send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+        recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
     for hid in neighborhoods:
         hood_data[hid]["send_rows"] = send_rows
         hood_data[hid]["recv_rows"] = recv_rows

@@ -354,26 +354,36 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     perm = owner.astype(np.int64) * L + row_of_pos
 
     # pair lists for halo exchange (same construction as the generic
-    # path: receive every ghost, sender = owner, sorted by id)
-    pair_gidx = [[np.empty(0, np.int64)] * n_dev for _ in range(n_dev)]
-    for q in range(n_dev):
-        gg = ghost_gidx[q]
-        if len(gg) == 0:
-            continue
-        gowner = owner[gg]
-        for p in range(n_dev):
-            pair_gidx[p][q] = gg[gowner == p]
-    M = cap(("M", "uniform"),
-            max(1, max(len(pair_gidx[p][q]) for p in range(n_dev) for q in range(n_dev))))
-    send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-    recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-    for p in range(n_dev):
-        for q in range(n_dev):
-            ids = pair_gidx[p][q]
-            if len(ids) == 0:
-                continue
-            send_rows[p, q, : len(ids)] = row_of_pos[ids]
-            recv_rows[q, p, : len(ids)] = L + np.searchsorted(ghost_gidx[q], ids)
+    # path: receive every ghost, sender = owner, sorted by id) — one
+    # lexsort-grouping over the concatenated ghosts, no n_dev^2 loop
+    gg_all = (np.concatenate(ghost_gidx) if n_dev
+              else np.empty(0, np.int64))
+    q_all = np.repeat(np.arange(n_dev), [len(g) for g in ghost_gidx])
+    total = len(gg_all)
+    if total:
+        p_all = owner[gg_all]
+        order = np.lexsort((gg_all, q_all, p_all))
+        p_s, q_s, g_s = p_all[order], q_all[order], gg_all[order]
+        # position of each ghost within its (p, q) group
+        pq = p_s.astype(np.int64) * n_dev + q_s
+        starts = np.r_[0, np.flatnonzero(np.diff(pq)) + 1]
+        lens = np.diff(np.r_[starts, total])
+        pos = np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
+        M = cap(("M", "uniform"), max(1, int(lens.max())))
+        send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+        recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+        send_rows[p_s, q_s, pos] = row_of_pos[g_s]
+        # ghost row = L + position in the receiver's sorted ghost
+        # list; gg_all concatenates exactly those sorted lists, so the
+        # position is the element's index minus its list's start
+        lens_q = np.array([len(g) for g in ghost_gidx], dtype=np.int64)
+        q_starts = np.cumsum(lens_q) - lens_q
+        gpos = np.arange(total, dtype=np.int64) - q_starts[q_all]
+        recv_rows[q_s, p_s, pos] = (L + gpos[order]).astype(np.int32)
+    else:
+        M = cap(("M", "uniform"), 1)
+        send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+        recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
 
     # pad rows (beyond each device's local count) need explicit init
     # since the permutation pass only covers real cells

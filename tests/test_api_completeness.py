@@ -260,6 +260,13 @@ def test_parity_accessors(mesh8):
     assert sum(len(v) for v in sends.values()) == \
         g.get_number_of_update_send_cells()
     assert all(p != q for p, q in sends)
+    # receive lists are derived from the RECEIVE tables (ghost rows)
+    # independently; both sides must describe the same transfers
+    recvs = g.get_cells_to_receive()
+    assert set(recvs) == set(sends)
+    for pq in sends:
+        np.testing.assert_array_equal(np.sort(sends[pq]),
+                                      np.sort(recvs[pq]))
     # neighborhood offsets
     offs = g.get_neighborhood_of()
     np.testing.assert_array_equal(-offs, g.get_neighborhood_to())

@@ -518,16 +518,20 @@ def test_peer_exchange_buffers_compact():
     assert dense_rows >= 3 * peer_rows  # ~4x fewer rows on the wire
 
 
-def test_multi_process_guard(monkeypatch):
-    """Grid is single-controller: a mesh containing another process's
-    devices must be refused loudly, not answered from partial shards.
-    (A mesh of this process's own devices stays fine under
-    jax.distributed — the check is addressability, not process count.)"""
+def test_multi_process_mesh_accepted_with_rank_local_access(monkeypatch):
+    """A mesh containing another process's devices initializes (the
+    plan is replicated structure, computed identically on every
+    process — dccrg.hpp:7311), but host get/set become rank-local: on
+    a process that owns NO mesh devices every cell is foreign.
+    Deeper multi-process behavior is covered by
+    tests/test_multiprocess.py's faked splits."""
     monkeypatch.setattr(jax, "process_index", lambda backend=None: 99)
-    with pytest.raises(RuntimeError, match="single-controller"):
-        (Grid(cell_data={"v": jnp.float32})
+    g = (Grid(cell_data={"v": jnp.float32})
          .set_initial_length((4, 4, 4))
          .initialize())
+    assert g._multiproc
+    with pytest.raises(KeyError, match="process-local"):
+        g.get("v", g.plan.cells[:2])
 
 
 def test_transfer_predicate_requires_initialize():

@@ -1,0 +1,201 @@
+"""Multi-process (jax.distributed) lifting, validated by FAKING the
+process split on the single-controller test mesh.
+
+A multi-process mesh differs from a single-controller one only in
+which shards the host may touch: uploads go through put_sharded (each
+process serves its addressable shards), get/set become rank-local
+(the reference's operator[] semantics, dccrg.hpp:7738-7803), and
+checkpoint I/O writes per-process slices (the reference's collective
+MPI-IO with per-rank file views, dccrg.hpp:1594-1659). Faking
+``grid._proc_local_dev`` exercises exactly those code paths; the
+shards stay addressable underneath, so the restriction logic and the
+slice-merging can be verified byte-for-byte against the
+single-controller result — two faked processes writing one file must
+reproduce the single-save file exactly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dccrg_tpu.grid import Grid
+
+
+def _mk(fields=None, n=(8, 8, 8)):
+    g = (
+        Grid(cell_data=fields or {"v": jnp.float32})
+        .set_initial_length(n)
+        .set_periodic(True, True, False)
+        .set_maximum_refinement_level(0)
+        .set_neighborhood_length(1)
+        .initialize(partition="block")
+    )
+    return g
+
+
+def _fake_split(g, local_devs):
+    g._proc_local_dev = np.array(
+        [d in set(local_devs) for d in range(g.n_dev)], dtype=bool)
+
+
+def _unfake(g):
+    g._proc_local_dev = np.ones(g.n_dev, dtype=bool)
+
+
+def test_get_set_are_rank_local():
+    g = _mk()
+    cells = g.plan.cells
+    g.set("v", cells, (cells % np.uint64(11)).astype(np.float32))
+    half = list(range(g.n_dev // 2))
+    _fake_split(g, half)
+    assert g._multiproc
+    local = np.isin(g.plan.owner, half)
+    my, foreign = cells[local], cells[~local]
+
+    # local reads work and match the single-controller values
+    got = g.get("v", my[:100])
+    np.testing.assert_array_equal(
+        got, (my[:100] % np.uint64(11)).astype(np.float32))
+
+    # foreign access fails loudly (reference: operator[] is rank-local)
+    with pytest.raises(KeyError, match="process-local"):
+        g.get("v", foreign[:3])
+    with pytest.raises(KeyError, match="process-local"):
+        g.set("v", foreign[:3], np.zeros(3, np.float32))
+
+    # local writes land (verified through the unfaked full view)
+    g.set("v", my[:5], np.full(5, 99.0, np.float32))
+    _unfake(g)
+    np.testing.assert_array_equal(g.get("v", my[:5]),
+                                  np.full(5, 99.0, np.float32))
+
+
+def test_collective_paths_unchanged_under_split():
+    """Halo exchange + fused steps use replicated tables and
+    collectives only — a faked process split must not change them."""
+    def kern(cell, nbr, offs, mask):
+        return {"v": 0.5 * cell["v"] + 0.125 * jnp.sum(
+            jnp.where(mask, nbr["v"], 0.0), axis=1)}
+
+    res = []
+    for split in (False, True):
+        g = _mk()
+        cells = g.plan.cells
+        g.set("v", cells, (cells % np.uint64(7)).astype(np.float32))
+        g.update_copies_of_remote_neighbors()
+        if split:
+            _fake_split(g, range(g.n_dev // 2))
+        g.run_steps(kern, ["v"], ["v"], 3)
+        _unfake(g)
+        res.append(g.get("v", cells))
+    np.testing.assert_array_equal(res[0], res[1])
+
+
+def test_two_process_checkpoint_slices_merge_exactly(tmp_path):
+    """Two faked processes filling one file == the single-save file."""
+    vals = None
+    files = {}
+    for mode in ("single", "split"):
+        g = _mk({"v": jnp.float32, "w": jnp.int32})
+        cells = g.plan.cells
+        rng = np.random.default_rng(3)
+        vals = rng.random(len(cells)).astype(np.float32)
+        g.set("v", cells, vals)
+        g.set("w", cells, (cells % np.uint64(5)).astype(np.int32))
+        fn = tmp_path / f"{mode}.dc"
+        if mode == "single":
+            g.save_grid_data(str(fn), header=b"HDR!")
+        else:
+            half = g.n_dev // 2
+            _fake_split(g, range(half))
+            g.save_grid_data(str(fn), header=b"HDR!")  # proc 0: meta + slice
+            _fake_split(g, range(half, g.n_dev))
+            g._ckpt_writes_meta = False
+            g.save_grid_data(str(fn), header=b"HDR!")  # proc 1: its slice
+        files[mode] = fn.read_bytes()
+    assert files["single"] == files["split"]
+
+
+def test_two_process_ragged_checkpoint(tmp_path):
+    """Variable-size payloads: counts ride the replicated device
+    gather, ragged rows ride per-process shard reads."""
+    cap = 4
+    files = {}
+    for mode in ("single", "split"):
+        g = _mk({"n": jnp.int32, "p": ((cap, 2), jnp.float32)})
+        cells = g.plan.cells
+        rng = np.random.default_rng(5)
+        counts = rng.integers(0, cap + 1, len(cells)).astype(np.int32)
+        g.set("n", cells, counts)
+        g.set("p", cells, rng.random((len(cells), cap, 2)).astype(np.float32))
+        fn = tmp_path / f"{mode}.dc"
+        if mode == "single":
+            g.save_grid_data(str(fn), variable={"p": "n"})
+        else:
+            half = g.n_dev // 2
+            _fake_split(g, range(half))
+            g.save_grid_data(str(fn), variable={"p": "n"})
+            _fake_split(g, range(half, g.n_dev))
+            g._ckpt_writes_meta = False
+            g.save_grid_data(str(fn), variable={"p": "n"})
+        files[mode] = fn.read_bytes()
+    assert files["single"] == files["split"]
+
+
+def test_process_local_load(tmp_path):
+    """Each process scatters only its cells; foreign rows stay zero
+    (their real shards are served by the owning process)."""
+    g = _mk()
+    cells = g.plan.cells
+    g.set("v", cells, (cells % np.uint64(13)).astype(np.float32))
+    fn = str(tmp_path / "a.dc")
+    g.save_grid_data(fn)
+
+    g2 = _mk()
+    half = list(range(g2.n_dev // 2))
+    _fake_split(g2, half)
+    g2.load_grid_data(fn)
+    _unfake(g2)
+    local = np.isin(g2.plan.owner, half)
+    np.testing.assert_array_equal(
+        g2.get("v", cells[local]),
+        (cells[local] % np.uint64(13)).astype(np.float32))
+    assert not np.any(g2.get("v", cells[~local]))
+
+
+def test_full_cover_set_preserving_ghosts_under_split():
+    """A replicated full-cover set() with preserve_ghosts=True (the
+    standard init idiom) must work on a multi-process mesh: new values
+    ride put_sharded, ghost rows keep their old values via an
+    on-device merge."""
+    g = _mk()
+    cells = g.plan.cells
+    g.set("v", cells, np.ones(len(cells), np.float32))
+    g.update_copies_of_remote_neighbors()  # ghosts now 1.0
+    _fake_split(g, range(g.n_dev // 2))
+    g.set("v", cells, np.full(len(cells), 2.0, np.float32))  # full cover
+    _unfake(g)
+    np.testing.assert_array_equal(
+        g.get("v", cells), np.full(len(cells), 2.0, np.float32))
+    # ghost rows were preserved (still 1.0, not zeroed): check one
+    # device's ghost block directly
+    host = np.asarray(g.data["v"])
+    L = g.plan.L
+    for d in range(g.n_dev):
+        ng = len(g.plan.ghost_ids[d])
+        if ng:
+            np.testing.assert_array_equal(host[d, L:L + ng],
+                                          np.ones(ng, np.float32))
+
+
+def test_initialize_accepts_foreign_process_mesh_structurally():
+    """initialize() no longer refuses multi-process meshes; the plan it
+    builds is pure replicated host structure, identical to the
+    single-controller one (every process computes the same plan)."""
+    g1 = _mk()
+    g2 = _mk()
+    _fake_split(g2, range(g2.n_dev // 2))
+    assert np.array_equal(g1.plan.cells, g2.plan.cells)
+    assert np.array_equal(g1.plan.owner, g2.plan.owner)
