@@ -351,17 +351,34 @@ def _save_process_slice(grid, filename, meta, cells, offsets, sizes, counts,
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(f"dccrg_save:{filename}")
-    with open(filename, "r+b") as f:
-        # runs of consecutive local cells share one write
+    from concurrent.futures import ThreadPoolExecutor
+
+    with open(filename, "r+b") as f, ThreadPoolExecutor(1) as pool:
+        # runs of consecutive local cells share one write; the same
+        # one-deep prefetch pipeline as the single-controller path, so
+        # the shard pull of piece k+1 overlaps the file write of k
         if len(my):
             brk = np.flatnonzero(np.diff(my) != 1) + 1
-            for run in np.split(my, brk):
-                f.seek(int(offsets[run[0]]))
-                for s in range(0, len(run), CHUNK):
-                    f.write(_chunk_bytes(grid, cells, counts, 0,
-                                         fixed_spec, fixed_bytes, var_spec,
-                                         reader=grid._shard_read,
-                                         idx=run[s : s + CHUNK]))
+            pieces = [
+                (int(offsets[run[0]] if s == 0 else 0), s == 0,
+                 run[s : s + CHUNK])
+                for run in np.split(my, brk)
+                for s in range(0, len(run), CHUNK)
+            ]
+
+            def assemble(piece):
+                return _chunk_bytes(grid, cells, counts, 0, fixed_spec,
+                                    fixed_bytes, var_spec,
+                                    reader=grid._shard_read, idx=piece[2])
+
+            fut = pool.submit(assemble, pieces[0])
+            for i, (off_here, is_run_start, _idx) in enumerate(pieces):
+                buf = fut.result()
+                if i + 1 < len(pieces):
+                    fut = pool.submit(assemble, pieces[i + 1])
+                if is_run_start:
+                    f.seek(off_here)
+                f.write(buf)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

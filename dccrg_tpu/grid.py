@@ -882,9 +882,13 @@ class Grid:
         semantics as the generic builder."""
         from . import hybrid as hybrid_mod
 
+        if getattr(self, "_hybrid_reuse", None) is None:
+            # epoch-to-epoch cache of the hard-shell neighbor streams
+            # (see hybrid.py): only the dirty region reruns the engine
+            self._hybrid_reuse = {}
         layout, hood_data = hybrid_mod.build_hybrid_plan(
             self.mapping, self.topology, self.neighborhoods, cells, owner,
-            self.n_dev, cap=self._sticky_cap,
+            self.n_dev, cap=self._sticky_cap, reuse=self._hybrid_reuse,
         )
         plan = _Plan(
             cells=cells,
@@ -1008,35 +1012,18 @@ class Grid:
 
         # --- halo send/receive lists (dccrg.hpp:8729-8891) ---
         # device q receives every remote neighbor it reads; sender p is
-        # that cell's owner. Lists sorted by cell id (reference sorts
-        # by id for tag assignment). Built by ONE lexsort-grouping over
-        # the concatenated ghost arrays — O(ghosts log ghosts), no
-        # n_dev^2 Python loop (pod-scale table-build time is linear in
-        # devices; the dense [n_dev, n_dev, M] arrays themselves remain
-        # the all_to_all-fallback format)
-        g_all = np.concatenate([plan.ghost_ids[q] for q in range(n_dev)]) \
-            if n_dev else np.empty(0, np.uint64)
-        q_all = np.repeat(np.arange(n_dev),
-                          [len(plan.ghost_ids[q]) for q in range(n_dev)])
-        total = len(g_all)
-        if total:
-            gidx_all = np.searchsorted(cells, g_all)
-            p_all = owner[gidx_all]
-            order = np.lexsort((g_all, q_all, p_all))
-            p_s, q_s, gx_s = p_all[order], q_all[order], gidx_all[order]
-            pq = p_s.astype(np.int64) * n_dev + q_s
-            starts = np.r_[0, np.flatnonzero(np.diff(pq)) + 1]
-            lens = np.diff(np.r_[starts, total])
-            pos = np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
-            M = self._sticky_cap(("M", hid), max(1, int(lens.max())))
-            send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-            recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-            send_rows[p_s, q_s, pos] = row_by_gidx[p_s, gx_s]
-            recv_rows[q_s, p_s, pos] = row_by_gidx[q_s, gx_s]
-        else:
-            M = self._sticky_cap(("M", hid), 1)
-            send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-            recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+        # that cell's owner. Lists sorted by cell id. Keys are cell
+        # POSITIONS (ids are sorted, so position order == id order);
+        # the shared lexsort-grouping construction lives in uniform.py.
+        ghost_pos = [np.searchsorted(cells, plan.ghost_ids[q])
+                     for q in range(n_dev)]
+        send_rows, recv_rows = uniform_mod.build_pair_tables(
+            ghost_pos, n_dev,
+            lambda keys: owner[keys],
+            lambda p_s, keys: row_by_gidx[p_s, keys],
+            lambda q_s, keys, gpos: row_by_gidx[q_s, keys],
+            lambda needed: self._sticky_cap(("M", hid), needed),
+        )
 
         return _HoodPlan(
             offsets=offsets,
@@ -1280,9 +1267,18 @@ class Grid:
         # write rides this tier: the scatter has no collective and each
         # device applies only its own process's writes (rank-local set,
         # like the reference's operator[] assignment)
-        full_cover = (len(np.atleast_1d(np.asarray(ids)))
-                      == len(self.plan.cells))
-        if self._multiproc and full_cover and not fresh:
+        # a TRUE cover (every cell exactly once) — a same-length list
+        # with duplicates must not take the zero-filled merge below, or
+        # the missed cell's data would be silently zeroed. The sort
+        # only runs in the rare multi-process full-length case.
+        full_cover = (
+            self._multiproc and not fresh
+            and len(np.atleast_1d(np.asarray(ids))) == len(self.plan.cells)
+            and np.array_equal(
+                np.sort(np.atleast_1d(np.asarray(ids, dtype=np.uint64))),
+                self.plan.cells)
+        )
+        if full_cover:
             # replicated full-cover write with ghost preservation:
             # upload the new values (put_sharded serves local shards),
             # then merge ON DEVICE so old ghost rows survive — no

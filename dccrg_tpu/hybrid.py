@@ -131,7 +131,7 @@ class _LevelBlock:
 
 
 def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
-                      cap=None):
+                      cap=None, reuse=None):
     """All plan pieces for a refined grid.
 
     Returns ``(layout, hood_data)`` like uniform.build_uniform_plan:
@@ -214,18 +214,100 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     mark(f"classify (hard {len(hard_pos)}/{n})")
 
     # --- hard streams (generic engine on the hard shell) --------------
+    # Epoch-to-epoch reuse: a hard cell whose whole search box is
+    # untouched since the previous commit has an IDENTICAL neighbor
+    # stream — only the positions shift, and those remap with one
+    # searchsorted. The previous epoch's streams are cached by cell ID
+    # (reuse dict, kept by the Grid), the changed region is the set
+    # difference of the two cell sets box-dilated by the search
+    # radius + 1 on the level-0 lattice, and only the dirty subset of
+    # the hard shell reruns the generic engine — the reference's
+    # incremental rebuild cost (dccrg.hpp:10642-10690).
+    size0_log2 = mapping.max_refinement_level
+    hood_fp = tuple(sorted(
+        (hid, offs.tobytes()) for hid, offs in neighborhoods.items()))
+
+    def lvl0_gidx_of(ids):
+        idx = np.asarray(mapping.get_indices(ids), dtype=np.int64) >> size0_log2
+        return idx[:, 0] + nx * (idx[:, 1] + ny * idx[:, 2])
+
+    reusable = None
+    if reuse and reuse.get("fp") == (dims, hood_fp):
+        prev_cells = reuse["cells"]
+        changed = np.concatenate([
+            np.setdiff1d(cells, prev_cells, assume_unique=True),
+            np.setdiff1d(prev_cells, cells, assume_unique=True),
+        ])
+        if len(changed):
+            lat_ch = np.zeros(n0, dtype=bool)
+            lat_ch[lvl0_gidx_of(changed)] = True
+            dirty = _box_dilate(
+                lat_ch.reshape(nz, ny, nx),
+                (int(rho[2]) + 1, int(rho[1]) + 1, int(rho[0]) + 1),
+                (periodic[2], periodic[1], periodic[0]),
+            ).reshape(-1)
+        else:
+            dirty = np.zeros(n0, dtype=bool)
+        clean_hard = hard_cells[~dirty[lvl0_gidx_of(hard_cells)]]
+        reusable = np.intersect1d(clean_hard, reuse["hard_ids"],
+                                  assume_unique=True)
+        if len(reusable) == 0:
+            reusable = None
+
     streams = {}
+    new_cache = {"fp": (dims, hood_fp), "cells": cells,
+                 "hard_ids": hard_cells, "streams": {}}
+    if reusable is None:
+        fresh_hard, fresh_pos = hard_cells, hard_pos
+    else:
+        fm = ~np.isin(hard_cells, reusable, assume_unique=True)
+        fresh_hard, fresh_pos = hard_cells[fm], hard_pos[fm]
+        # one position remap for the whole epoch: old position -> new
+        # position (every reused entry's source AND neighbor survive —
+        # their boxes are untouched), plus a reusable-source mask over
+        # old positions; per-hood selection is then pure gathers
+        prev_cells = reuse["cells"]
+        old2new = np.searchsorted(cells, prev_cells)
+        reus_old = np.zeros(len(prev_cells), dtype=bool)
+        reus_old[np.searchsorted(prev_cells, reusable)] = True
     for hid, offs in neighborhoods.items():
         src, nbr, off, item = find_neighbors_of(
-            mapping, topology, cells, hard_cells, offs
+            mapping, topology, cells, fresh_hard, offs
         )
-        streams[hid] = (
-            hard_pos[src],
-            np.searchsorted(cells, nbr),
-            off.astype(np.int64),
-            item,
-        )
-    mark("hard streams")
+        off = off.astype(np.int64)
+        spos = fresh_pos[src]
+        npos = np.searchsorted(cells, nbr)
+        if reusable is not None:
+            ps_pos, pn_pos, po, pi = reuse["streams"][hid]
+            keep = reus_old[ps_pos]
+            spos_b = old2new[ps_pos[keep]]
+            npos_b = old2new[pn_pos[keep]]
+            off_b, item_b = po[keep], pi[keep]
+            # both pieces are sorted by source position and share no
+            # source (a cell is wholly fresh or wholly reused), so a
+            # linear merge replaces the N log N sort; within-source
+            # (item, sibling-rank) order is preserved piecewise
+            na, nb = len(spos), len(spos_b)
+            at = np.searchsorted(spos_b, spos) + np.arange(na)
+            bt = np.searchsorted(spos, spos_b) + np.arange(nb)
+            m_spos = np.empty(na + nb, dtype=spos.dtype)
+            m_npos = np.empty(na + nb, dtype=npos.dtype)
+            m_off = np.empty((na + nb,) + off.shape[1:], dtype=off.dtype)
+            m_item = np.empty(na + nb, dtype=item.dtype)
+            for dst_arr, a_arr, b_arr in ((m_spos, spos, spos_b),
+                                          (m_npos, npos, npos_b),
+                                          (m_off, off, off_b),
+                                          (m_item, item, item_b)):
+                dst_arr[at] = a_arr
+                dst_arr[bt] = b_arr
+            spos, npos, off, item = m_spos, m_npos, m_off, m_item
+        new_cache["streams"][hid] = (spos, npos, off, item)
+        streams[hid] = (spos, npos, off, item)
+    if reuse is not None:
+        reuse.clear()
+        reuse.update(new_cache)
+    mark(f"hard streams (reused {0 if reusable is None else len(reusable)}"
+         f"/{len(hard_cells)})")
 
     # --- boundary classification + ghost sets -------------------------
     # every cross-device of-edge (c -> v) makes both endpoints outer
@@ -469,34 +551,15 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
         mark(f"tables hood {hid}")
 
     # --- send / receive lists -----------------------------------------
-    # one lexsort-grouping over the concatenated ghost positions — no
-    # n_dev^2 Python loop (see uniform.py's identical construction)
-    gg_all = (np.concatenate(ghost_pos_sorted) if n_dev
-              else np.empty(0, np.int64))
-    q_all = np.repeat(np.arange(n_dev),
-                      [len(g) for g in ghost_pos_sorted])
-    total = len(gg_all)
-    if total:
-        p_all = owner[gg_all]
-        order = np.lexsort((gg_all, q_all, p_all))
-        p_s, q_s, g_s = p_all[order], q_all[order], gg_all[order]
-        pq = p_s.astype(np.int64) * n_dev + q_s
-        starts = np.r_[0, np.flatnonzero(np.diff(pq)) + 1]
-        lens = np.diff(np.r_[starts, total])
-        pos = np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
-        M = cap(("M", "hybrid"), max(1, int(lens.max())))
-        send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-        recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-        send_rows[p_s, q_s, pos] = row_of_pos[g_s]
-        lens_q = np.array([len(g) for g in ghost_pos_sorted],
-                          dtype=np.int64)
-        q_starts = np.cumsum(lens_q) - lens_q
-        gpos = np.arange(total, dtype=np.int64) - q_starts[q_all]
-        recv_rows[q_s, p_s, pos] = (L + gpos[order]).astype(np.int32)
-    else:
-        M = cap(("M", "hybrid"), 1)
-        send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-        recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+    from .uniform import build_pair_tables
+
+    send_rows, recv_rows = build_pair_tables(
+        ghost_pos_sorted, n_dev,
+        lambda keys: owner[keys],
+        lambda p_s, keys: row_of_pos[keys],
+        lambda q_s, keys, gpos: (L + gpos).astype(np.int32),
+        lambda needed: cap(("M", "hybrid"), needed),
+    )
     for hid in neighborhoods:
         hood_data[hid]["send_rows"] = send_rows
         hood_data[hid]["recv_rows"] = recv_rows

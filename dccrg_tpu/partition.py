@@ -102,6 +102,82 @@ def refine_cut(owner, w, src, dst, n_parts, rounds=8, tol=1.1):
                 moved += len(take)
         if moved == 0:
             break
+    return _swap_pass(owner, w, src, dst, n_parts, hi_cap, lo_cap)
+
+
+def _swap_pass(owner, w, src, dst, n_parts, hi_cap, lo_cap, rounds=4,
+               max_swaps=50000):
+    """KL-style boundary exchange after the greedy sweep (the tail of
+    Zoltan PHG's refinement, dccrg.hpp:7834-7842): the greedy pass only
+    MOVES cells with strict-majority gain, so tied boundaries — e.g. a
+    jagged interface where each cell individually gains nothing — stay
+    put. Swapping a cross-edge PAIR (a in p, b in q -> a in q, b in p)
+    keeps loads balanced to |w[b] - w[a]| and can still reduce the cut:
+    pair gain = gain(a->q) + gain(b->p) - 2 x (a,b multiplicity), the
+    classic Kernighan-Lin correction. Gains are exact at the start of
+    each round; within a round a used-mask keeps swapped cells (whose
+    neighbors' gains went stale) from moving twice, and a round that
+    fails to reduce the total cut is reverted, so the pass can never
+    hand back a worse partition."""
+    n = len(owner)
+    if n == 0 or len(src) == 0 or n_parts == 1:
+        return owner
+    for _ in range(rounds):
+        cross = owner[src] != owner[dst]
+        cut_before = int(cross.sum())
+        if cut_before == 0:
+            break
+        comp = np.full(n, -1, dtype=np.int64)
+        cidx = np.unique(src[cross])  # both directions present
+        comp[cidx] = np.arange(len(cidx))
+        esel = comp[src] >= 0
+        cm = np.bincount(
+            comp[src[esel]] * n_parts + owner[dst[esel]],
+            minlength=len(cidx) * n_parts,
+        ).reshape(len(cidx), n_parts)
+        # undirected cross pairs with (directed) multiplicity
+        a, b = src[cross], dst[cross]
+        key = np.minimum(a, b) * n + np.maximum(a, b)
+        uk, mult = np.unique(key, return_counts=True)
+        ua, ub = uk // n, uk % n
+        m_dir = mult // 2  # each undirected adjacency is listed twice
+        p, q = owner[ua], owner[ub]
+        g = ((cm[comp[ua], q] - cm[comp[ua], p])
+             + (cm[comp[ub], p] - cm[comp[ub], q])
+             - 2 * m_dir)
+        sel = g > 0
+        if not sel.any():
+            break
+        ua, ub, g = ua[sel], ub[sel], g[sel]
+        order = np.argsort(-g, kind="stable")[:max_swaps]
+        prev_owner = owner.copy()
+        load = np.bincount(owner, weights=w, minlength=n_parts)
+        used = np.zeros(n, dtype=bool)
+        swapped = 0
+        for i in order:
+            A, B = ua[i], ub[i]
+            if used[A] or used[B]:
+                continue
+            pp, qq = owner[A], owner[B]
+            if pp == qq:
+                continue
+            dl = w[B] - w[A]
+            # equal-weight swaps never change the balance, so they are
+            # legal even when a load already sits outside the band
+            if dl != 0 and not (lo_cap <= load[pp] + dl <= hi_cap
+                                and lo_cap <= load[qq] - dl <= hi_cap):
+                continue
+            owner[A], owner[B] = qq, pp
+            load[pp] += dl
+            load[qq] -= dl
+            used[A] = used[B] = True
+            swapped += 1
+        if swapped == 0:
+            break
+        if int((owner[src] != owner[dst]).sum()) >= cut_before:
+            # stale-gain conflicts made the round a wash: revert
+            owner = prev_owner
+            break
     return owner
 
 

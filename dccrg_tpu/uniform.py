@@ -76,6 +76,52 @@ class _NeighborMaps:
         return ng.reshape(-1), valid.reshape(-1)
 
 
+def build_pair_tables(ghost_lists, n_dev, owner_of_key, send_row_of,
+                      recv_row_of, cap):
+    """Dense halo send/receive tables from per-receiver ghost lists —
+    the shared lexsort-grouping construction (no n_dev^2 Python loop;
+    the reference builds the equivalent per-peer lists at
+    dccrg.hpp:8729-8891).
+
+    ``ghost_lists[q]`` is the SORTED array of ghost keys device q
+    reads (cell ids, lattice indices or positions — whatever the
+    caller's row resolvers understand). ``owner_of_key(keys)`` maps
+    keys to their owning (sending) device; ``send_row_of(p_s, keys)``
+    and ``recv_row_of(q_s, keys, gpos)`` resolve sender rows and
+    receiver ghost rows, where ``gpos`` is each key's position within
+    its receiver's sorted list. Entries within one (sender, receiver)
+    pair are ordered by key (the reference sorts by id for tag
+    assignment). Returns ``(send_rows, recv_rows)``, both
+    ``[n_dev, n_dev, M]`` int32 padded with -1, M from ``cap``."""
+    g_all = (np.concatenate(ghost_lists) if n_dev
+             else np.empty(0, np.int64))
+    q_all = np.repeat(np.arange(n_dev), [len(g) for g in ghost_lists])
+    total = len(g_all)
+    if total == 0:
+        M = cap(1)
+        shape = (n_dev, n_dev, M)
+        return (np.full(shape, -1, np.int32), np.full(shape, -1, np.int32))
+    p_all = np.asarray(owner_of_key(g_all))
+    order = np.lexsort((g_all, q_all, p_all))
+    p_s, q_s, g_s = p_all[order], q_all[order], g_all[order]
+    # position of each ghost within its (sender, receiver) group
+    pq = p_s.astype(np.int64) * n_dev + q_s
+    starts = np.r_[0, np.flatnonzero(np.diff(pq)) + 1]
+    lens = np.diff(np.r_[starts, total])
+    pos = np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
+    M = cap(max(1, int(lens.max())))
+    send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+    recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+    send_rows[p_s, q_s, pos] = send_row_of(p_s, g_s)
+    # g_all concatenates the receivers' sorted lists, so each key's
+    # in-list position is its index minus its list's start
+    lens_q = np.array([len(g) for g in ghost_lists], dtype=np.int64)
+    q_starts = np.cumsum(lens_q) - lens_q
+    gpos = (np.arange(total, dtype=np.int64) - q_starts[q_all])[order]
+    recv_rows[q_s, p_s, pos] = recv_row_of(q_s, g_s, gpos)
+    return send_rows, recv_rows
+
+
 def _wrap_band(dims, o):
     """Sorted grid indices of cells whose neighbor at cell offset ``o``
     crosses a grid boundary in some dimension — the only cells besides
@@ -356,34 +402,13 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     # pair lists for halo exchange (same construction as the generic
     # path: receive every ghost, sender = owner, sorted by id) — one
     # lexsort-grouping over the concatenated ghosts, no n_dev^2 loop
-    gg_all = (np.concatenate(ghost_gidx) if n_dev
-              else np.empty(0, np.int64))
-    q_all = np.repeat(np.arange(n_dev), [len(g) for g in ghost_gidx])
-    total = len(gg_all)
-    if total:
-        p_all = owner[gg_all]
-        order = np.lexsort((gg_all, q_all, p_all))
-        p_s, q_s, g_s = p_all[order], q_all[order], gg_all[order]
-        # position of each ghost within its (p, q) group
-        pq = p_s.astype(np.int64) * n_dev + q_s
-        starts = np.r_[0, np.flatnonzero(np.diff(pq)) + 1]
-        lens = np.diff(np.r_[starts, total])
-        pos = np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
-        M = cap(("M", "uniform"), max(1, int(lens.max())))
-        send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-        recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-        send_rows[p_s, q_s, pos] = row_of_pos[g_s]
-        # ghost row = L + position in the receiver's sorted ghost
-        # list; gg_all concatenates exactly those sorted lists, so the
-        # position is the element's index minus its list's start
-        lens_q = np.array([len(g) for g in ghost_gidx], dtype=np.int64)
-        q_starts = np.cumsum(lens_q) - lens_q
-        gpos = np.arange(total, dtype=np.int64) - q_starts[q_all]
-        recv_rows[q_s, p_s, pos] = (L + gpos[order]).astype(np.int32)
-    else:
-        M = cap(("M", "uniform"), 1)
-        send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-        recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+    send_rows, recv_rows = build_pair_tables(
+        ghost_gidx, n_dev,
+        lambda keys: owner[keys],
+        lambda p_s, keys: row_of_pos[keys],
+        lambda q_s, keys, gpos: (L + gpos).astype(np.int32),
+        lambda needed: cap(("M", "uniform"), needed),
+    )
 
     # pad rows (beyond each device's local count) need explicit init
     # since the permutation pass only covers real cells

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Basic cell data over a refined, periodic grid (reference
+examples/basic_cell_data.cpp): store each cell's own id as its data,
+refresh remote copies, and verify every ghost copy carries the right
+value — the smallest end-to-end proof that the halo exchange moves the
+right bytes between owners.
+
+Run (defaults to a virtual 8-device CPU mesh):
+    python examples/basic_cell_data.py
+"""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+_plat = os.environ.get("DCCRG_EXAMPLE_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _plat
+_flags = os.environ.get("XLA_FLAGS", "")
+if _plat == "cpu" and "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", _plat)
+
+import numpy as np
+import jax.numpy as jnp
+
+from dccrg_tpu.grid import Grid
+
+
+def main() -> None:
+    # the reference's configuration: odd lengths, refinement, a wide
+    # (length-2) neighborhood, full periodicity, then a balance
+    grid = (
+        Grid(cell_data={"data": jnp.int32})
+        .set_initial_length((7, 13, 11))
+        .set_maximum_refinement_level(1)
+        .set_neighborhood_length(2)
+        .set_periodic(True, True, True)
+        .initialize(partition="morton")
+    )
+    for cid in grid.local_cells().ids[::97]:  # a scattering of refines
+        grid.refine_completely(int(cid))
+    grid.stop_refining()
+    grid.balance_load()
+
+    # set cell id as the value for cell data
+    cells = grid.plan.cells
+    grid.set("data", cells, cells.astype(np.int32))
+
+    # check that cell data is updated correctly between devices:
+    # after the refresh, every ghost row must hold its cell's id
+    grid.update_copies_of_remote_neighbors()
+    host = np.asarray(grid.data["data"])
+    L = grid.plan.L
+    checked = 0
+    for d in range(grid.n_dev):
+        ghosts = grid.plan.ghost_ids[d]
+        if len(ghosts) == 0:
+            continue
+        got = host[d, L : L + len(ghosts)]
+        if not np.array_equal(got, ghosts.astype(np.int32)):
+            bad = np.nonzero(got != ghosts.astype(np.int32))[0][:5]
+            raise SystemExit(
+                f"wrong ghost data on device {d}: rows {bad} hold "
+                f"{got[bad]} instead of {ghosts[bad]}"
+            )
+        checked += len(ghosts)
+
+    # and spot-check through the neighbor query API, as the reference
+    # iterates cell.neighbors_of
+    for cid in cells[:: max(1, len(cells) // 50)]:
+        for nbr, _off in grid.get_neighbors_of(int(cid)):
+            if nbr != 0 and grid.get("data", int(nbr)) != np.int32(nbr):
+                raise SystemExit(f"wrong data for neighbor {nbr} of {cid}")
+
+    print(f"{len(cells)} cells, {checked} ghost copies verified")
+    print("PASSED")
+
+
+if __name__ == "__main__":
+    main()

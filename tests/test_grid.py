@@ -593,3 +593,33 @@ def test_cut_partition_beats_morton_halo_traffic():
         loads = np.bincount(g.plan.owner, minlength=8)
         assert loads.max() <= 1.25 * len(g.plan.cells) / 8
     assert results["cut"] < 0.92 * results["morton"], results
+
+
+def test_cut_partition_beats_rcb_on_anisotropic_grid():
+    """VERDICT r4 item 9: with the KL swap pass, 'cut' must not move
+    more halo bytes than plain RCB even on an anisotropic (stretched)
+    grid with refinement, where RCB's index-space bisection is already
+    strong."""
+    from dccrg_tpu.utils.profiling import halo_bytes_per_update
+
+    results = {}
+    for method in ("rcb", "cut"):
+        g = (Grid(cell_data={"v": jnp.float32})
+             .set_initial_length((32, 8, 2))
+             .set_maximum_refinement_level(1)
+             .set_neighborhood_length(1)
+             .initialize(Mesh(np.array(jax.devices()[:8]), ("dev",)),
+                         partition="morton"))
+        cells = g.plan.cells
+        idx = g.mapping.get_indices(cells)
+        r = np.linalg.norm((idx - np.array([8, 8, 2]))
+                           / np.array([4.0, 1.0, 1.0]), axis=1)
+        for c in cells[r < 6]:
+            g.refine_completely(c)
+        g.stop_refining()
+        g._lb_method = method
+        g.balance_load()
+        results[method] = halo_bytes_per_update(g)
+        loads = np.bincount(g.plan.owner, minlength=8)
+        assert loads.max() <= 1.25 * len(g.plan.cells) / 8
+    assert results["cut"] <= results["rcb"], results

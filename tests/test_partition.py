@@ -144,6 +144,27 @@ def test_cut_without_edges_is_rcb():
     np.testing.assert_array_equal(a, b)
 
 
+def test_swap_pass_heals_boundary_the_greedy_cannot():
+    """Two wrong-side cells straddling the interface: each single move
+    is blocked by the balance caps (it would overload one part), but
+    the KL-style pair swap is balance-neutral and heals both — the
+    tail Zoltan PHG's refinement covers beyond the greedy sweep."""
+    from dccrg_tpu.partition import refine_cut
+
+    owner = np.array([0, 0, 0, 1, 0, 1, 1, 1], dtype=np.int32)
+    n = len(owner)
+    src = np.concatenate([np.arange(n - 1), np.arange(1, n)])
+    dst = np.concatenate([np.arange(1, n), np.arange(n - 1)])
+
+    def cut(o):
+        return int(np.sum(o[src] != o[dst]))
+
+    assert cut(owner) == 6
+    out = refine_cut(owner, np.ones(n), src, dst, 2, tol=1.1)
+    assert cut(out) == 2, out  # clean split
+    np.testing.assert_array_equal(np.bincount(out), [4, 4])
+
+
 def test_refine_cut_reduces_edge_cut_within_balance():
     """A jagged 1-D chain partition: refinement should heal boundary
     cells surrounded by the other device without wrecking balance."""
