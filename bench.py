@@ -240,6 +240,33 @@ def ab_roll_vs_tables():
     return winner, roll_ups, table_ups, None
 
 
+def ab_overlap():
+    """Quick-size A/B of the overlapped fused step (DCCRG_OVERLAP)
+    against the sequential exchange->kernel order. On a single chip the
+    mesh has one device, so this only measures when >1 device is
+    visible; the record tells whether the accelerator-default overlap
+    earns its outer re-pass on real hardware. Skipped when the user
+    exported DCCRG_OVERLAP explicitly."""
+    import jax
+
+    if (os.environ.get("BENCH_SKIP_AB") == "1"
+            or "DCCRG_OVERLAP" in os.environ or len(jax.devices()) < 2):
+        return None, None
+    try:
+        os.environ["DCCRG_OVERLAP"] = "0"
+        seq, _ = bench_grid_path(AB_N, AB_STEPS, label="A/B sequential")
+        os.environ["DCCRG_OVERLAP"] = "1"
+        ovl, _ = bench_grid_path(AB_N, AB_STEPS, label="A/B overlap")
+    except Exception as e:
+        print(f"overlap A/B failed ({e!r})", file=sys.stderr)
+        return None, None
+    finally:
+        os.environ.pop("DCCRG_OVERLAP", None)
+    print(f"A/B overlap at {AB_N}^3: sequential {seq:.3g}/s vs "
+          f"overlap {ovl:.3g}/s", file=sys.stderr)
+    return seq, ovl
+
+
 def probe_backend(timeout_s: int = 150) -> bool:
     """Check in a SUBPROCESS that the accelerator backend actually
     answers: a hung device tunnel would otherwise hang the whole bench
@@ -284,6 +311,7 @@ def main() -> None:
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
     user_env = {v: os.environ[v] for v in _GATHER_VARS if v in os.environ}
+    ab_seq, ab_ovl = ab_overlap()
     winner, ab_roll, ab_tables, ab_note = ab_roll_vs_tables()
     if winner is not None:
         mode_used, mode_source = winner, ("ab" if ab_note is None
@@ -341,6 +369,8 @@ def main() -> None:
                 "gather_mode_source": mode_source,
                 "ab_roll_updates_per_sec": ab_roll,
                 "ab_tables_updates_per_sec": ab_tables,
+                "ab_sequential_updates_per_sec": ab_seq,
+                "ab_overlap_updates_per_sec": ab_ovl,
                 "pallas_updates_per_sec": pallas_ups,
                 "pallas_l2_error": pallas_l2,
                 "pallas_note": ("specialized temporal-blocked kernel bound, "
