@@ -103,6 +103,16 @@ class _LevelBlock:
         self.y = (lin // nxl) % nyl
         self.z = lin // (nxl * nyl)
         self._cache = {}
+        # all level-l cells are contiguous in the sorted cell array, so
+        # a direct lin -> position lattice replaces the per-offset
+        # binary search over the whole grid (the hot part of easy-block
+        # classification) when the level lattice fits in memory
+        n_lat = nxl * nyl * nzl
+        if n_lat <= (1 << 25):
+            self._plat = np.full(n_lat, -1, dtype=np.int32)
+            self._plat[lin] = np.arange(a, b, dtype=np.int32)
+        else:
+            self._plat = None
 
     def lookup(self, off):
         key = (int(off[0]), int(off[1]), int(off[2]))
@@ -121,11 +131,18 @@ class _LevelBlock:
                 arr %= nl
             else:
                 valid &= (arr >= 0) & (arr < nl)
-        nid = (self.first + np.where(valid, xs + nxl * (ys + nyl * zs), 0)
-               ).astype(np.uint64)
-        pos = np.minimum(np.searchsorted(self.cells, nid), len(self.cells) - 1)
-        exist = (self.cells[pos] == nid) & valid
-        out = (pos.astype(np.int64), valid, exist)
+        lin_n = np.where(valid, xs + nxl * (ys + nyl * zs), 0)
+        if self._plat is not None:
+            p32 = self._plat[lin_n]
+            exist = (p32 >= 0) & valid
+            pos = np.where(exist, p32, 0).astype(np.int64)
+        else:
+            nid = (self.first + lin_n).astype(np.uint64)
+            pos = np.minimum(np.searchsorted(self.cells, nid),
+                             len(self.cells) - 1)
+            exist = (self.cells[pos] == nid) & valid
+            pos = pos.astype(np.int64)
+        out = (pos, valid, exist)
         self._cache[key] = out
         return out
 

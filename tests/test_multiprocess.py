@@ -93,55 +93,82 @@ def test_collective_paths_unchanged_under_split():
     np.testing.assert_array_equal(res[0], res[1])
 
 
-def test_two_process_checkpoint_slices_merge_exactly(tmp_path):
-    """Two faked processes filling one file == the single-save file."""
-    vals = None
+def _single_vs_split_save(make_grid, tmp_path, **save_kwargs):
+    """Save an identically-built grid once single-controller and once
+    as two faked processes filling one file; return both byte strings.
+    The protocol under test: proc 0 writes meta + its slice, proc 1
+    (_ckpt_writes_meta=False) fills its own payload runs."""
     files = {}
     for mode in ("single", "split"):
-        g = _mk({"v": jnp.float32, "w": jnp.int32})
-        cells = g.plan.cells
-        rng = np.random.default_rng(3)
-        vals = rng.random(len(cells)).astype(np.float32)
-        g.set("v", cells, vals)
-        g.set("w", cells, (cells % np.uint64(5)).astype(np.int32))
+        g = make_grid()
         fn = tmp_path / f"{mode}.dc"
         if mode == "single":
-            g.save_grid_data(str(fn), header=b"HDR!")
+            g.save_grid_data(str(fn), **save_kwargs)
         else:
             half = g.n_dev // 2
             _fake_split(g, range(half))
-            g.save_grid_data(str(fn), header=b"HDR!")  # proc 0: meta + slice
+            g.save_grid_data(str(fn), **save_kwargs)
             _fake_split(g, range(half, g.n_dev))
             g._ckpt_writes_meta = False
-            g.save_grid_data(str(fn), header=b"HDR!")  # proc 1: its slice
+            g.save_grid_data(str(fn), **save_kwargs)
         files[mode] = fn.read_bytes()
-    assert files["single"] == files["split"]
+    return files["single"], files["split"]
+
+
+def test_two_process_checkpoint_slices_merge_exactly(tmp_path):
+    """Two faked processes filling one file == the single-save file."""
+    def make():
+        g = _mk({"v": jnp.float32, "w": jnp.int32})
+        cells = g.plan.cells
+        rng = np.random.default_rng(3)
+        g.set("v", cells, rng.random(len(cells)).astype(np.float32))
+        g.set("w", cells, (cells % np.uint64(5)).astype(np.int32))
+        return g
+
+    single, split = _single_vs_split_save(make, tmp_path, header=b"HDR!")
+    assert single == split
 
 
 def test_two_process_ragged_checkpoint(tmp_path):
     """Variable-size payloads: counts ride the replicated device
     gather, ragged rows ride per-process shard reads."""
     cap = 4
-    files = {}
-    for mode in ("single", "split"):
+
+    def make():
         g = _mk({"n": jnp.int32, "p": ((cap, 2), jnp.float32)})
         cells = g.plan.cells
         rng = np.random.default_rng(5)
-        counts = rng.integers(0, cap + 1, len(cells)).astype(np.int32)
-        g.set("n", cells, counts)
+        g.set("n", cells,
+              rng.integers(0, cap + 1, len(cells)).astype(np.int32))
         g.set("p", cells, rng.random((len(cells), cap, 2)).astype(np.float32))
-        fn = tmp_path / f"{mode}.dc"
-        if mode == "single":
-            g.save_grid_data(str(fn), variable={"p": "n"})
-        else:
-            half = g.n_dev // 2
-            _fake_split(g, range(half))
-            g.save_grid_data(str(fn), variable={"p": "n"})
-            _fake_split(g, range(half, g.n_dev))
-            g._ckpt_writes_meta = False
-            g.save_grid_data(str(fn), variable={"p": "n"})
-        files[mode] = fn.read_bytes()
-    assert files["single"] == files["split"]
+        return g
+
+    single, split = _single_vs_split_save(make, tmp_path,
+                                          variable={"p": "n"})
+    assert single == split
+
+
+def test_two_process_slices_on_refined_morton_grid(tmp_path):
+    """Fragmented ownership (morton partition + refinement): the
+    per-process payload runs are many and interleaved; the merged file
+    must still be byte-identical to the single-controller save."""
+    def make():
+        g = (
+            Grid(cell_data={"v": jnp.float32})
+            .set_initial_length((6, 6, 4))
+            .set_maximum_refinement_level(1)
+            .set_neighborhood_length(1)
+            .initialize(partition="morton")
+        )
+        for cid in g.local_cells().ids[::17]:
+            g.refine_completely(int(cid))
+        g.stop_refining()
+        cells = g.plan.cells
+        g.set("v", cells, (cells % np.uint64(19)).astype(np.float32))
+        return g
+
+    single, split = _single_vs_split_save(make, tmp_path)
+    assert single == split
 
 
 def test_process_local_load(tmp_path):
