@@ -129,11 +129,56 @@ def test_overlap_static_field(monkeypatch):
                                rtol=2e-6, atol=2e-6)
 
 
+def test_overlap_odd_device_count(monkeypatch):
+    """5 devices over 40 z-planes: uneven slabs, per-device outer
+    widths differ — the padded outer tables must stay consistent."""
+    from jax.sharding import Mesh
+
+    results = []
+    for ov in (False, True):
+        monkeypatch.setenv("DCCRG_OVERLAP", "1" if ov else "0")
+        g = (
+            Grid(cell_data={"v": jnp.float32})
+            .set_initial_length((8, 8, 40))
+            .set_periodic(True, True, False)
+            .set_maximum_refinement_level(0)
+            .set_neighborhood_length(1)
+            .initialize(Mesh(np.array(jax.devices()[:5]), ("dev",)),
+                        partition="block")
+        )
+        cells = g.plan.cells
+        rng = np.random.default_rng(11)
+        g.set("v", cells, rng.random(len(cells)).astype(np.float32))
+        g.update_copies_of_remote_neighbors()
+        g.run_steps(_kern, ["v"], ["v"], 4)
+        if ov:
+            assert _engaged(g)
+        results.append(g.get("v", cells))
+    np.testing.assert_array_equal(results[0], results[1])
+
+
 def test_overlap_nonperiodic(monkeypatch):
     results = []
     for ov in (False, True):
         g = _mk(monkeypatch, ov, periodic=(False, False, False))
         g.run_steps(_kern, ["v"], ["v"], 5)
+        results.append(g.get("v", g.plan.cells))
+    np.testing.assert_array_equal(results[0], results[1])
+
+
+def test_overlap_honors_transfer_predicates(monkeypatch):
+    """Predicate-filtered per-field pair tables feed the overlapped
+    sends/scatters exactly as the sequential path's."""
+    results = []
+    for ov in (False, True):
+        g = _mk(monkeypatch, ov)
+        # block transfers of cells whose id is 0 mod 3
+        g.set_transfer_predicate(
+            "v", lambda ids, s, r, h: (ids % np.uint64(3)) != 0)
+        g.update_copies_of_remote_neighbors()
+        g.run_steps(_kern, ["v"], ["v"], 3)
+        if ov:
+            assert _engaged(g)
         results.append(g.get("v", g.plan.cells))
     np.testing.assert_array_equal(results[0], results[1])
 
