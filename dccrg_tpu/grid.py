@@ -253,10 +253,11 @@ class _HoodPlan:
     """
 
     def __init__(self, offsets, nbr_rows, nbr_offs, nbr_mask,
-                 send_rows, recv_rows, n_inner, lists=None, to_tables=None,
-                 to_rows=None, to_offs=None, to_mask=None, offs_const=None,
-                 hard_rows=None, hard_nbr_rows=None, hard_offs=None,
-                 hard_mask=None, scale_rows=None, closed_form=None):
+                 send_rows=None, recv_rows=None, n_inner=None, lists=None,
+                 to_tables=None, to_rows=None, to_offs=None, to_mask=None,
+                 offs_const=None, hard_rows=None, hard_nbr_rows=None,
+                 hard_offs=None, hard_mask=None, scale_rows=None,
+                 closed_form=None, pair_compact=None):
         self.offsets = offsets  # [K, 3] neighborhood items
         # stencil gather tables, per device, padded. May be ONE thunk
         # (returning (rows, mask)) for closed-form plans, materialized
@@ -285,9 +286,15 @@ class _HoodPlan:
         # hybrid plans: offs_const is in CELL units; per-row cell size
         # (index units) scales it on device (far/easy rows only)
         self.scale_rows = scale_rows  # [n_dev, L] int32 or None
-        # halo exchange tables:
-        self.send_rows = send_rows  # [n_dev(src), n_dev(dst), M] int32 or -1
-        self.recv_rows = recv_rows  # [n_dev(dst), n_dev(src), M] int32 or -1
+        # halo exchange lists: the COMPACT per-entry record
+        # (uniform.build_pair_tables) is the primary store — O(ghosts)
+        # memory; the dense [n_dev, n_dev, M] views are materialized
+        # lazily (all_to_all fallback + host introspection only), so
+        # pod-scale meshes never pay the n_dev^2 arrays on the
+        # per-delta ppermute path
+        self._pair_compact = pair_compact
+        self._send_rows = send_rows  # [n_dev(src), n_dev(dst), M] or -1
+        self._recv_rows = recv_rows  # [n_dev(dst), n_dev(src), M] or -1
         self.n_inner = n_inner  # [n_dev] rows [0, n_inner) have no remote deps
         self._lists = lists  # NeighborLists or thunk
         if to_tables is None and to_rows is not None:
@@ -299,6 +306,26 @@ class _HoodPlan:
         # only the table values re-upload)
         self._dev = {}
         self._pair_host = {}  # field -> predicate-filtered pair tables
+
+    @property
+    def pair_compact(self):
+        return self._pair_compact
+
+    def _dense_pairs(self):
+        if self._send_rows is None:
+            from . import uniform as uniform_mod
+
+            self._send_rows, self._recv_rows = uniform_mod.dense_pair_tables(
+                self._pair_compact)
+        return self._send_rows, self._recv_rows
+
+    @property
+    def send_rows(self):
+        return self._dense_pairs()[0]
+
+    @property
+    def recv_rows(self):
+        return self._dense_pairs()[1]
 
     @property
     def lists(self):
@@ -663,8 +690,10 @@ class Grid:
         halo data from device p under the neighborhood — the peer sets
         the reference's Some_Reduce reduces over (its process-boundary
         peers, dccrg_mpi_support.hpp:285-380)."""
-        hp = self.plan.hoods[neighborhood_id]
-        return np.asarray((hp.recv_rows >= 0).any(axis=2))
+        c = self.plan.hoods[neighborhood_id].pair_compact
+        out = np.zeros((self.n_dev, self.n_dev), dtype=bool)
+        out[c["q"], c["p"]] = True
+        return out
 
     # capacities whose arrays are small but whose need varies a lot
     # epoch-to-epoch (hard-shell sizes, pair lists, fixup widths):
@@ -863,8 +892,7 @@ class Grid:
                 offs_const=hd["offs_const"],
                 closed_form=hd.get("closed_form"),
                 to_tables=hd["to_thunk"],
-                send_rows=hd["send_rows"],
-                recv_rows=hd["recv_rows"],
+                pair_compact=hd["pair_compact"],
                 n_inner=(layout["n_inner"]
                          if hid == DEFAULT_NEIGHBORHOOD_ID else None),
                 lists=lists_thunk,
@@ -920,8 +948,7 @@ class Grid:
                 hard_mask=hd["hard_mask"],
                 scale_rows=layout["scale_rows"],
                 to_tables=hd["to_thunk"],
-                send_rows=hd["send_rows"],
-                recv_rows=hd["recv_rows"],
+                pair_compact=hd["pair_compact"],
                 n_inner=(layout["n_inner"]
                          if hid == DEFAULT_NEIGHBORHOOD_ID else None),
                 lists=lists_thunk,
@@ -1017,7 +1044,7 @@ class Grid:
         # the shared lexsort-grouping construction lives in uniform.py.
         ghost_pos = [np.searchsorted(cells, plan.ghost_ids[q])
                      for q in range(n_dev)]
-        send_rows, recv_rows = uniform_mod.build_pair_tables(
+        pair_compact = uniform_mod.build_pair_tables(
             ghost_pos, n_dev,
             lambda keys: owner[keys],
             lambda p_s, keys: row_by_gidx[p_s, keys],
@@ -1031,8 +1058,7 @@ class Grid:
             nbr_offs=nbr_offs,
             nbr_mask=nbr_mask,
             to_tables=to_tables,
-            send_rows=send_rows,
-            recv_rows=recv_rows,
+            pair_compact=pair_compact,
             n_inner=(n_inner_arr if n_inner_arr is not None else None),
             lists=nl,
         )
@@ -1794,35 +1820,63 @@ class Grid:
             for k in stale:
                 del hood._dev[k]
 
-    def _field_pair_tables(self, neighborhood_id, field):
-        """(send_rows, recv_rows) for one field: the neighborhood's
-        tables, filtered by the field's transfer predicate if set."""
+    @staticmethod
+    def _pair_groups(c):
+        """(starts, ends) of the (sender, receiver) groups in a compact
+        pair record (entries are sorted by (p, q))."""
+        pq = c["p"] * np.int64(c["n_dev"]) + c["q"]
+        starts = np.r_[0, np.flatnonzero(np.diff(pq)) + 1] \
+            if len(pq) else np.empty(0, np.int64)
+        ends = np.r_[starts[1:], len(pq)] if len(pq) else starts
+        return starts.astype(np.int64), ends.astype(np.int64)
+
+    def _field_pair_compact(self, neighborhood_id, field):
+        """The hood's compact pair record, filtered by the field's
+        transfer predicate if set (dropped entries removed; surviving
+        entries KEEP their slot positions, so holes mirror the dense
+        tables' -1 slots)."""
         hood = self.plan.hoods[neighborhood_id]
+        c = hood.pair_compact
         fn = self._transfer_predicates.get(field)
         if fn is None:
+            return c
+        cached = hood._pair_host.get(("c", field))
+        if cached is not None:
+            return cached
+        keep = np.ones(len(c["p"]), dtype=bool)
+        starts, ends = self._pair_groups(c)
+        # the predicate contract is per-(sender, receiver): each live
+        # pair gets its own call (O(devices x peers) calls)
+        for s, e in zip(starts, ends):
+            p0, q0 = int(c["p"][s]), int(c["q"][s])
+            ids = self.plan.local_ids[p0][c["srow"][s:e]]
+            k = np.asarray(fn(ids, p0, q0, neighborhood_id), dtype=bool)
+            if k.shape != ids.shape:
+                raise ValueError(
+                    "transfer predicate must return one bool per cell"
+                )
+            keep[s:e] = k
+        out = dict(c)
+        for key in ("p", "q", "pos", "srow", "rrow"):
+            out[key] = c[key][keep]
+        hood._pair_host[("c", field)] = out
+        return out
+
+    def _field_pair_tables(self, neighborhood_id, field):
+        """(send_rows, recv_rows) DENSE views for one field — the
+        all_to_all fallback and host introspection format; the
+        per-delta ppermute path uses _field_pair_compact and never
+        materializes these."""
+        hood = self.plan.hoods[neighborhood_id]
+        if self._transfer_predicates.get(field) is None:
             return hood.send_rows, hood.recv_rows
         cached = hood._pair_host.get(field)
         if cached is not None:
             return cached
-        send = hood.send_rows.copy()
-        recv = hood.recv_rows.copy()
-        # only pairs with traffic (O(devices x peers), not n_dev^2);
-        # the predicate contract is per-(sender, receiver) so each live
-        # pair still gets its own call
-        for p, q in np.argwhere((send >= 0).any(axis=2)):
-            valid = np.nonzero(send[p, q] >= 0)[0]
-            ids = self.plan.local_ids[p][send[p, q, valid]]
-            keep = np.asarray(fn(ids, int(p), int(q), neighborhood_id),
-                              dtype=bool)
-            if keep.shape != ids.shape:
-                raise ValueError(
-                    "transfer predicate must return one bool per cell"
-                )
-            drop = valid[~keep]
-            send[p, q, drop] = -1
-            recv[q, p, drop] = -1
-        hood._pair_host[field] = (send, recv)
-        return send, recv
+        out = uniform_mod.dense_pair_tables(self._field_pair_compact(
+            neighborhood_id, field))
+        hood._pair_host[field] = out
+        return out
 
     # halo exchanges with at most this many peer offsets use one
     # ppermute per offset instead of a dense all_to_all: each device
@@ -1837,9 +1891,9 @@ class Grid:
         hood = self.plan.hoods[neighborhood_id]
         if ("deltas",) in hood._dev:
             return hood._dev[("deltas",)]
-        send = hood.send_rows
-        pairs = np.argwhere((send >= 0).any(axis=2))
-        deltas = tuple(sorted({int((q - p) % self.n_dev) for p, q in pairs}))
+        c = hood.pair_compact
+        deltas = tuple(sorted(set(
+            np.unique((c["q"] - c["p"]) % self.n_dev).tolist())))
         if len(deltas) > self._MAX_PEER_OFFSETS:
             deltas = None  # all_to_all fallback (memoized as None too)
         hood._dev[("deltas",)] = deltas
@@ -1856,33 +1910,40 @@ class Grid:
         deltas = self._peer_deltas(neighborhood_id)
         sends, recvs = [], []
         for n in field_names:
-            s, r = self._field_pair_tables(neighborhood_id, n)
             if deltas is None:
+                s, r = self._field_pair_tables(neighborhood_id, n)
                 sends.append(hood.dev(("pair", n, "s"), s, sh))
                 recvs.append(hood.dev(("pair", n, "r"), r, sh))
                 continue
+            # per-delta compact tables straight from the compact pair
+            # record — the dense [n_dev, n_dev, M] arrays are never
+            # touched on this path (pod-scale memory stays linear);
+            # fc/dvec are only computed when some delta's tables are
+            # not yet cached (the warm path is dictionary hits)
+            fc = dvec = None
             for d in deltas:
                 key_s, key_r = ("peer", n, d, "s"), ("peer", n, d, "r")
                 if key_s not in hood._dev:
-                    p = np.arange(self.n_dev)
-                    # device p SENDS s[p, p+d]; device p RECEIVES (from
-                    # p-d) into rows r[p, p-d] — both sharded by p
-                    sd = s[p, (p + d) % self.n_dev]  # [n_dev, M]
-                    rd = r[p, (p - d) % self.n_dev]
+                    if fc is None:
+                        fc = self._field_pair_compact(neighborhood_id, n)
+                        dvec = (fc["q"] - fc["p"]) % self.n_dev
+                    sel = dvec == d
                     # shrink to this delta's own (sticky) width; slots
                     # may have predicate holes, so cover the LAST valid
                     # slot, not the count
-                    vs = (sd >= 0).any(axis=0)
-                    vr = (rd >= 0).any(axis=0)
-                    need = 1
-                    if vs.any():
-                        need = max(need, int(np.nonzero(vs)[0][-1]) + 1)
-                    if vr.any():
-                        need = max(need, int(np.nonzero(vr)[0][-1]) + 1)
+                    need = (int(fc["pos"][sel].max()) + 1
+                            if sel.any() else 1)
                     Md = self._sticky_cap(("Md", neighborhood_id, d), need)
-                    Md = min(Md, sd.shape[1])
-                    hood.dev(key_s, sd[:, :Md], sh)
-                    hood.dev(key_r, rd[:, :Md], sh)
+                    Md = min(Md, fc["M"])
+                    sd = np.full((self.n_dev, Md), -1, dtype=np.int32)
+                    rd = np.full((self.n_dev, Md), -1, dtype=np.int32)
+                    inw = sel & (fc["pos"] < Md)
+                    # device p SENDS to p+d; device q RECEIVES from q-d
+                    # — both tables sharded by the acting device
+                    sd[fc["p"][inw], fc["pos"][inw]] = fc["srow"][inw]
+                    rd[fc["q"][inw], fc["pos"][inw]] = fc["rrow"][inw]
+                    hood.dev(key_s, sd, sh)
+                    hood.dev(key_r, rd, sh)
                 sends.append(hood._dev[key_s])
                 recvs.append(hood._dev[key_r])
         return tuple(sends), tuple(recvs)
@@ -2057,18 +2118,16 @@ class Grid:
     ) -> int:
         """Total cells sent per halo update (dccrg.hpp:5428); with
         ``field``, the count after that field's transfer predicate."""
-        if field is not None:
-            send, _ = self._field_pair_tables(neighborhood_id, field)
-            return int(np.sum(send >= 0))
-        return int(np.sum(self.plan.hoods[neighborhood_id].send_rows >= 0))
+        if field is None:
+            return len(self.plan.hoods[neighborhood_id].pair_compact["p"])
+        return len(self._field_pair_compact(neighborhood_id, field)["p"])
 
     def get_number_of_update_receive_cells(
         self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID, field: str | None = None
     ) -> int:
-        if field is not None:
-            _, recv = self._field_pair_tables(neighborhood_id, field)
-            return int(np.sum(recv >= 0))
-        return int(np.sum(self.plan.hoods[neighborhood_id].recv_rows >= 0))
+        if field is None:
+            return len(self.plan.hoods[neighborhood_id].pair_compact["q"])
+        return len(self._field_pair_compact(neighborhood_id, field)["q"])
 
     # -- stencil execution ---------------------------------------------
 
@@ -2852,27 +2911,26 @@ class Grid:
     def get_cells_to_send(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
         """{(sender, receiver): cell ids} of one halo update — the
         reference's per-peer send lists (dccrg.hpp get_cells_to_send)."""
-        hood = self.plan.hoods[neighborhood_id]
+        c = self.plan.hoods[neighborhood_id].pair_compact
+        starts, ends = self._pair_groups(c)
         out = {}
-        send = hood.send_rows
-        for p, q in np.argwhere((send >= 0).any(axis=2)):  # live pairs only
-            rows = send[p, q]
-            out[(int(p), int(q))] = self.plan.local_ids[p][rows[rows >= 0]]
+        for s, e in zip(starts, ends):
+            p0, q0 = int(c["p"][s]), int(c["q"][s])
+            out[(p0, q0)] = self.plan.local_ids[p0][c["srow"][s:e]]
         return out
 
     def get_cells_to_receive(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):
         """{(sender, receiver): cell ids} computed from the RECEIVE
-        tables (ghost rows on the receiver), independently of
-        get_cells_to_send — the two must agree, and tests cross-check
-        them (reference get_cells_to_receive)."""
-        hood = self.plan.hoods[neighborhood_id]
-        out = {}
-        recv = hood.recv_rows  # [receiver, sender, M] ghost rows
+        rows (ghost rows on the receiver), independently of
+        get_cells_to_send's sender rows — the two must agree, and tests
+        cross-check them (reference get_cells_to_receive)."""
+        c = self.plan.hoods[neighborhood_id].pair_compact
+        starts, ends = self._pair_groups(c)
         L = self.plan.L
-        for q, p in np.argwhere((recv >= 0).any(axis=2)):
-            rows = recv[q, p]
-            rows = rows[rows >= 0]
-            out[(int(p), int(q))] = self.plan.ghost_ids[q][rows - L]
+        out = {}
+        for s, e in zip(starts, ends):
+            p0, q0 = int(c["p"][s]), int(c["q"][s])
+            out[(p0, q0)] = self.plan.ghost_ids[q0][c["rrow"][s:e] - L]
         return out
 
     def get_neighborhood_of(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID):

@@ -570,7 +570,7 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     # --- send / receive lists -----------------------------------------
     from .uniform import build_pair_tables
 
-    send_rows, recv_rows = build_pair_tables(
+    pair_compact = build_pair_tables(
         ghost_pos_sorted, n_dev,
         lambda keys: owner[keys],
         lambda p_s, keys: row_of_pos[keys],
@@ -578,8 +578,7 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
         lambda needed: cap(("M", "hybrid"), needed),
     )
     for hid in neighborhoods:
-        hood_data[hid]["send_rows"] = send_rows
-        hood_data[hid]["recv_rows"] = recv_rows
+        hood_data[hid]["pair_compact"] = pair_compact
     mark("send/recv lists")
 
     # --- lazy neighbors_to tables -------------------------------------

@@ -78,7 +78,7 @@ class _NeighborMaps:
 
 def build_pair_tables(ghost_lists, n_dev, owner_of_key, send_row_of,
                       recv_row_of, cap):
-    """Dense halo send/receive tables from per-receiver ghost lists —
+    """COMPACT halo send/receive lists from per-receiver ghost lists —
     the shared lexsort-grouping construction (no n_dev^2 Python loop;
     the reference builds the equivalent per-peer lists at
     dccrg.hpp:8729-8891).
@@ -91,16 +91,22 @@ def build_pair_tables(ghost_lists, n_dev, owner_of_key, send_row_of,
     receiver ghost rows, where ``gpos`` is each key's position within
     its receiver's sorted list. Entries within one (sender, receiver)
     pair are ordered by key (the reference sorts by id for tag
-    assignment). Returns ``(send_rows, recv_rows)``, both
-    ``[n_dev, n_dev, M]`` int32 padded with -1, M from ``cap``."""
+    assignment).
+
+    Returns a compact dict — O(total ghosts) memory, NOT the dense
+    ``[n_dev, n_dev, M]`` arrays (those are quadratic in devices and
+    only materialized lazily for the all_to_all fallback and host
+    introspection; see grid._HoodPlan.send_rows):
+      ``n_dev, M`` — device count and the capped max pair width;
+      ``p, q, pos, srow, rrow`` — per-entry sender, receiver, slot
+      within the pair, sender row, receiver ghost row, sorted by
+      (sender, receiver, key)."""
     g_all = (np.concatenate(ghost_lists) if n_dev
              else np.empty(0, np.int64))
     q_all = np.repeat(np.arange(n_dev), [len(g) for g in ghost_lists])
     total = len(g_all)
     if total == 0:
-        M = cap(1)
-        shape = (n_dev, n_dev, M)
-        return (np.full(shape, -1, np.int32), np.full(shape, -1, np.int32))
+        return empty_pair_compact(n_dev, cap(1))
     p_all = np.asarray(owner_of_key(g_all))
     order = np.lexsort((g_all, q_all, p_all))
     p_s, q_s, g_s = p_all[order], q_all[order], g_all[order]
@@ -110,15 +116,38 @@ def build_pair_tables(ghost_lists, n_dev, owner_of_key, send_row_of,
     lens = np.diff(np.r_[starts, total])
     pos = np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
     M = cap(max(1, int(lens.max())))
-    send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-    recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
-    send_rows[p_s, q_s, pos] = send_row_of(p_s, g_s)
     # g_all concatenates the receivers' sorted lists, so each key's
     # in-list position is its index minus its list's start
     lens_q = np.array([len(g) for g in ghost_lists], dtype=np.int64)
     q_starts = np.cumsum(lens_q) - lens_q
     gpos = (np.arange(total, dtype=np.int64) - q_starts[q_all])[order]
-    recv_rows[q_s, p_s, pos] = recv_row_of(q_s, g_s, gpos)
+    return {
+        "n_dev": n_dev, "M": M,
+        "p": p_s.astype(np.int64), "q": q_s.astype(np.int64), "pos": pos,
+        "srow": np.asarray(send_row_of(p_s, g_s), dtype=np.int32),
+        "rrow": np.asarray(recv_row_of(q_s, g_s, gpos), dtype=np.int32),
+    }
+
+
+def empty_pair_compact(n_dev, M):
+    """A compact pair record with no entries (single-device plans and
+    ghost-free meshes)."""
+    e = np.empty(0, np.int64)
+    return {"n_dev": n_dev, "M": M, "p": e, "q": e, "pos": e,
+            "srow": np.empty(0, np.int32), "rrow": np.empty(0, np.int32)}
+
+
+def dense_pair_tables(compact):
+    """Materialize the dense ``[n_dev, n_dev, M]`` send/recv arrays
+    from a compact pair record (all_to_all fallback + introspection;
+    O(n_dev^2 M) memory — never built on the per-delta ppermute
+    path)."""
+    n_dev, M = compact["n_dev"], compact["M"]
+    send_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+    recv_rows = np.full((n_dev, n_dev, M), -1, dtype=np.int32)
+    p, q, pos = compact["p"], compact["q"], compact["pos"]
+    send_rows[p, q, pos] = compact["srow"]
+    recv_rows[q, p, pos] = compact["rrow"]
     return send_rows, recv_rows
 
 
@@ -151,7 +180,7 @@ def _wrap_band(dims, o):
 
 def _closed_form_hoods(hoods, dims, periodic, size, n_dev, owner,
                        local_ids, ghost_gidx, n_inner, L, R,
-                       row_of_pos, send_rows, recv_rows, cap, dense_tables,
+                       row_of_pos, pair_compact, cap, dense_tables,
                        maps, reader_rows, perm):
     """Closed-form hood data for a multi-device partition contiguous in
     cell-id order (block slabs, incl. weighted cuts).
@@ -281,8 +310,7 @@ def _closed_form_hoods(hoods, dims, periodic, size, n_dev, owner,
             "tables_thunk": tables_thunk,
             "nbr_offs": offs_thunk,
             "offs_const": offs_const,
-            "send_rows": send_rows,
-            "recv_rows": recv_rows,
+            "pair_compact": pair_compact,
             "to_thunk": make_to_thunk(),
         }
     return hood_data
@@ -402,7 +430,7 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     # pair lists for halo exchange (same construction as the generic
     # path: receive every ghost, sender = owner, sorted by id) — one
     # lexsort-grouping over the concatenated ghosts, no n_dev^2 loop
-    send_rows, recv_rows = build_pair_tables(
+    pair_compact = build_pair_tables(
         ghost_gidx, n_dev,
         lambda keys: owner[keys],
         lambda p_s, keys: row_of_pos[keys],
@@ -501,7 +529,7 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
         hood_data = _closed_form_hoods(
             hoods, dims, periodic, size, n_dev, owner,
             local_ids, ghost_gidx, n_inner, L, R,
-            row_of_pos, send_rows, recv_rows, cap, dense_tables,
+            row_of_pos, pair_compact, cap, dense_tables,
             maps, reader_rows, perm,
         )
         layout = dict(
@@ -533,8 +561,7 @@ def build_uniform_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
             "nbr_offs": offs_thunk,
             "offs_const": offs_const,
             "nbr_mask": mask_t.reshape(n_dev, L, k),
-            "send_rows": send_rows,
-            "recv_rows": recv_rows,
+            "pair_compact": pair_compact,
         }
 
     def make_to_thunk(offs):
@@ -675,8 +702,7 @@ def _build_single_device_plan(mapping, hoods, cells, dims, periodic, size, cap):
         for j, (w, s) in enumerate(wrongs):
             wrong_rows[0, j, : len(w)] = w
             wrong_src[0, j, : len(w)] = s
-        send_rows = np.full((1, 1, 16), -1, dtype=np.int32)
-        recv_rows = np.full((1, 1, 16), -1, dtype=np.int32)
+        pair_compact = empty_pair_compact(1, 16)
 
         def tables_thunk(offs=offs, k=k, hid=hid):
             """Materialize the dense [1, L, k] tables on demand (host
@@ -722,8 +748,7 @@ def _build_single_device_plan(mapping, hoods, cells, dims, periodic, size, cap):
             "tables_thunk": tables_thunk,
             "nbr_offs": offs_thunk,
             "offs_const": offs_const,
-            "send_rows": send_rows,
-            "recv_rows": recv_rows,
+            "pair_compact": pair_compact,
             "to_thunk": make_to_thunk(),
         }
 

@@ -217,6 +217,27 @@ def test_full_cover_set_preserving_ghosts_under_split():
                                           np.ones(ng, np.float32))
 
 
+def test_ppermute_exchange_never_materializes_dense_pair_tables():
+    """Pod-scale memory: the per-delta ppermute exchange works from
+    the compact O(ghosts) pair record; the dense [n_dev, n_dev, M]
+    arrays must stay unmaterialized unless the all_to_all fallback or
+    a host introspection API asks for them."""
+    from dccrg_tpu.grid import DEFAULT_NEIGHBORHOOD_ID
+
+    g = _mk()
+    cells = g.plan.cells
+    g.set("v", cells, (cells % np.uint64(7)).astype(np.float32))
+    g.update_copies_of_remote_neighbors()
+    g.run_steps(lambda c, n, o, m: {"v": c["v"]}, ["v"], ["v"], 2)
+    hood = g.plan.hoods[DEFAULT_NEIGHBORHOOD_ID]
+    assert hood._send_rows is None and hood._recv_rows is None
+    # introspection still works, via lazy materialization
+    sends = g.get_cells_to_send()
+    assert sends and hood._send_rows is None  # compact-backed
+    _ = hood.send_rows
+    assert hood._send_rows is not None
+
+
 def test_initialize_accepts_foreign_process_mesh_structurally():
     """initialize() no longer refuses multi-process meshes; the plan it
     builds is pure replicated host structure, identical to the

@@ -86,9 +86,10 @@ def test_corrupt_send_list_detected(mesh8):
     hp = grid.plan.hoods[DEFAULT_NEIGHBORHOOD_ID]
     if not np.any(hp.send_rows >= 0):
         pytest.skip("no remote transfers on this mesh")
-    hp.send_rows = hp.send_rows.copy()
-    p, q, j = np.argwhere(hp.send_rows >= 0)[0]
-    hp.send_rows[p, q, j] = -1
+    # corrupt the (lazily materialized) dense view in place
+    hp._send_rows = hp.send_rows.copy()
+    p, q, j = np.argwhere(hp._send_rows >= 0)[0]
+    hp._send_rows[p, q, j] = -1
     with pytest.raises(VerificationError):
         V.verify_remote_neighbor_info(grid)
 
