@@ -2815,6 +2815,19 @@ class Grid:
         if snap is None:
             return ids.copy(), None
         dev, rows = self._host_rows(ids)  # plan unchanged since staging
+        if self._multiproc:
+            # rank-local peek: only this process's moving cells, read
+            # from addressable shards of the snapshot (no collective)
+            lm = self._proc_local_dev[dev]
+            by_dev = {s.index[0].start: s.data
+                      for s in snap.addressable_shards}
+            out = np.empty((int(lm.sum()),) + snap.shape[2:],
+                           dtype=snap.dtype)
+            ldev, lrows = dev[lm], rows[lm]
+            for d in np.unique(ldev):
+                m = ldev == d
+                out[m] = np.asarray(by_dev[int(d)][0, lrows[m]])
+            return ids[lm].copy(), out
         return ids.copy(), np.asarray(snap[dev, rows])
 
     def finish_balance_load(self) -> None:

@@ -217,6 +217,28 @@ def test_full_cover_set_preserving_ghosts_under_split():
                                           np.ones(ng, np.float32))
 
 
+def test_staged_balance_peek_is_rank_local():
+    """staged_balance_data under a process split returns only this
+    process's moving cells, read from addressable shards."""
+    g = _mk()
+    cells = g.plan.cells
+    g.set("v", cells, (cells % np.uint64(9)).astype(np.float32))
+    for c in cells[:6]:
+        g.pin(int(c), (g.get_process(int(c)) + 1) % g.n_dev)
+    g.initialize_balance_load(use_zoltan=False)
+    g.continue_balance_load()
+    all_ids, all_vals = g.staged_balance_data("v")
+    half = list(range(g.n_dev // 2))
+    _fake_split(g, half)
+    ids, vals = g.staged_balance_data("v")
+    _unfake(g)
+    dev, _ = g._host_rows(ids)
+    assert np.isin(dev, half).all()
+    sel = np.isin(all_ids, ids)
+    np.testing.assert_array_equal(all_vals[sel], vals)
+    g.finish_balance_load()  # leave the grid consistent
+
+
 def test_ppermute_exchange_never_materializes_dense_pair_tables():
     """Pod-scale memory: the per-delta ppermute exchange works from
     the compact O(ghosts) pair record; the dense [n_dev, n_dev, M]
