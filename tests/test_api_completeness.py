@@ -281,3 +281,39 @@ def test_parity_accessors(mesh8):
     assert c0 == ERROR_CELL  # level-0 cell 1 was replaced by children
     assert g.get_comm_size() == 8
     assert g.get_number_of_cells() == len(g.get_cells())
+
+
+def test_remote_boundary_cells_have_valid_ghost_data(mesh8):
+    """Reference tests/proc_bdy_cells/test1.cpp: on a tiny refined
+    grid with a wide (length-2) neighborhood, after balance + halo
+    update every remote cell on a process boundary must hold valid
+    data on the reading device, and the boundary views must be
+    consistent with the neighbor relations."""
+    g = (Grid(cell_data={"v": jnp.int32})
+         .set_initial_length((3, 1, 1))
+         .set_neighborhood_length(2)
+         .set_maximum_refinement_level(1)
+         .set_load_balancing_method("rcb")
+         .initialize(mesh8))
+    g.balance_load()
+    g.refine_completely(3)
+    g.stop_refining()
+    g.balance_load()
+    cells = g.plan.cells
+    g.set("v", cells, cells.astype(np.int32))
+    g.update_copies_of_remote_neighbors()
+
+    remote = set(g.remote_cells().ids.tolist())
+    # every ghost copy holds its cell's value, on every reader
+    host = np.asarray(g.data["v"])
+    L = g.plan.L
+    for d in range(g.n_dev):
+        ghosts = g.plan.ghost_ids[d]
+        np.testing.assert_array_equal(host[d, L:L + len(ghosts)],
+                                      ghosts.astype(np.int32))
+        assert set(ghosts.tolist()) <= remote
+    # and every remote neighbor of a local cell is in the remote view
+    for cid in cells:
+        for nbr, _off in g.get_neighbors_of(int(cid)):
+            if nbr and g.get_process(int(nbr)) != g.get_process(int(cid)):
+                assert nbr in remote
