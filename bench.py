@@ -146,7 +146,7 @@ def bench_pallas(baseline):
     return updates_per_sec, l2
 
 
-def bench_grid_path(n=None, steps=None, label="grid path"):
+def bench_grid_path(n=None, steps=None, label="grid path", dtype=None):
     """The general Grid runtime (closed-form plan / gather tables +
     fused run_steps) on the same physics — the framework path an AMR
     user exercises, at max_refinement_level 0
@@ -157,7 +157,8 @@ def bench_grid_path(n=None, steps=None, label="grid path"):
 
     n = n if n is not None else GRID_N
     steps = steps if steps is not None else GRID_STEPS
-    solver = GridAdvection(n=n, nz=n)
+    kw = {} if dtype is None else {"dtype": dtype}
+    solver = GridAdvection(n=n, nz=n, **kw)
     dt = 0.5 * solver.max_time_step()
 
     solver.run(1, dt)  # warmup / compile
@@ -338,6 +339,18 @@ def main() -> None:
         except Exception as e2:  # keep the JSON line flowing for the driver
             print(f"grid path bench failed again: {e2!r}", file=sys.stderr)
             grid_ups, grid_l2 = None, None
+    # bfloat16 storage leg (float32 compute): halves the stencil's HBM
+    # traffic — reported separately, the headline stays float32 (the
+    # reference computes in double; f32 is already the recorded
+    # departure, bf16 is the optional narrow-storage mode)
+    bf16_ups = bf16_l2 = None
+    if os.environ.get("BENCH_SKIP_BF16") != "1" and grid_ups is not None:
+        try:
+            import jax.numpy as jnp
+            bf16_ups, bf16_l2 = bench_grid_path(
+                label="grid path bf16", dtype=jnp.bfloat16)
+        except Exception as e:
+            print(f"bf16 leg failed ({e!r})", file=sys.stderr)
     # restore the caller's gather settings for the Pallas leg
     for v in _GATHER_VARS:
         os.environ.pop(v, None)
@@ -371,6 +384,8 @@ def main() -> None:
                 "ab_tables_updates_per_sec": ab_tables,
                 "ab_sequential_updates_per_sec": ab_seq,
                 "ab_overlap_updates_per_sec": ab_ovl,
+                "bf16_updates_per_sec": bf16_ups,
+                "bf16_l2_error": bf16_l2,
                 "pallas_updates_per_sec": pallas_ups,
                 "pallas_l2_error": pallas_l2,
                 "pallas_note": ("specialized temporal-blocked kernel bound, "

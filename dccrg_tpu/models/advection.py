@@ -95,15 +95,21 @@ def make_uniform_flux_kernel(cell_length):
     """Upwind flux kernel for the general-Grid gather path on a uniform
     (max_refinement_level=0) grid with in-plane velocities: same math
     as AdvectionSolver._kernel (solve.hpp:44-279) expressed over
-    face-neighbor gather tables (offsets in index units, cell size 1)."""
+    face-neighbor gather tables (offsets in index units, cell size 1).
+    Arithmetic is always float32: narrow-storage fields (bfloat16 HBM
+    residency, the TPU bandwidth lever) are widened on read and the
+    fused loop's writeback narrows the result — no-op casts when the
+    fields are float32 already."""
     inv = [1.0 / float(cell_length[d]) for d in range(3)]
+    f32 = jnp.float32
 
     def kernel(cell, nbr, offs, mask, dt):
-        rho_c = cell["density"][:, None]
-        rho_n = nbr["density"]
+        rho_c = cell["density"].astype(f32)[:, None]
+        rho_n = nbr["density"].astype(f32)
         acc = jnp.zeros_like(rho_n)
         for d, vname in ((0, "vx"), (1, "vy")):
-            v = 0.5 * (cell[vname][:, None] + nbr[vname])
+            v = 0.5 * (cell[vname].astype(f32)[:, None]
+                       + nbr[vname].astype(f32))
             up_pos = jnp.where(v >= 0, rho_c, rho_n)
             up_neg = jnp.where(v >= 0, rho_n, rho_c)
             face_pos = mask & (offs[..., d] == 1)
@@ -111,7 +117,7 @@ def make_uniform_flux_kernel(cell_length):
             m = v * (dt * inv[d])
             acc = acc - jnp.where(face_pos, up_pos * m, 0.0)
             acc = acc + jnp.where(face_neg, up_neg * m, 0.0)
-        return {"density": cell["density"] + jnp.sum(acc, axis=1)}
+        return {"density": cell["density"].astype(f32) + jnp.sum(acc, axis=1)}
 
     return kernel
 
@@ -124,16 +130,18 @@ class GridAdvection:
     one XLA program) instead of the dense fast path. Face-neighbor
     neighborhood (set_neighborhood_length(0), dccrg.hpp:8015-8076)."""
 
-    def __init__(self, n=256, nz=None, mesh=None, cfl=0.5):
+    def __init__(self, n=256, nz=None, mesh=None, cfl=0.5,
+                 dtype=jnp.float32):
         from ..grid import Grid
 
         nz = nz if nz is not None else n
         self.n, self.nz, self.cfl = n, nz, cfl
+        self.dtype = jnp.dtype(dtype)
         dx = 1.0 / n
         self.dx = dx
         self.grid = (
-            Grid(cell_data={"density": jnp.float32, "vx": jnp.float32,
-                            "vy": jnp.float32})
+            Grid(cell_data={"density": self.dtype, "vx": self.dtype,
+                            "vy": self.dtype})
             .set_initial_length((n, n, nz))
             .set_periodic(True, True, False)
             .set_maximum_refinement_level(0)
@@ -154,6 +162,8 @@ class GridAdvection:
         ridx = self.grid.device_row_ids()
         nx = np.int32(n)
 
+        fdt = self.dtype
+
         @partial(jax.jit, out_shardings=self.grid._sharding())
         def _init_fields(ridx):
             valid = ridx >= 0
@@ -163,9 +173,10 @@ class GridAdvection:
             y = (yi.astype(jnp.float32) + 0.5) * jnp.float32(dx)
             zero = jnp.float32(0.0)
             return (
-                jnp.where(valid, hump_density(x, y).astype(jnp.float32), zero),
-                jnp.where(valid, jnp.float32(0.5) - y, zero),
-                jnp.where(valid, x - jnp.float32(0.5), zero),
+                jnp.where(valid, hump_density(x, y).astype(jnp.float32),
+                          zero).astype(fdt),
+                jnp.where(valid, jnp.float32(0.5) - y, zero).astype(fdt),
+                jnp.where(valid, x - jnp.float32(0.5), zero).astype(fdt),
             )
 
         rho, vx, vy = _init_fields(ridx)
