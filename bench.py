@@ -108,13 +108,17 @@ AB_STEPS = int(os.environ.get("BENCH_AB_STEPS", "10"))
 
 
 def bench_pallas(baseline):
-    """The Pallas temporal-blocked fast path at the north-star size."""
+    """The Pallas temporal-blocked fast path at the north-star size.
+    BENCH_PALLAS_DTYPE=bfloat16 runs the narrow-storage variant (the
+    kernel's flux arithmetic is weakly typed, so state stays bf16 in
+    VMEM and HBM — roughly half the traffic of f32 on chip)."""
     import jax
     import jax.numpy as jnp
     from dccrg_tpu.models.advection import PallasRotationAdvection, analytic_density
     import numpy as np
 
-    solver = PallasRotationAdvection(n=N, nz=NZ)
+    pdt = jnp.dtype(os.environ.get("BENCH_PALLAS_DTYPE", "float32"))
+    solver = PallasRotationAdvection(n=N, nz=NZ, dtype=pdt)
     dt = 0.5 * solver.max_time_step()
 
     # warmup / compile, synced by a forced scalar readback (a device
@@ -132,6 +136,7 @@ def bench_pallas(baseline):
 
     n_cells = N * N * NZ
     updates_per_sec = n_cells * STEPS * solver.steps_per_pass / elapsed
+    pallas_dtype = str(pdt)
     x = (np.arange(N) + 0.5) / N
     exact = np.asarray(
         analytic_density(x[:, None, None], x[None, :, None], solver.time)
@@ -143,7 +148,7 @@ def bench_pallas(baseline):
         f"{solver.steps_per_pass} steps; l2 {l2:.2e}",
         file=sys.stderr,
     )
-    return updates_per_sec, l2
+    return updates_per_sec, l2, pallas_dtype
 
 
 def bench_grid_path(n=None, steps=None, label="grid path", dtype=None):
@@ -356,10 +361,10 @@ def main() -> None:
         os.environ.pop(v, None)
     os.environ.update(user_env)
     try:
-        pallas_ups, pallas_l2 = bench_pallas(baseline)
+        pallas_ups, pallas_l2, pallas_dt = bench_pallas(baseline)
     except Exception as e:  # the specialized kernel is secondary
         print(f"pallas bench failed ({e!r})", file=sys.stderr)
-        pallas_ups, pallas_l2 = None, None
+        pallas_ups, pallas_l2, pallas_dt = None, None, "not-run"
 
     # headline value = the FRAMEWORK (general Grid runtime) throughput
     # at the north-star size; the Pallas figure is the specialized
@@ -389,7 +394,8 @@ def main() -> None:
                 "pallas_updates_per_sec": pallas_ups,
                 "pallas_l2_error": pallas_l2,
                 "pallas_note": ("specialized temporal-blocked kernel bound, "
-                                f"{N}^2x{NZ}; not the framework path"),
+                                f"{N}^2x{NZ} {pallas_dt}"
+                                "; not the framework path"),
                 "baseline_node_updates_per_sec": baseline,
                 "baseline_note": (f"measured C++ upwind loop, extrapolated "
                                   f"to a {NODE_CORES}-core node at perfect "

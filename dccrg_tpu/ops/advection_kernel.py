@@ -215,7 +215,7 @@ def make_rotation_step(
         ),
         cost_estimate=pl.CostEstimate(
             flops_per_cell * X * Y * Z,
-            bytes_accessed=2 * 4 * X * Y * Z,
+            bytes_accessed=2 * jnp.dtype(dtype).itemsize * X * Y * Z,
             transcendentals=0,
         ),
     )
