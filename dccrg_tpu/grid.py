@@ -3153,18 +3153,15 @@ class Grid:
         self._removed_data = {}
         if len(old_ids):
             # gather the disappearing cells' rows ON DEVICE and pull
-            # only that slice (not every field's full array); padded to
-            # a sticky capacity so the gather doesn't retrace per epoch
+            # only that slice (not every field's full array), through
+            # the psum gather whose replicated (structure-derived) args
+            # make it consistent across processes too; the sticky cap
+            # keeps the program from retracing per epoch
             dev, rows = self._host_rows(old_ids)
-            n_old = len(old_ids)
-            capn = self._sticky_cap("removed", n_old)
-            dpad = np.zeros(capn, dtype=np.int64)
-            rpad = np.zeros(capn, dtype=np.int64)
-            dpad[:n_old] = dev
-            rpad[:n_old] = rows
+            capn = self._sticky_cap("removed", len(old_ids))
             for name in self.fields:
                 self._removed_data[name] = (
-                    old_ids, np.asarray(self.data[name][dpad, rpad])[:n_old]
+                    old_ids, self._device_gather(name, dev, rows, cap=capn)
                 )
         else:
             self._removed_data = {name: (old_ids, None) for name in self.fields}
