@@ -217,6 +217,41 @@ def test_full_cover_set_preserving_ghosts_under_split():
                                           np.ones(ng, np.float32))
 
 
+def test_amr_commit_and_balance_under_split():
+    """The whole AMR pipeline — refine, commit, projection, balance —
+    must produce the same structure and data under a faked process
+    split as single-controller (the structure decisions are replicated;
+    data movement is device-side)."""
+    results = {}
+    for split in (False, True):
+        g = (
+            Grid(cell_data={"v": jnp.float32})
+            .set_initial_length((8, 8, 4))
+            .set_periodic(True, True, False)
+            .set_maximum_refinement_level(1)
+            .set_neighborhood_length(1)
+            .initialize(partition="block")
+        )
+        cells = g.plan.cells
+        g.set("v", cells, (cells % np.uint64(23)).astype(np.float32))
+        if split:
+            _fake_split(g, range(g.n_dev // 2))
+        for cid in g.plan.cells[:12:3]:
+            g.refine_completely(int(cid))
+        g.stop_refining()
+        g.assign_children_from_parents(fields=["v"])
+        g.clear_refined_unrefined_data()
+        g.set_partitioning_option("method", "morton")
+        g.balance_load()
+        g.update_copies_of_remote_neighbors()
+        _unfake(g)
+        results[split] = (g.plan.cells.copy(), g.plan.owner.copy(),
+                          g.get("v", g.plan.cells))
+    np.testing.assert_array_equal(results[False][0], results[True][0])
+    np.testing.assert_array_equal(results[False][1], results[True][1])
+    np.testing.assert_array_equal(results[False][2], results[True][2])
+
+
 def test_staged_balance_peek_is_rank_local():
     """staged_balance_data under a process split returns only this
     process's moving cells, read from addressable shards."""
