@@ -183,6 +183,42 @@ def test_overlap_honors_transfer_predicates(monkeypatch):
     np.testing.assert_array_equal(results[0], results[1])
 
 
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_overlap_fuzz_random_structures(monkeypatch, seed):
+    """Random dims / partition / refinement / steps: overlapped and
+    sequential fused loops must agree bitwise (power-of-two kernel)."""
+    rng = np.random.default_rng(100 + seed)
+    dims = (int(rng.choice([4, 8])), int(rng.choice([4, 8])),
+            int(rng.choice([24, 40])))
+    part = str(rng.choice(["block", "morton", "rcb"]))
+    per = bool(rng.integers(0, 2))
+    refine = bool(rng.integers(0, 2))
+    steps = int(rng.integers(2, 6))
+    results = []
+    for ov in (False, True):
+        lrng = np.random.default_rng(1000 + seed)  # identical draws per leg
+        monkeypatch.setenv("DCCRG_OVERLAP", "1" if ov else "0")
+        g = (
+            Grid(cell_data={"v": jnp.float32})
+            .set_initial_length(dims)
+            .set_periodic(per, per, False)
+            .set_maximum_refinement_level(1 if refine else 0)
+            .set_neighborhood_length(1)
+            .initialize(partition=part)
+        )
+        if refine:
+            cells = g.plan.cells
+            for cid in cells[lrng.integers(0, len(cells), 3)]:
+                g.refine_completely(int(cid))
+            g.stop_refining()
+        cells = g.plan.cells
+        g.set("v", cells, lrng.random(len(cells)).astype(np.float32))
+        g.update_copies_of_remote_neighbors()
+        g.run_steps(_kern, ["v"], ["v"], steps)
+        results.append(g.get("v", cells))
+    np.testing.assert_array_equal(results[0], results[1])
+
+
 def test_overlap_survives_balance(monkeypatch):
     """Partition changes rebuild the outer tables per epoch."""
     results = []
