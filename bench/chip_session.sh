@@ -1,0 +1,32 @@
+#!/bin/bash
+# One-shot chip measurement battery: run the moment the TPU tunnel
+# answers (see bench/tpu_poller.sh -> /tmp/tpu_up). Captures every
+# staged measurement in priority order so a short window still gets
+# the headline numbers first. Outputs land in bench/chip_results/.
+set -u
+cd "$(dirname "$0")/.."
+out=bench/chip_results
+mkdir -p "$out"
+ts=$(date +%s)
+
+run() { # name, timeout_s, cmd...
+  local name=$1 t=$2; shift 2
+  echo "=== $name ($(date +%T)) ===" | tee -a "$out/log_$ts.txt"
+  timeout -k 10 "$t" "$@" >"$out/${name}_$ts.out" 2>&1
+  echo "rc=$? $name" | tee -a "$out/log_$ts.txt"
+  tail -3 "$out/${name}_$ts.out" | tee -a "$out/log_$ts.txt"
+}
+
+# 1. the headline: 512^3 grid path + both A/Bs + pallas bound + bf16
+run bench_main 3600 python bench.py
+# 2. pallas bound, narrow storage
+run bench_pallas_bf16 1800 env BENCH_SKIP_AB=1 BENCH_SKIP_BF16=1 \
+    BENCH_PALLAS_DTYPE=bfloat16 python bench.py
+# 3. poisson kernel VMEM fit + rates
+run poisson_256 1200 python bench/poisson_bench.py --n 256
+# 4. native pallas/poisson kernel tests on the chip
+run tpu_tests 1800 env DCCRG_TEST_TPU=1 python -m pytest tests/ -q
+# 5. overlap A/B on the chip backend (single chip: mesh of 1 device —
+#    records the no-exchange baseline sanity)
+run overlap_ab 900 python bench/overlap_bench.py --n 128
+echo "chip session complete: $out (ts $ts)" | tee -a "$out/log_$ts.txt"
