@@ -12,6 +12,13 @@ EOF
   if [[ "$out" == OK* ]]; then
     echo "$ts UP $out" >> /tmp/tpu_poll.log
     echo "$ts" > /tmp/tpu_up
+    # first contact: fire the full measurement battery once, so even
+    # an unattended tunnel window is captured
+    if [ ! -f /tmp/tpu_session_started ]; then
+      touch /tmp/tpu_session_started
+      nohup "$(dirname "$0")/chip_session.sh" \
+        >> /tmp/tpu_poll.log 2>&1 &
+    fi
   else
     echo "$ts DOWN $(echo "$out" | tail -1 | head -c 200)" >> /tmp/tpu_poll.log
   fi
