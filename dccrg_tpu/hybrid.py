@@ -440,13 +440,24 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
 
     # --- gather tables per hood (split far+easy / hard) ---------------
     hood_data = {}
+    # rows covered by the far/easy full-width writes below: the pad
+    # fill only needs the complement (hard + pad rows, ~the surface),
+    # saving a full GB-scale memory pass per hood table at large grids
+    covered = np.zeros(n_dev * L, dtype=bool)
+    covered[far_rowidx] = True
+    for _blk_c, _easy_c in blocks:
+        covered[easy_rowidx[_blk_c.level][1]] = True
+    uncovered_rows = np.nonzero(~covered)[0]
+    del covered
+
     for hid, offs_in in neighborhoods.items():
         offs = np.asarray(offs_in, dtype=np.int64).reshape(-1, 3)
         k = len(offs)
         s_p, s_n, s_off, s_item = streams[hid]
         nE = len(s_p)
 
-        rows_t = np.full((n_dev * L, k), R - 1, dtype=np.int32)
+        rows_t = np.empty((n_dev * L, k), dtype=np.int32)
+        rows_t[uncovered_rows] = R - 1  # far/easy rows overwritten below
         mask_t = np.zeros((n_dev * L, k), dtype=bool)
 
         # far rows: closed-form lattice tables (native one-pass builder
