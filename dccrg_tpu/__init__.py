@@ -25,7 +25,8 @@ from .length import GridLength
 from .topology import GridTopology
 from .mapping import Mapping
 from .geometry import NoGeometry, CartesianGeometry, StretchedCartesianGeometry
-from .grid import DEFAULT_NEIGHBORHOOD_ID, Grid, default_mesh
+from .grid import (DEFAULT_NEIGHBORHOOD_ID, Grid, SlotwiseKernel,
+                   default_mesh)
 from .dense import DenseGrid, dense_mesh
 from .verify import VerificationError, verify_all
 
@@ -41,6 +42,7 @@ __all__ = [
     "CartesianGeometry",
     "StretchedCartesianGeometry",
     "Grid",
+    "SlotwiseKernel",
     "DenseGrid",
     "DEFAULT_NEIGHBORHOOD_ID",
     "default_mesh",

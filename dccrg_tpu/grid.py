@@ -137,33 +137,49 @@ def _synth_key(cf):
             tuple(map(tuple, cf["offsets"])), bool(cf.get("multi")))
 
 
-def _synth_mask(synth, L, row_gidx=None):
-    """Closed-form [L, S] validity mask: from the row index alone on
-    single-device plans (rows ARE grid order), or from the per-row
-    grid index array on multi-device closed-form plans (rows are
-    [inner|outer] per device; ``row_gidx`` is ``device_row_ids[:L]``
-    for this device's shard, -1 on pad rows)."""
-    (nx_, ny_, nz_), per_, n0_, offs_cells, *_ = synth
+def _synth_prep(synth, L, row_gidx=None):
+    """(grid index, base validity) per row for closed-form mask
+    synthesis: from the row index alone on single-device plans (rows
+    ARE grid order), or from the per-row grid index array on
+    multi-device closed-form plans (rows are [inner|outer] per device;
+    ``row_gidx`` is ``device_row_ids[:L]`` for this device's shard,
+    -1 on pad rows)."""
+    n0_ = synth[2]
     if row_gidx is None:
         gidx = jnp.arange(L, dtype=jnp.int32)
         base_valid = (gidx < n0_) if L > n0_ else jnp.ones((L,), bool)
     else:
         base_valid = row_gidx >= 0
         gidx = jnp.maximum(row_gidx, 0)
+    return gidx, base_valid
+
+
+def _synth_col(synth, gidx, base_valid, j):
+    """One [L] validity column of the closed-form mask (stencil slot
+    ``j``) — lets slot-wise kernels avoid materializing the [L, S]
+    stack."""
+    (nx_, ny_, nz_), per_, _n0, offs_cells, *_ = synth
     xc = gidx % nx_
     yc = (gidx // nx_) % ny_
     zc = gidx // (nx_ * ny_)
-    cols = []
-    for (ox, oy, oz) in offs_cells:
-        v = base_valid
-        for coord, o, nd, per in ((xc, ox, nx_, per_[0]),
-                                  (yc, oy, ny_, per_[1]),
-                                  (zc, oz, nz_, per_[2])):
-            if o != 0 and not per:
-                t = coord + o
-                v = v & (t >= 0) & (t < nd)
-        cols.append(v)
-    return jnp.stack(cols, axis=1)
+    ox, oy, oz = offs_cells[j]
+    v = base_valid
+    for coord, o, nd, per in ((xc, ox, nx_, per_[0]),
+                              (yc, oy, ny_, per_[1]),
+                              (zc, oz, nz_, per_[2])):
+        if o != 0 and not per:
+            t = coord + o
+            v = v & (t >= 0) & (t < nd)
+    return v
+
+
+def _synth_mask(synth, L, row_gidx=None):
+    """Closed-form [L, S] validity mask (stack of _synth_col)."""
+    gidx, base_valid = _synth_prep(synth, L, row_gidx)
+    offs_cells = synth[3]
+    return jnp.stack(
+        [_synth_col(synth, gidx, base_valid, j)
+         for j in range(len(offs_cells))], axis=1)
 
 
 
@@ -218,6 +234,84 @@ def _make_nbr_gather(use_roll, r_shifts, L, nrows, nmask, wr, ws):
         return jnp.where(mexp, st, jnp.zeros((), st.dtype))
 
     return gather
+
+
+def _make_nbr_slot_gather(use_roll, r_shifts, L, nrows, wr, ws):
+    """Column-``j`` neighbor gather for slot-wise stencils:
+    ``gather(fl, j, mask_j) -> [L, ...]``, one stencil slot at a time,
+    so the [L, S] neighbor stack (and its O(L*S) HBM residency —
+    the 512^3 OOM) is never materialized. Roll mode zeroes masked
+    slots (the rolled values there are junk); table mode returns the
+    raw gather like the dense table path (masked slots point at
+    zeroed pad rows; kernels gate on the mask either way)."""
+    if not use_roll:
+        return lambda fl, j, mask_j: fl[nrows[:, j]]
+
+    def gather(fl, j, mask_j):
+        col = jnp.roll(fl[:L], -r_shifts[j], axis=0)
+        col = col.at[wr[j]].set(fl[ws[j]], mode="drop")
+        mexp = mask_j.reshape(mask_j.shape + (1,) * (col.ndim - 1))
+        return jnp.where(mexp, col, jnp.zeros((), col.dtype))
+
+    return gather
+
+
+def _make_offs_col(uniform_offs, noffs, sc0):
+    """Per-slot offsets closure shared by the stencil bodies and the
+    dense adapter: raw (NOT premasked — kernels gate on the mask),
+    ``[3]`` for uniform plans, ``[L, 3]`` when scaled (``sc0`` is the
+    per-row size factor) or table-driven."""
+    if uniform_offs:
+        if sc0 is not None:
+            return lambda j: noffs[j][None, :] * sc0[:, None]
+        return lambda j: noffs[j]
+    return lambda j: noffs[:, j]
+
+
+def _run_slotwise(kernel, cell_fields, nbr_col, offs_col, mask_col,
+                  n_slots, extra):
+    """The one slot loop every slot-wise call site shares:
+    init -> slot per stencil leg -> finish."""
+    carry = kernel.init(cell_fields, *extra)
+    for j in range(n_slots):
+        mj = mask_col(j)
+        carry = kernel.slot(carry, cell_fields, nbr_col(j, mj),
+                            offs_col(j), mj, *extra)
+    return kernel.finish(carry, cell_fields, *extra)
+
+
+class SlotwiseKernel:
+    """Memory-lean stencil kernel: the bulk pass feeds it one neighbor
+    slot (stencil leg) at a time, so peak HBM is O(cells) instead of
+    the dense contract's O(cells * slots) — the difference between
+    fitting 512^3 in a single chip's HBM or not. Three callables:
+
+    - ``init(cell_fields, *extra) -> carry``
+    - ``slot(carry, cell_fields, nbr_j, offs_j, mask_j, *extra) ->
+      carry`` — ``nbr_j[name]`` is ``[L, ...]`` (slot j's neighbor
+      values), ``offs_j`` is ``[3]`` / ``[L, 3]`` and is NOT
+      pre-masked (gate on ``mask_j``, shape ``[L]``)
+    - ``finish(carry, cell_fields, *extra) -> {name: [L, ...]}``
+
+    Instances are also plain dense kernels (``__call__`` loops the
+    slots over axis 1), so the surface-sized passes — hard rows near
+    refinement, the overlap outer re-pass — and the CPU path use the
+    same object unchanged. The slots accumulate sequentially, so
+    results match the dense contract's axis-1 reduction only to
+    float re-association."""
+
+    def __init__(self, init, slot, finish):
+        self.init = init
+        self.slot = slot
+        self.finish = finish
+
+    def __call__(self, cell_fields, nbr_fields, offs, mask, *extra):
+        return _run_slotwise(
+            self, cell_fields,
+            lambda j, mj: {n: v[:, j] for n, v in nbr_fields.items()},
+            (lambda j: offs[:, j]) if offs.ndim == 3 else
+            (lambda j: offs[j]),
+            lambda j: mask[..., j], mask.shape[-1], extra)
 
 
 def default_mesh(devices=None) -> Mesh:
@@ -2338,13 +2432,16 @@ class Grid:
         n_in, n_out = len(fields_in), len(fields_out)
         axis, mesh = self.axis, self.mesh
         use_roll = r_shifts is not None
+        if isinstance(kernel, SlotwiseKernel) and include_to:
+            raise ValueError("SlotwiseKernel does not support include_to")
+        slotwise = isinstance(kernel, SlotwiseKernel)
 
         def body(nrows, noffs, nmask, *args):
             nrows = nrows[0]
+            row_gidx = None
             if synth is not None:
-                nmask = _synth_mask(
-                    synth, L,
-                    row_gidx=(nmask[0][:L] if synth[4] else None))
+                row_gidx = nmask[0][:L] if synth[4] else None
+                nmask = None  # synthesized on demand (dense) / per-slot
             else:
                 nmask = nmask[0]
             if use_roll:
@@ -2352,12 +2449,8 @@ class Grid:
                 wr, ws = wr[0], ws[0]
             if scaled:
                 sc, *args = args
-            if uniform_offs:
-                noffs = nmask[:, :, None] * noffs[None, :, :]
-                if scaled:
-                    # offs_const is in cell units; scale by per-row size
-                    noffs = noffs * sc[0][:, None, None]
-            else:
+                sc0 = sc[0]
+            if not uniform_offs:
                 noffs = noffs[0]
             if split:
                 hr, hnr, hof, hm, *args = args
@@ -2369,19 +2462,51 @@ class Grid:
             outs_cur = args[n_in: n_in + n_out]
             extra = args[n_in + n_out:]
             cell_fields = {n: f[0][:L] for n, f in zip(fields_in, ins)}
-            gather_nbr = _make_nbr_gather(
-                use_roll, r_shifts, L, nrows, nmask,
-                wr if use_roll else None, ws if use_roll else None,
-            )
-            nbr_fields = {n: gather_nbr(f[0]) for n, f in zip(fields_in, ins)}
-            if include_to:
-                to_fields = {n: f[0][trows] for n, f in zip(fields_in, ins)}
-                result = kernel(
-                    cell_fields, nbr_fields, noffs, nmask, to_fields, toffs, tmask,
-                    *extra,
+            if slotwise:
+                # per-slot gather + accumulate: the [L, S] neighbor
+                # stack (and [L, S, 3] offsets) never materialize
+                if synth is not None:
+                    sgidx, sbase = _synth_prep(synth, L, row_gidx=row_gidx)
+                    mask_col = lambda j: _synth_col(synth, sgidx, sbase, j)
+                else:
+                    mask_col = lambda j: nmask[:, j]
+                n_slots = len(r_shifts) if use_roll else nrows.shape[1]
+                slot_gather = _make_nbr_slot_gather(
+                    use_roll, r_shifts, L, nrows,
+                    wr if use_roll else None, ws if use_roll else None,
                 )
+                result = _run_slotwise(
+                    kernel, cell_fields,
+                    lambda j, mj: {n: slot_gather(f[0], j, mj)
+                                   for n, f in zip(fields_in, ins)},
+                    _make_offs_col(uniform_offs, noffs,
+                                   sc0 if scaled else None),
+                    mask_col, n_slots, extra)
             else:
-                result = kernel(cell_fields, nbr_fields, noffs, nmask, *extra)
+                if nmask is None:
+                    nmask = _synth_mask(synth, L, row_gidx=row_gidx)
+                if uniform_offs:
+                    noffs = nmask[:, :, None] * noffs[None, :, :]
+                    if scaled:
+                        # offs_const is in cell units; scale by per-row
+                        # size
+                        noffs = noffs * sc0[:, None, None]
+                gather_nbr = _make_nbr_gather(
+                    use_roll, r_shifts, L, nrows, nmask,
+                    wr if use_roll else None, ws if use_roll else None,
+                )
+                nbr_fields = {n: gather_nbr(f[0])
+                              for n, f in zip(fields_in, ins)}
+                if include_to:
+                    to_fields = {n: f[0][trows]
+                                 for n, f in zip(fields_in, ins)}
+                    result = kernel(
+                        cell_fields, nbr_fields, noffs, nmask, to_fields,
+                        toffs, tmask, *extra,
+                    )
+                else:
+                    result = kernel(cell_fields, nbr_fields, noffs, nmask,
+                                    *extra)
             if split:
                 # second pass over the hard rows (near refinement) with
                 # their own, wider gather tables; results scattered over
@@ -2537,16 +2662,17 @@ class Grid:
             return fn, tables, static_in
 
         axis, mesh, n_dev = self.axis, self.mesh, self.n_dev
+        slotwise = isinstance(kernel, SlotwiseKernel)
 
         def body(n_steps, nrows, noffs, nmask, *args):
             send_rs = [a[0] for a in args[: n_x * n_t]]
             recv_rs = [a[0] for a in args[n_x * n_t : 2 * n_x * n_t]]
             args = args[2 * n_x * n_t:]
             nrows = nrows[0]
+            row_gidx = None
             if synth is not None:
-                nmask = _synth_mask(
-                    synth, L,
-                    row_gidx=(nmask[0][:L] if synth[4] else None))
+                row_gidx = nmask[0][:L] if synth[4] else None
+                nmask = None  # synthesized on demand (dense) / per-slot
             else:
                 nmask = nmask[0]
             if use_roll:
@@ -2554,11 +2680,8 @@ class Grid:
                 wr, ws = wr[0], ws[0]
             if scaled:
                 sc, *args = args
-            if uniform_offs:
-                noffs = nmask[:, :, None] * noffs[None, :, :]
-                if scaled:
-                    noffs = noffs * sc[0][:, None, None]
-            else:
+                sc0 = sc[0]
+            if not uniform_offs:
                 noffs = noffs[0]
             if split:
                 hr, hnr, hof, hm, *args = args
@@ -2577,10 +2700,54 @@ class Grid:
                                          axis, n_dev)
                     fl = _halo_scatter(fl, recv_rs[xi * n_t + j], payload, R)
                 return fl.at[R - 1].set(0)
-            gather_nbr = _make_nbr_gather(
-                use_roll, r_shifts, L, nrows, nmask,
-                wr if use_roll else None, ws if use_roll else None,
-            )
+            if slotwise:
+                n_slots = len(r_shifts) if use_roll else nrows.shape[1]
+                if synth is not None:
+                    sgidx, sbase = _synth_prep(synth, L, row_gidx=row_gidx)
+                    mask_col = lambda j: _synth_col(synth, sgidx, sbase, j)
+
+                    def mask_rows(rows):
+                        g, b = sgidx[rows], sbase[rows]
+                        return jnp.stack(
+                            [_synth_col(synth, g, b, j)
+                             for j in range(n_slots)], axis=1)
+                else:
+                    mask_col = lambda j: nmask[:, j]
+                    mask_rows = lambda rows: nmask[rows]
+                slot_gather = _make_nbr_slot_gather(
+                    use_roll, r_shifts, L, nrows,
+                    wr if use_roll else None, ws if use_roll else None,
+                )
+
+                def offs_rows(rows, m):
+                    # dense offsets for a surface-sized row subset,
+                    # premasked like the dense path's uniform offsets
+                    if uniform_offs:
+                        o = m[:, :, None] * noffs[None, :, :]
+                        if scaled:
+                            o = o * sc0[rows][:, None, None]
+                        return o
+                    return noffs[rows]
+
+                def run_bulk(full, cell_fields, extra):
+                    return _run_slotwise(
+                        kernel, cell_fields,
+                        lambda j, mj: {n: slot_gather(full[n], j, mj)
+                                       for n in fields_in},
+                        _make_offs_col(uniform_offs, noffs,
+                                       sc0 if scaled else None),
+                        mask_col, n_slots, extra)
+            else:
+                if nmask is None:
+                    nmask = _synth_mask(synth, L, row_gidx=row_gidx)
+                if uniform_offs:
+                    noffs = nmask[:, :, None] * noffs[None, :, :]
+                    if scaled:
+                        noffs = noffs * sc0[:, None, None]
+                gather_nbr = _make_nbr_gather(
+                    use_roll, r_shifts, L, nrows, nmask,
+                    wr if use_roll else None, ws if use_roll else None,
+                )
 
             statics = {n: a[0] for n, a in zip(static_in, args[:n_static])}
             state0 = tuple(a[0] for a in args[n_static:n_static + n_out])
@@ -2609,9 +2776,13 @@ class Grid:
                     full = dict(statics)
                     full.update(zip(fields_out, state))
                     cell_fields = {n: full[n][:L] for n in fields_in}
-                    nbr_fields = {n: gather_nbr(full[n]) for n in fields_in}
-                    result = kernel(cell_fields, nbr_fields, noffs, nmask,
-                                    *extra)
+                    if slotwise:
+                        result = run_bulk(full, cell_fields, extra)
+                    else:
+                        nbr_fields = {n: gather_nbr(full[n])
+                                      for n in fields_in}
+                        result = kernel(cell_fields, nbr_fields, noffs,
+                                        nmask, *extra)
                     # land the halos, then redo just the outer rows
                     for xi, j in enumerate(exch_idx):
                         fl = state[j]
@@ -2622,7 +2793,7 @@ class Grid:
                     full = dict(statics)
                     full.update(zip(fields_out, state))
                     cell_fields = {n: full[n][:L] for n in fields_in}
-                    om = nmask[orc]
+                    om = mask_rows(orc) if slotwise else nmask[orc]
                     o_cell = {n: cell_fields[n][orc] for n in fields_in}
                     o_nbr = {}
                     for n in fields_in:
@@ -2634,7 +2805,8 @@ class Grid:
                             g = jnp.where(mexp, g,
                                           jnp.zeros((), g.dtype))
                         o_nbr[n] = g
-                    o_res = kernel(o_cell, o_nbr, noffs[orc], om, *extra)
+                    o_offs = offs_rows(orc, om) if slotwise else noffs[orc]
+                    o_res = kernel(o_cell, o_nbr, o_offs, om, *extra)
                     for n in fields_out:
                         result[n] = result[n].at[orow].set(
                             o_res[n].astype(result[n].dtype), mode="drop")
@@ -2645,9 +2817,13 @@ class Grid:
                     full = dict(statics)
                     full.update(zip(fields_out, state))
                     cell_fields = {n: full[n][:L] for n in fields_in}
-                    nbr_fields = {n: gather_nbr(full[n]) for n in fields_in}
-                    result = kernel(cell_fields, nbr_fields, noffs, nmask,
-                                    *extra)
+                    if slotwise:
+                        result = run_bulk(full, cell_fields, extra)
+                    else:
+                        nbr_fields = {n: gather_nbr(full[n])
+                                      for n in fields_in}
+                        result = kernel(cell_fields, nbr_fields, noffs,
+                                        nmask, *extra)
                 if split:
                     h_cell = {n: cell_fields[n][hrc] for n in fields_in}
                     h_nbr = {n: full[n][hnr] for n in fields_in}

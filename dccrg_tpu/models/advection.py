@@ -28,6 +28,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..dense import AXES, DenseGrid
+from ..grid import SlotwiseKernel
 
 HUMP_X0, HUMP_Y0, HUMP_RADIUS = 0.25, 0.5, 0.15
 
@@ -103,13 +104,18 @@ def make_uniform_flux_kernel(cell_length):
     inv = [1.0 / float(cell_length[d]) for d in range(3)]
     f32 = jnp.float32
 
-    def kernel(cell, nbr, offs, mask, dt):
-        rho_c = cell["density"].astype(f32)[:, None]
+    def init(cell, dt):
+        return jnp.zeros(cell["density"].shape, f32)
+
+    def slot(acc, cell, nbr, offs, mask, dt):
+        # one stencil leg: nbr[name] is [L], offs [3] or [L, 3] (raw,
+        # gated by mask), mask [L] — the SlotwiseKernel contract keeps
+        # peak HBM at O(cells); dense callers reach this through the
+        # __call__ adapter one slot at a time
+        rho_c = cell["density"].astype(f32)
         rho_n = nbr["density"].astype(f32)
-        acc = jnp.zeros_like(rho_n)
         for d, vname in ((0, "vx"), (1, "vy")):
-            v = 0.5 * (cell[vname].astype(f32)[:, None]
-                       + nbr[vname].astype(f32))
+            v = 0.5 * (cell[vname].astype(f32) + nbr[vname].astype(f32))
             up_pos = jnp.where(v >= 0, rho_c, rho_n)
             up_neg = jnp.where(v >= 0, rho_n, rho_c)
             face_pos = mask & (offs[..., d] == 1)
@@ -117,9 +123,12 @@ def make_uniform_flux_kernel(cell_length):
             m = v * (dt * inv[d])
             acc = acc - jnp.where(face_pos, up_pos * m, 0.0)
             acc = acc + jnp.where(face_neg, up_neg * m, 0.0)
-        return {"density": cell["density"].astype(f32) + jnp.sum(acc, axis=1)}
+        return acc
 
-    return kernel
+    def finish(acc, cell, dt):
+        return {"density": cell["density"].astype(f32) + acc}
+
+    return SlotwiseKernel(init, slot, finish)
 
 
 class GridAdvection:
