@@ -256,6 +256,32 @@ def _make_nbr_slot_gather(use_roll, r_shifts, L, nrows, wr, ws):
     return gather
 
 
+def _make_roll3d_gather(synth, L):
+    """Single-device closed-form slot gather: reshape the flat field to
+    the 3-D grid and ``jnp.roll`` — pure slices/concats, NO
+    scatter/gather ops. TPU executes dynamic scatters orders of
+    magnitude slower than shifts (the round-5 chip A/B at 128^3:
+    1.7e8 updates/s for roll-with-fixup-scatter vs 2.5e6/s for table
+    gathers, against a 7.6e10/s Pallas bound), so the flat roll plan's
+    wrap fixups are replaced by exact 3-D periodic rolls — rows ARE
+    grid order on single-device closed-form plans. Non-periodic wraps
+    carry junk and are zeroed through the slot mask, exactly like the
+    fixup path."""
+    (nx, ny, nz), _per, n0, offs_cells, *_ = synth
+
+    def gather(fl, j, mask_j):
+        ox, oy, oz = offs_cells[j]
+        g3 = fl[:n0].reshape((nz, ny, nx) + fl.shape[1:])
+        g3 = jnp.roll(g3, shift=(-oz, -oy, -ox), axis=(0, 1, 2))
+        col = g3.reshape((n0,) + fl.shape[1:])
+        if L > n0:
+            col = jnp.pad(col, [(0, L - n0)] + [(0, 0)] * (col.ndim - 1))
+        mexp = mask_j.reshape(mask_j.shape + (1,) * (col.ndim - 1))
+        return jnp.where(mexp, col, jnp.zeros((), col.dtype))
+
+    return gather
+
+
 def _make_offs_col(uniform_offs, noffs, sc0):
     """Per-slot offsets closure shared by the stencil bodies and the
     dense adapter: raw (NOT premasked — kernels gate on the mask),
@@ -268,15 +294,30 @@ def _make_offs_col(uniform_offs, noffs, sc0):
     return lambda j: noffs[:, j]
 
 
-def _run_slotwise(kernel, cell_fields, nbr_col, offs_col, mask_col,
+def _run_slotwise(kernel, cell_fields, fields, gather, offs_col, mask_col,
                   n_slots, extra):
     """The one slot loop every slot-wise call site shares:
-    init -> slot per stencil leg -> finish."""
+    init -> slot per stencil leg -> finish. ``fields`` maps name ->
+    backing array, ``gather(arr, j, mask_j)`` produces slot j's
+    neighbor column. Between slots the carry and the backing arrays
+    thread through ``optimization_barrier``: the per-slot gathers have
+    no data dependency on each other, so without the barrier XLA's
+    scheduler hoists ALL slots' rolls to the front and every column is
+    live at once — the O(L*S) residency slot-wise exists to prevent
+    (observed on chip: 512^3 still OOM'd by exactly that hoisting,
+    ~16 GB of roll temps at 50% fragmentation)."""
     carry = kernel.init(cell_fields, *extra)
+    names = list(fields)
+    vals = [fields[n] for n in names]
     for j in range(n_slots):
         mj = mask_col(j)
-        carry = kernel.slot(carry, cell_fields, nbr_col(j, mj),
-                            offs_col(j), mj, *extra)
+        nbr_j = {n: gather(v, j, mj) for n, v in zip(names, vals)}
+        carry = kernel.slot(carry, cell_fields, nbr_j, offs_col(j), mj,
+                            *extra)
+        if j + 1 < n_slots:
+            carry, vals_t = jax.lax.optimization_barrier(
+                (carry, tuple(vals)))
+            vals = list(vals_t)
     return kernel.finish(carry, cell_fields, *extra)
 
 
@@ -307,8 +348,8 @@ class SlotwiseKernel:
 
     def __call__(self, cell_fields, nbr_fields, offs, mask, *extra):
         return _run_slotwise(
-            self, cell_fields,
-            lambda j, mj: {n: v[:, j] for n, v in nbr_fields.items()},
+            self, cell_fields, nbr_fields,
+            lambda v, j, mj: v[:, j],
             (lambda j: offs[:, j]) if offs.ndim == 3 else
             (lambda j: offs[j]),
             lambda j: mask[..., j], mask.shape[-1], extra)
@@ -2471,14 +2512,16 @@ class Grid:
                 else:
                     mask_col = lambda j: nmask[:, j]
                 n_slots = len(r_shifts) if use_roll else nrows.shape[1]
-                slot_gather = _make_nbr_slot_gather(
-                    use_roll, r_shifts, L, nrows,
-                    wr if use_roll else None, ws if use_roll else None,
-                )
+                if synth is not None and not synth[4]:
+                    slot_gather = _make_roll3d_gather(synth, L)
+                else:
+                    slot_gather = _make_nbr_slot_gather(
+                        use_roll, r_shifts, L, nrows,
+                        wr if use_roll else None, ws if use_roll else None,
+                    )
                 result = _run_slotwise(
                     kernel, cell_fields,
-                    lambda j, mj: {n: slot_gather(f[0], j, mj)
-                                   for n, f in zip(fields_in, ins)},
+                    {n: f[0] for n, f in zip(fields_in, ins)}, slot_gather,
                     _make_offs_col(uniform_offs, noffs,
                                    sc0 if scaled else None),
                     mask_col, n_slots, extra)
@@ -2714,10 +2757,13 @@ class Grid:
                 else:
                     mask_col = lambda j: nmask[:, j]
                     mask_rows = lambda rows: nmask[rows]
-                slot_gather = _make_nbr_slot_gather(
-                    use_roll, r_shifts, L, nrows,
-                    wr if use_roll else None, ws if use_roll else None,
-                )
+                if synth is not None and not synth[4]:
+                    slot_gather = _make_roll3d_gather(synth, L)
+                else:
+                    slot_gather = _make_nbr_slot_gather(
+                        use_roll, r_shifts, L, nrows,
+                        wr if use_roll else None, ws if use_roll else None,
+                    )
 
                 def offs_rows(rows, m):
                     # dense offsets for a surface-sized row subset,
@@ -2732,8 +2778,7 @@ class Grid:
                 def run_bulk(full, cell_fields, extra):
                     return _run_slotwise(
                         kernel, cell_fields,
-                        lambda j, mj: {n: slot_gather(full[n], j, mj)
-                                       for n in fields_in},
+                        {n: full[n] for n in fields_in}, slot_gather,
                         _make_offs_col(uniform_offs, noffs,
                                        sc0 if scaled else None),
                         mask_col, n_slots, extra)

@@ -170,11 +170,18 @@ def make_rotation_step(
         x0, _z0 = tile_indices(n)
         x0 = pl.multiple_of(x0, tx)
         dt = dt_ref[0]
-        # fold dt/dlen into the 1-D velocity vectors once per pass
-        cx = vxf_ref[0, :].reshape(1, Y, 1) * (dt * rdx)
+        # fold dt/dlen into the 1-D velocity vectors once per pass;
+        # the minor-dim-inserting reshapes run in float32 (Mosaic only
+        # supports them for 32-bit types) and cast straight back, so
+        # everything downstream stays in the storage dtype
+        f32 = jnp.float32
+        cx = (vxf_ref[0, :].astype(f32).reshape(1, Y, 1)
+              * (dt.astype(f32) * rdx)).astype(dtype)
         # extended vy: index i of vyf_ref holds vy[(i - 8) % X], so the
         # slice at x0 (sublane-aligned) covers global rows x0-8..x0+tx+7
-        cy_wide = vyf_ref[pl.ds(x0, tx + 16), 0].reshape(tx + 16, 1, 1) * (dt * rdy)
+        cy_wide = (vyf_ref[pl.ds(x0, tx + 16), 0].astype(f32)
+                   .reshape(tx + 16, 1, 1)
+                   * (dt.astype(f32) * rdy)).astype(dtype)
 
         s = body[slot]  # rows cover global [x0 - H, x0 + tx + H)
         for k in range(sp):

@@ -170,6 +170,44 @@ def test_grid_advection_physics_on_slotwise_path():
     assert adv.l2_error() < 0.2
 
 
+def test_single_device_closed_form_roll3d_matches_dense(monkeypatch):
+    """On a single-device closed-form plan the slot gather lowers to
+    exact 3-D rolls (no fixup scatter); results must stay bitwise equal
+    to the dense kernel across periodic and walled dimensions."""
+    import jax
+
+    monkeypatch.setenv("DCCRG_ROLL_STENCIL", "1")
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dev",))
+    g = (
+        Grid(cell_data={"v": jnp.float32, "w": jnp.float32})
+        .set_initial_length((6, 5, 4))
+        .set_periodic(True, False, True)
+        .set_maximum_refinement_level(0)
+        .set_neighborhood_length(1)
+        .initialize(mesh, partition="block")
+    )
+    hood = g.plan.hoods[DEFAULT_NEIGHBORHOOD_ID]
+    assert hood.closed_form is not None and not hood.closed_form.get(
+        "multi")
+    cells = g.plan.cells
+    rng = np.random.default_rng(5)
+    g.set("v", cells, rng.integers(0, 64, len(cells)).astype(np.float32))
+    g.set("w", cells, rng.integers(0, 64, len(cells)).astype(np.float32))
+    v0 = g.get("v", cells).copy()
+    g.apply_stencil(_dense_kern, ["v", "w"], ["v"])
+    want = g.get("v", cells).copy()
+    g.run_steps(_dense_kern, ["v", "w"], ["v"], 2)
+    want2 = g.get("v", cells).copy()
+
+    g.set("v", cells, v0)
+    g.apply_stencil(_slot_kern(), ["v", "w"], ["v"])
+    np.testing.assert_array_equal(g.get("v", cells), want)
+    g.run_steps(_slot_kern(), ["v", "w"], ["v"], 2)
+    np.testing.assert_array_equal(g.get("v", cells), want2)
+
+
 def test_slotwise_include_to_raises(monkeypatch):
     g = _mk(monkeypatch, roll=False)
     with pytest.raises(ValueError, match="include_to"):
