@@ -4,6 +4,11 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
+``value`` is the FRAMEWORK (grid-path) throughput and is null when
+that leg fails — the specialized Pallas kernel bound is published
+separately under ``pallas_metric`` / ``pallas_updates_per_sec`` and is
+never substituted into the headline.
+
 Workload: the reference's north-star configuration (BASELINE.json) —
 tests/advection 3-D 512^3 uniform grid (max_refinement_level 0),
 first-order upwind solid-body rotation — on the real TPU chip via the
@@ -274,23 +279,24 @@ def ab_overlap():
 
 
 def probe_backend(timeout_s: int = 150) -> bool:
-    """Check in a SUBPROCESS that the accelerator backend actually
-    answers: a hung device tunnel would otherwise hang the whole bench
-    without emitting the JSON line the driver records.
-    ``BENCH_PLATFORM=cpu`` targets the CPU backend instead (validation
-    runs when no chip is reachable; the image's site hook pre-sets
-    JAX_PLATFORMS=axon, so the override must go through jax.config)."""
-    plat = os.environ.get("BENCH_PLATFORM", "")
-    cfg = (f"import jax; jax.config.update('jax_platforms', {plat!r}); "
-           if plat else "import jax; ")
+    """Check that the accelerator backend actually answers before any
+    in-process jax.devices() call: a hung device tunnel would otherwise
+    hang the whole bench without emitting the JSON line the driver
+    records. Routed through resilience.safe_devices — a subprocess
+    probe with hard-kill timeout escalation and bounded retries (the
+    axon client is known to survive SIGTERM). ``BENCH_PLATFORM=cpu``
+    targets the CPU backend instead (validation runs when no chip is
+    reachable; the image's site hook pre-sets JAX_PLATFORMS=axon, so
+    the override must go through jax.config)."""
+    from dccrg_tpu.resilience import DeviceProbeError, safe_devices
+
+    plat = os.environ.get("BENCH_PLATFORM", "") or None
     try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             cfg + "print(jax.devices()[0].platform)"],
-            timeout=timeout_s, capture_output=True, text=True,
-        )
-        return out.returncode == 0
-    except subprocess.TimeoutExpired:
+        safe_devices(timeout=timeout_s, retries=1, backoff=2.0,
+                     platform=plat)
+        return True
+    except DeviceProbeError as e:
+        print(f"device probe failed: {e}", file=sys.stderr)
         return False
 
 
@@ -305,9 +311,9 @@ def main() -> None:
         print(json.dumps({
             "metric": (f"grid-path advection 3D {GRID_N}^3 "
                        "cell-updates/sec/chip"),
-            "value": 0,
+            "value": None,
             "unit": "cell-updates/s",
-            "vs_baseline": 0,
+            "vs_baseline": None,
             "error": "TPU backend unreachable (device probe timed out)",
         }))
         return
@@ -368,16 +374,19 @@ def main() -> None:
 
     # headline value = the FRAMEWORK (general Grid runtime) throughput
     # at the north-star size; the Pallas figure is the specialized
-    # single-kernel bound, reported separately (round-3 verdict item 1)
-    value = grid_ups if grid_ups is not None else (pallas_ups or 0)
+    # single-kernel bound, published under its OWN metric name — when
+    # the grid leg fails the headline is null, never the Pallas bound
+    # (round-5 advisor item: a 7.6e10 'grid-path' value measured on the
+    # specialized kernel misleads downstream consumers)
     print(
         json.dumps(
             {
                 "metric": (f"grid-path advection 3D {GRID_N}^3 "
                            "cell-updates/sec/chip"),
-                "value": value,
+                "value": grid_ups,
                 "unit": "cell-updates/s",
-                "vs_baseline": value / baseline,
+                "vs_baseline": (grid_ups / baseline
+                                if grid_ups is not None else None),
                 "grid_path_updates_per_sec": grid_ups,
                 "grid_path_size": f"{GRID_N}^3",
                 "grid_path_vs_baseline": (grid_ups / baseline
@@ -391,7 +400,11 @@ def main() -> None:
                 "ab_overlap_updates_per_sec": ab_ovl,
                 "bf16_updates_per_sec": bf16_ups,
                 "bf16_l2_error": bf16_l2,
+                "pallas_metric": (f"pallas-kernel advection 3D {N}^2x{NZ} "
+                                  "cell-updates/sec/chip"),
                 "pallas_updates_per_sec": pallas_ups,
+                "pallas_vs_baseline": (pallas_ups / baseline
+                                       if pallas_ups is not None else None),
                 "pallas_l2_error": pallas_l2,
                 "pallas_note": ("specialized temporal-blocked kernel bound, "
                                 f"{N}^2x{NZ} {pallas_dt}"
@@ -402,7 +415,8 @@ def main() -> None:
                                   "MPI scaling (bench/baseline_measured"
                                   ".json has the raw measurement)"),
                 "error": (None if grid_ups is not None else
-                          ("grid path failed; value is the Pallas bound"
+                          ("grid path failed; the specialized-kernel "
+                           "bound is under pallas_metric"
                            if pallas_ups is not None
                            else "grid path AND pallas legs failed")),
             }

@@ -49,9 +49,12 @@ def main():
                     "via XLA_FLAGS still applies)")
     args = ap.parse_args()
 
-    import jax
-    if args.cpu:
-        jax.config.update("jax_platforms", "cpu")
+    # device discovery through the hang-proof probe: a dead axon
+    # tunnel fails fast instead of wedging the A/B
+    from dccrg_tpu.resilience import safe_devices
+
+    devices = safe_devices(timeout=120, retries=1,
+                           platform="cpu" if args.cpu else None)
 
     ups = {}
     l2 = {}
@@ -61,8 +64,8 @@ def main():
               file=sys.stderr)
     print(json.dumps({
         "metric": f"overlap A/B grid advection {args.n}^3",
-        "platform": jax.devices()[0].platform,
-        "n_devices": len(jax.devices()),
+        "platform": devices[0].platform,
+        "n_devices": len(devices),
         "sequential_updates_per_sec": ups["sequential"],
         "overlap_updates_per_sec": ups["overlap"],
         "overlap_speedup": ups["overlap"] / ups["sequential"],

@@ -22,12 +22,13 @@ def main():
     ap.add_argument("--iters", type=int, default=30)
     args = ap.parse_args()
 
-    import jax
+    # device discovery through the hang-proof probe: a dead axon
+    # tunnel fails fast instead of wedging the bench
+    from dccrg_tpu.resilience import safe_devices
 
-    plat = os.environ.get("BENCH_PLATFORM")
-    if plat:
-        jax.config.update("jax_platforms", plat)
-    on_tpu = jax.devices()[0].platform == "tpu"
+    devices = safe_devices(timeout=120, retries=1,
+                           platform=os.environ.get("BENCH_PLATFORM") or None)
+    on_tpu = devices[0].platform == "tpu"
 
     import numpy as np
     import jax.numpy as jnp
@@ -47,7 +48,7 @@ def main():
         arrays = {"p": x, "Ap": x}
         return dense._matvec(arrays)["Ap"]
 
-    results = {"size": f"{n}^3", "platform": jax.devices()[0].platform}
+    results = {"size": f"{n}^3", "platform": devices[0].platform}
     float(jnp.sum(p))  # pre-compile the sync reduction OUTSIDE timing
     for name, mv in (("pallas", mv_pallas), ("xla_dense", dense_mv)):
         out = mv(p)

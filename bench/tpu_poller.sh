@@ -1,15 +1,16 @@
 #!/bin/bash
 # Polls the axon TPU tunnel. Appends one line per probe to /tmp/tpu_poll.log;
 # writes /tmp/tpu_up when a probe succeeds, then keeps polling (so a flap is visible).
+#
+# The probe runs through `python -m dccrg_tpu.resilience` (subprocess
+# probe with hard-kill timeout escalation — the axon client is known to
+# survive SIGTERM) with `timeout -k 5` as an outer belt, so a wedged
+# tunnel can never wedge the poller.
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
 while true; do
   ts=$(date +%s)
-  out=$(timeout -k 5 90 python - <<'EOF' 2>&1
-import jax
-devs = jax.devices()
-print("OK", devs)
-EOF
-)
-  if [[ "$out" == OK* ]]; then
+  out=$(cd "$REPO" && timeout -k 5 120 python -m dccrg_tpu.resilience --timeout 90 2>&1)
+  if echo "$out" | grep -q '^OK'; then
     echo "$ts UP $out" >> /tmp/tpu_poll.log
     echo "$ts" > /tmp/tpu_up
     # first contact: fire the full measurement battery once, so even

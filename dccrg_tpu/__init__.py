@@ -11,7 +11,10 @@ reference dccrg library (header-only C++/MPI/Zoltan; see SURVEY.md):
   ``lax.all_to_all``) under ``shard_map``,
 - adaptive mesh refinement and load balancing as host-side replanning
   events,
-- parallel checkpoint/restart.
+- parallel checkpoint/restart,
+- a resilience layer (checksummed atomic checkpoints, a numerics
+  watchdog with auto-rollback, OOM-aware gather-mode fallback and
+  hang-proof device probing) with deterministic fault injection.
 
 Reference: /root/reference (dccrg.hpp and friends). This package is a
 re-design for TPU, not a translation: structure (cell lists, neighbor
@@ -29,6 +32,11 @@ from .grid import (DEFAULT_NEIGHBORHOOD_ID, Grid, SlotwiseKernel,
                    default_mesh)
 from .dense import DenseGrid, dense_mesh
 from .verify import VerificationError, verify_all
+from .faults import FaultPlan
+from .resilience import (CheckpointCorruptionError, DeviceProbeError,
+                         NumericsError, ResilienceExhaustedError,
+                         ResilientRunner, guarded_step, load_checkpoint,
+                         save_checkpoint, safe_devices)
 
 __version__ = "0.1.0"
 
@@ -49,4 +57,14 @@ __all__ = [
     "dense_mesh",
     "VerificationError",
     "verify_all",
+    "FaultPlan",
+    "CheckpointCorruptionError",
+    "DeviceProbeError",
+    "NumericsError",
+    "ResilienceExhaustedError",
+    "ResilientRunner",
+    "guarded_step",
+    "load_checkpoint",
+    "save_checkpoint",
+    "safe_devices",
 ]

@@ -52,8 +52,15 @@ import struct
 
 import numpy as np
 
+from . import faults
+
 ENDIAN_MAGIC = 0x1234567890ABCDEF
 CHUNK = 1 << 19  # cells per streamed payload chunk
+
+# Integrity wrappers live in resilience.py: save_checkpoint writes
+# these same bytes atomically (temp + fsync + rename) plus a CRC32
+# sidecar <file>.crc; load_checkpoint verifies it. The .dc byte layout
+# here stays pinned by the golden-file tests either way.
 
 
 def _payload_spec_of(fields, variable=None):
@@ -305,6 +312,10 @@ def save_grid_data(grid, filename: str, header: bytes = b"",
                                starts[i + 1], fixed_spec, fixed_bytes,
                                var_spec)
                    if i + 1 < len(starts) else None)
+            # fault-injection site: a mid-stream write failure leaves a
+            # torn file — resilience.save_checkpoint's atomic rename
+            # guarantees it never carries the final checkpoint name
+            faults.fire("checkpoint.chunk", chunk=i, path=filename)
             f.write(buf)
 
 

@@ -12,6 +12,8 @@ tests/poisson/poisson_solve.hpp:278-360, use psum the same way).
 
 - ``all_gather``  — All_Gather (dccrg_mpi_support.hpp:101-234)
 - ``all_reduce``  — All_Reduce, sum (dccrg_mpi_support.hpp:240-269)
+- ``all_finite``  — the resilience watchdog's probe: fused per-device
+  ``all(isfinite)`` + min all-reduce, one scalar to the host
 - ``some_reduce`` — Some_Reduce: reduce contributions only from a
   device's peer set (dccrg_mpi_support.hpp:285-380, which reduces
   values from neighbor processes via point-to-point messages; on TPU
@@ -27,10 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from .compat import shard_map as _shard_map
 
 
 def all_gather(x, axis_name: str):
@@ -47,6 +46,19 @@ def all_reduce(x, axis_name: str, op: str = "sum"):
     if op == "min":
         return lax.pmin(x, axis_name)
     raise ValueError(f"unknown reduction {op!r}")
+
+
+def all_finite(xs, axis_name: str):
+    """Watchdog reduction: 1 iff every element of every array in
+    ``xs`` on every device is finite. Each device fuses its local
+    ``all(isfinite)`` over the list, then one min all-reduce crosses
+    the mesh — so the resilience watchdog (resilience.check_finite)
+    pulls a single scalar to the host no matter how many fields it
+    guards."""
+    ok = jnp.ones((), jnp.int32)
+    for x in xs:
+        ok = ok * jnp.all(jnp.isfinite(x)).astype(jnp.int32)
+    return all_reduce(ok, axis_name, "min")
 
 
 def some_reduce(x, peer_mask, axis_name: str):

@@ -1,0 +1,55 @@
+"""Version-compatibility shims for jax API drift.
+
+``shard_map`` has moved twice (experimental -> top level) and renamed
+its replication-check flag (``check_rep`` -> ``check_vma``). The
+callers in this package write the newest spelling; this shim adapts it
+to whatever the installed jax accepts, so a container pinned to an
+older jax runs the same code instead of failing every sharded program
+at trace time.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.5 exposes shard_map at top level
+    from jax import shard_map as _raw_shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _raw_shard_map
+
+_PARAMS = inspect.signature(_raw_shard_map).parameters
+
+if "check_vma" in _PARAMS:
+    shard_map = _raw_shard_map
+else:
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=None, **kw):
+        if check_vma is not None and "check_rep" in _PARAMS:
+            kw["check_rep"] = check_vma
+        return _raw_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+
+def pallas_compiler_params(**kw):
+    """TPU Pallas compiler params under either spelling:
+    ``pltpu.CompilerParams`` (newer jax) or ``pltpu.TPUCompilerParams``
+    (older releases)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
+def pallas_interpret_mode(interpret: bool):
+    """The value ``pl.pallas_call(..., interpret=...)`` wants for TPU
+    interpret mode: newer jax models it as ``pltpu.InterpretParams()``;
+    older releases take the plain boolean. False either way when not
+    interpreting."""
+    if not interpret:
+        return False
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pltpu.InterpretParams()
+    except AttributeError:
+        return True

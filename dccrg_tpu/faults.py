@@ -1,0 +1,289 @@
+"""Deterministic fault injection for the resilience layer.
+
+Production grids die in ways unit asserts never exercise: a node loss
+mid-checkpoint leaves a torn file, a flaky disk flips a payload bit, a
+too-large dispatch hits XLA ``RESOURCE_EXHAUSTED``, a probe into a dead
+device tunnel hangs forever, and a numerical blow-up writes NaN into a
+field with nobody watching. This module makes every one of those
+failures reproducible on demand so the recovery paths in
+:mod:`dccrg_tpu.resilience` are *tested*, not hoped for.
+
+A :class:`FaultPlan` is a seedable, deterministic schedule of faults,
+installed as the process-wide active plan via context manager::
+
+    plan = FaultPlan(seed=7)
+    plan.io_error(times=1)                   # first checkpoint write fails
+    plan.nan_poison("density", step=13)      # NaN lands after step 13
+    plan.resource_exhausted(times=1)         # first step dispatch OOMs
+    with plan:
+        runner.run(50)
+    assert plan.fired("step.poison")
+
+Instrumented call sites (in resilience.py / checkpoint.py) consult the
+active plan through the module hooks:
+
+- :func:`fire` — raise a scheduled exception at a named site
+  (``checkpoint.write`` transient I/O errors, ``checkpoint.chunk``
+  mid-stream write failures, ``step.dispatch`` simulated
+  ``RESOURCE_EXHAUSTED``, ``device.probe`` hung-probe timeouts).
+- :func:`corrupt_file` — mutate a file that was just written
+  (truncation / torn tail, single bit flips), simulating post-write
+  disk corruption the CRC sidecar must catch.
+- :func:`poison_step` — write NaN into a field after a given step,
+  the silent numerics failure the watchdog must trip on.
+
+When no plan is installed every hook is a no-op, so the hooks cost one
+``is None`` check on hot paths. All randomness (which byte to flip)
+comes from the plan's seeded generator — two runs with the same seed
+inject byte-identical faults. The standalone helpers
+(:func:`flip_bit`, :func:`truncate_file`) are also used directly by
+the checkpoint-integrity tests.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+class SimulatedResourceExhausted(RuntimeError):
+    """Injected stand-in for an XLA device OOM. The message carries the
+    literal ``RESOURCE_EXHAUSTED`` marker so handlers that match real
+    XlaRuntimeError text treat both identically."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected device OOM {detail}".rstrip()
+        )
+
+
+class InjectedIOError(OSError):
+    """Injected transient I/O failure (checkpoint writes)."""
+
+
+class InjectedProbeHang(TimeoutError):
+    """Injected device-probe timeout (a dead accelerator tunnel)."""
+
+
+@dataclass
+class _Rule:
+    site: str
+    kind: str
+    times: float  # math.inf = every time
+    params: dict = field(default_factory=dict)
+    fired: int = 0
+
+    def matches(self, site: str, ctx: dict) -> bool:
+        if self.site != site or self.fired >= self.times:
+            return False
+        for key in ("mode", "step"):
+            want = self.params.get(key)
+            if want is not None and ctx.get(key) != want:
+                return False
+        return True
+
+
+_active: "FaultPlan | None" = None
+
+
+class FaultPlan:
+    """A deterministic, seedable schedule of injected faults.
+
+    Rules are added with the ``*_`` convenience methods below and fire
+    at the instrumented sites while the plan is installed (``with
+    plan:``). Each rule fires at most ``times`` times (default once);
+    ``times=math.inf`` fires forever. ``plan.log`` records every
+    firing as ``(site, kind, detail)`` for test assertions."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = np.random.default_rng(seed)
+        self.rules: list[_Rule] = []
+        self.log: list[tuple[str, str, dict]] = []
+
+    # -- schedule builders --------------------------------------------
+
+    def _add(self, site, kind, times, **params):
+        self.rules.append(_Rule(site, kind, times, params))
+        return self
+
+    def io_error(self, times=1, site="checkpoint.write"):
+        """Transient I/O error during a checkpoint write (before the
+        atomic rename — the previous checkpoint must survive)."""
+        return self._add(site, "io", times)
+
+    def chunk_io_error(self, times=1):
+        """I/O error mid payload stream (a torn temp file)."""
+        return self._add("checkpoint.chunk", "io", times)
+
+    def truncate(self, times=1, drop_bytes=None):
+        """Truncate a just-written checkpoint file (torn/partial
+        write reaching the final name). ``drop_bytes=None`` drops a
+        seeded random amount of the tail."""
+        return self._add("checkpoint.file", "truncate", times,
+                         drop_bytes=drop_bytes)
+
+    def bit_flip(self, times=1, byte_index=None, bit=None):
+        """Flip one bit of a just-written checkpoint file (silent disk
+        corruption). Position defaults to a seeded random payload
+        byte."""
+        return self._add("checkpoint.file", "bitflip", times,
+                         byte_index=byte_index, bit=bit)
+
+    def resource_exhausted(self, times=1, mode=None):
+        """Simulated XLA RESOURCE_EXHAUSTED at step dispatch. With
+        ``mode`` the rule fires only for that gather mode (e.g. only
+        the dense path OOMs; the slot-wise fallback fits)."""
+        return self._add("step.dispatch", "oom", times, mode=mode)
+
+    def nan_poison(self, fld, step, cells=None, value=float("nan"),
+                   times=1):
+        """Write ``value`` into ``fld`` for ``cells`` (default: one
+        seeded local cell) after step ``step`` completes. ``times > 1``
+        re-poisons on every replay of that step (a deterministic
+        blow-up the rollback cannot outrun — the retry-bound test)."""
+        return self._add("step.poison", "nan", times, field=fld, step=step,
+                         cells=cells, value=value)
+
+    def probe_hang(self, times=1):
+        """Device probe times out (dead accelerator tunnel)."""
+        return self._add("device.probe", "hang", times)
+
+    # -- installation -------------------------------------------------
+
+    def __enter__(self):
+        global _active
+        if _active is not None:
+            raise RuntimeError("a FaultPlan is already active")
+        _active = self
+        return self
+
+    def __exit__(self, *exc):
+        global _active
+        _active = None
+        return False
+
+    def fired(self, site: str) -> int:
+        """How many injections have fired at ``site``."""
+        return sum(1 for s, _k, _d in self.log if s == site)
+
+    # -- firing (internal) --------------------------------------------
+
+    def _take(self, site: str, ctx: dict) -> "_Rule | None":
+        for r in self.rules:
+            if r.matches(site, ctx):
+                r.fired += 1
+                return r
+        return None
+
+
+def active() -> "FaultPlan | None":
+    return _active
+
+
+def fire(site: str, **ctx) -> None:
+    """Raise the scheduled exception for ``site``, if any. Called from
+    the instrumented sites; no-op without an active plan."""
+    plan = _active
+    if plan is None:
+        return
+    rule = plan._take(site, ctx)
+    if rule is None:
+        return
+    plan.log.append((site, rule.kind, dict(ctx)))
+    if rule.kind == "io":
+        raise InjectedIOError(f"injected I/O error at {site}")
+    if rule.kind == "oom":
+        raise SimulatedResourceExhausted(f"at {site} {ctx}")
+    if rule.kind == "hang":
+        raise InjectedProbeHang(f"injected probe timeout at {site}")
+    raise AssertionError(f"rule kind {rule.kind!r} cannot fire at {site}")
+
+
+def corrupt_file(path: str) -> list:
+    """Apply scheduled file corruptions (truncate / bit flips) to a
+    just-written file; returns what was applied. Called after the
+    atomic save (file AND sidecar complete), simulating corruption at
+    rest — exactly what the CRC verification exists to catch."""
+    plan = _active
+    applied = []
+    if plan is None:
+        return applied
+    while True:
+        rule = plan._take("checkpoint.file", {"path": path})
+        if rule is None:
+            return applied
+        size = os.path.getsize(path)
+        if rule.kind == "truncate":
+            drop = rule.params.get("drop_bytes")
+            if drop is None:
+                drop = int(plan.rng.integers(1, max(2, size // 4)))
+            detail = {"path": path, "drop_bytes": drop}
+            truncate_file(path, drop)
+        elif rule.kind == "bitflip":
+            byte = rule.params.get("byte_index")
+            if byte is None:
+                byte = int(plan.rng.integers(0, size))
+            bit = rule.params.get("bit")
+            if bit is None:
+                bit = int(plan.rng.integers(0, 8))
+            detail = {"path": path, "byte_index": byte, "bit": bit}
+            flip_bit(path, byte, bit)
+        else:
+            raise AssertionError(f"rule kind {rule.kind!r} is not a "
+                                 "file corruption")
+        plan.log.append(("checkpoint.file", rule.kind, detail))
+        applied.append((rule.kind, detail))
+
+
+def poison_step(grid, step: int) -> list:
+    """Apply scheduled NaN poisonings for ``step`` to ``grid``'s
+    fields; returns the poisoned (field, cells) pairs. Each matching
+    rule fires at most ONCE per call (= per visit of the step), so a
+    rule with ``times=k`` re-poisons the first k replays."""
+    plan = _active
+    applied = []
+    if plan is None:
+        return applied
+    ctx = {"step": step}
+    for rule in [r for r in plan.rules if r.matches("step.poison", ctx)]:
+        rule.fired += 1
+        name = rule.params["field"]
+        cells = rule.params["cells"]
+        if cells is None:
+            local = np.asarray(grid.get_cells())
+            pick = int(plan.rng.integers(0, len(local)))
+            cells = np.asarray([local[pick]], dtype=np.uint64)
+        cells = np.atleast_1d(np.asarray(cells, dtype=np.uint64))
+        shape, dtype = grid.fields[name]
+        vals = np.full((len(cells),) + shape, rule.params["value"],
+                       dtype=dtype)
+        grid.set(name, cells, vals)
+        plan.log.append(("step.poison", "nan",
+                         {"step": step, "field": name,
+                          "cells": cells.tolist()}))
+        applied.append((name, cells))
+    return applied
+
+
+# -- standalone corruption helpers (also used directly by tests) ------
+
+def flip_bit(path: str, byte_index: int, bit: int = 0) -> None:
+    """Flip one bit of ``path`` in place."""
+    with open(path, "r+b") as f:
+        f.seek(byte_index)
+        (b,) = f.read(1)
+        f.seek(byte_index)
+        f.write(bytes([b ^ (1 << bit)]))
+
+
+def truncate_file(path: str, drop_bytes: int) -> None:
+    """Drop the last ``drop_bytes`` bytes of ``path``."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(0, size - int(drop_bytes)))
+
+
+EVERY = math.inf  # times=EVERY: the rule never exhausts

@@ -35,10 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.5 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from .compat import shard_map as _shard_map
 
 from .geometry import CartesianGeometry, NoGeometry, StretchedCartesianGeometry
 from .mapping import Mapping
@@ -239,8 +236,10 @@ def _make_nbr_gather(use_roll, r_shifts, L, nrows, nmask, wr, ws):
 def _make_nbr_slot_gather(use_roll, r_shifts, L, nrows, wr, ws):
     """Column-``j`` neighbor gather for slot-wise stencils:
     ``gather(fl, j, mask_j) -> [L, ...]``, one stencil slot at a time,
-    so the [L, S] neighbor stack (and its O(L*S) HBM residency —
-    the 512^3 OOM) is never materialized. Roll mode zeroes masked
+    so the [L, S] neighbor stack (whose O(L*S) HBM residency drove the
+    512^3 OOM) is never materialized as a single array — though the
+    scheduler can still co-locate several slot temporaries; see
+    _run_slotwise. Roll mode zeroes masked
     slots (the rolled values there are junk); table mode returns the
     raw gather like the dense table path (masked slots point at
     zeroed pad rows; kernels gate on the mask either way)."""
@@ -303,9 +302,16 @@ def _run_slotwise(kernel, cell_fields, fields, gather, offs_col, mask_col,
     thread through ``optimization_barrier``: the per-slot gathers have
     no data dependency on each other, so without the barrier XLA's
     scheduler hoists ALL slots' rolls to the front and every column is
-    live at once — the O(L*S) residency slot-wise exists to prevent
-    (observed on chip: 512^3 still OOM'd by exactly that hoisting,
-    ~16 GB of roll temps at 50% fragmentation)."""
+    live at once. NOTE the barrier is necessary but — per the measured
+    chip artifact (bench/chip_results/bench_main_slotwise.out) — not
+    sufficient at the largest sizes: the 512^3 roll-mode run still
+    kept ~9 co-resident 512 MB roll temps and OOM'd (~0.3 GB over a
+    16 GB budget at 50% fragmentation). Peak HBM is REDUCED versus the
+    dense [L, S] contract, not hard-bounded at O(cells); forcing full
+    sequencing (lax.scan over slots / donated carry) is the open
+    follow-up if 512^3-on-one-chip matters. On an OOM at dispatch the
+    resilience layer (resilience.guarded_step) degrades to the next
+    gather mode instead of crashing the run."""
     carry = kernel.init(cell_fields, *extra)
     names = list(fields)
     vals = [fields[n] for n in names]
@@ -323,9 +329,13 @@ def _run_slotwise(kernel, cell_fields, fields, gather, offs_col, mask_col,
 
 class SlotwiseKernel:
     """Memory-lean stencil kernel: the bulk pass feeds it one neighbor
-    slot (stencil leg) at a time, so peak HBM is O(cells) instead of
-    the dense contract's O(cells * slots) — the difference between
-    fitting 512^3 in a single chip's HBM or not. Three callables:
+    slot (stencil leg) at a time, avoiding the dense contract's
+    O(cells * slots) neighbor stack. Measured effect on chip
+    (bench/chip_results/bench_main_slotwise.out): peak HBM drops
+    substantially, but XLA's scheduler still co-locates several slot
+    temporaries, so 512^3 remained slightly over a single chip's HBM
+    budget in roll mode — treat this as *reduced*, not O(cells), peak
+    HBM until a passing 512^3 run exists. Three callables:
 
     - ``init(cell_fields, *extra) -> carry``
     - ``slot(carry, cell_fields, nbr_j, offs_j, mask_j, *extra) ->
@@ -869,6 +879,9 @@ class Grid:
         reference's initialize_neighbors + update_remote_neighbor_info +
         recalculate_neighbor_update_send_receive_lists +
         update_cell_pointers pipeline (dccrg.hpp:8371-8420)."""
+        # any rebuild invalidates a gather mode forced by the OOM
+        # fallback (resilience._apply_mode re-pins and re-marks it)
+        self._plan_gather_mode = None
         self._build_plan_impl(cells, owner)
         # the builder's large temporaries are dead only once the impl
         # frame is gone; trim here so malloc_trim can actually return
@@ -2930,6 +2943,42 @@ class Grid:
         )
         for n, arr in zip(fields_out, out):
             self.data[n] = arr
+        # DCCRG_WATCHDOG=N: self-check the stepped fields for NaN/Inf
+        # every ~N steps (one device-side scalar; see resilience.py) —
+        # a silent blow-up surfaces as NumericsError instead of
+        # garbage physics hours later
+        from . import resilience
+
+        wd = resilience.watchdog_interval()
+        if wd > 0:
+            self._watchdog_accum = getattr(self, "_watchdog_accum", 0) \
+                + int(n_steps)
+            if self._watchdog_accum >= wd:
+                self._watchdog_accum = 0
+                resilience.assert_finite(self, fields_out)
+
+    def run_steps_guarded(
+        self,
+        kernel,
+        fields_in,
+        fields_out,
+        n_steps,
+        exchange_fields=None,
+        neighborhood_id=DEFAULT_NEIGHBORHOOD_ID,
+        extra_args=(),
+    ) -> str:
+        """:meth:`run_steps` with graceful OOM degradation: on XLA
+        ``RESOURCE_EXHAUSTED`` the dispatch walks the gather-mode
+        fallback chain (current -> slot-wise roll -> dense tables),
+        logging each downgrade. Returns the mode that completed
+        (see resilience.guarded_step)."""
+        from . import resilience
+
+        return resilience.guarded_step(
+            self, kernel, fields_in, fields_out, n_steps,
+            exchange_fields=exchange_fields,
+            neighborhood_id=neighborhood_id, extra_args=extra_args,
+        )
 
     # -- load balancing (dccrg.hpp:1046-1064, 3770-4182, 8482-8720) ----
 
@@ -3567,6 +3616,30 @@ class Grid:
 
         return load_grid(filename, cell_data, mesh=mesh,
                          header_size=header_size, variable=variable)
+
+    def save_checkpoint(self, filename: str, header: bytes = b"",
+                        variable=None) -> str:
+        """Atomic, checksummed checkpoint: the pinned ``.dc`` bytes
+        (identical to :meth:`save_grid_data`) written via temp file +
+        fsync + rename, with a per-chunk CRC32 sidecar ``<file>.crc``
+        (see resilience.save_checkpoint)."""
+        from . import resilience
+
+        return resilience.save_checkpoint(self, filename, header=header,
+                                          variable=variable)
+
+    @classmethod
+    def load_checkpoint(cls, filename: str, cell_data, mesh: Mesh | None = None,
+                        header_size: int = 0, variable=None,
+                        strict: bool = True):
+        """Restart from a checkpoint with integrity verification:
+        ``(grid, header, report)``; corrupt chunks raise (strict) or
+        are salvaged (see resilience.load_checkpoint)."""
+        from . import resilience
+
+        return resilience.load_checkpoint(
+            filename, cell_data, mesh=mesh, header_size=header_size,
+            variable=variable, strict=strict)
 
     # -- misc parity ---------------------------------------------------
 

@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..compat import pallas_compiler_params, pallas_interpret_mode
+
 
 def make_rotation_step(
     shape, dtype=jnp.float32, tile=(8, 128), cell_length=None, steps_per_pass=1,
@@ -213,9 +215,9 @@ def make_rotation_step(
     call = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=pallas_interpret_mode(interpret),
         out_shape=jax.ShapeDtypeStruct((X, Y, Z), dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             # deep temporal blocking holds several flux temporaries live;
             # let Mosaic use more than the 16 MiB default scoped VMEM
             vmem_limit_bytes=96 * 1024 * 1024,

@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..compat import pallas_compiler_params, pallas_interpret_mode
+
 
 def make_laplacian_matvec(shape, cell_length=None, periodic=(True, True, True),
                           dtype=jnp.float32, tx=8, interpret=False):
@@ -160,9 +162,9 @@ def make_laplacian_matvec(shape, cell_length=None, periodic=(True, True, True),
     call = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=pallas_interpret_mode(interpret),
         out_shape=jax.ShapeDtypeStruct((X, Y, Z), jnp.dtype(dtype)),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             vmem_limit_bytes=96 * 1024 * 1024,
         ),
         cost_estimate=pl.CostEstimate(

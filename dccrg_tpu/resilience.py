@@ -1,0 +1,801 @@
+"""Resilience layer: checkpoint integrity, numerics watchdog with
+auto-rollback, and OOM-aware degradation.
+
+dccrg is the grid layer of week-long production plasma runs (Vlasiator
+survives node loss only through checkpoint/restart), so the framework
+must detect, degrade and recover without a human watching. Four
+pillars, each exercised end to end by the fault-injection suite
+(tests/test_resilience.py, tests/test_checkpoint_integrity.py, driven
+by :mod:`dccrg_tpu.faults`):
+
+**Checkpoint integrity** — :func:`save_checkpoint` writes the pinned
+``.dc`` byte format (unchanged — golden-file tests still pass)
+*atomically*: temp file in the same directory, fsync, rename, with
+bounded retries on transient I/O errors; a crash mid-save can never
+destroy the previous checkpoint. A sidecar ``<file>.crc`` records a
+CRC32 per fixed-size chunk of the final bytes; :func:`load_checkpoint`
+verifies it and raises :class:`CheckpointCorruptionError` naming the
+bad chunk, or — with ``strict=False`` — salvages every intact chunk
+(corrupt cells come back zeroed and are listed in the
+:class:`SalvageReport`).
+
+**Numerics watchdog** — :func:`check_finite` runs a device-side
+``isfinite`` reduction over the watched fields (one scalar crosses to
+the host, a psum-style min via :mod:`dccrg_tpu.comm`);
+:func:`assert_finite` turns a trip into a :class:`NumericsError`
+naming the offending fields and cells (located host-side by
+:func:`dccrg_tpu.verify.find_nonfinite_cells`). ``DCCRG_WATCHDOG=N``
+makes ``Grid.run_steps`` self-check every ~N steps.
+
+**Auto-rollback** — :class:`ResilientRunner` wraps a step loop:
+checkpoint every C steps, watchdog-check every K; on a trip it dumps a
+diagnostic bundle (step, fields, cell ids), rolls back to the last
+good checkpoint and resumes, with bounded retries and exponential
+backoff before surfacing :class:`ResilienceExhaustedError`.
+
+**OOM degradation** — :func:`guarded_step` dispatches
+``Grid.run_steps`` and, on XLA ``RESOURCE_EXHAUSTED`` (real or
+injected), walks the fallback chain *current gather mode -> slot-wise
+roll -> dense tables*, logging each downgrade; :func:`safe_devices`
+probes the backend in a killable subprocess with retries/backoff so a
+dead accelerator tunnel can never hang a bench or example script
+(``python -m dccrg_tpu.resilience`` is the CLI probe the poller
+scripts use).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+import zlib
+from contextlib import contextmanager
+from dataclasses import dataclass, field as dataclass_field
+
+import numpy as np
+
+from . import checkpoint as checkpoint_mod
+from . import faults
+
+logger = logging.getLogger("dccrg_tpu.resilience")
+
+CRC_CHUNK = 1 << 20  # bytes per sidecar checksum chunk
+SIDECAR_FORMAT = "dccrg-dc-crc-v1"
+SIDECAR_SUFFIX = ".crc"
+
+
+class CheckpointCorruptionError(ValueError):
+    """A checkpoint failed integrity verification. ``bad_chunks`` holds
+    the failing sidecar chunk indices (empty when the sidecar itself is
+    missing/unreadable)."""
+
+    def __init__(self, msg, bad_chunks=()):
+        super().__init__(msg)
+        self.bad_chunks = list(bad_chunks)
+
+
+class NumericsError(RuntimeError):
+    """The watchdog found non-finite values. ``details`` maps field
+    name -> offending cell ids."""
+
+    def __init__(self, msg, details=None):
+        super().__init__(msg)
+        self.details = details or {}
+
+
+class ResilienceExhaustedError(RuntimeError):
+    """Every bounded recovery attempt failed; the error is surfaced."""
+
+
+class DeviceProbeError(RuntimeError):
+    """The device backend did not answer within the probe budget."""
+
+
+# ---------------------------------------------------------------------
+# checkpoint integrity: CRC sidecar + atomic save + verifying load
+# ---------------------------------------------------------------------
+
+def sidecar_path(filename: str) -> str:
+    return filename + SIDECAR_SUFFIX
+
+
+def _chunk_ranges(payload_start, file_bytes, chunk_bytes, n=None):
+    """Byte ranges of the sidecar chunks: chunk 0 is exactly the
+    metadata block [0, payload_start) — mapping / geometry / offset
+    table, whose corruption is never salvageable — and chunks >= 1 tile
+    the payload in ``chunk_bytes`` pieces, so a bad payload chunk maps
+    onto a bounded set of cells."""
+    ranges = [(0, payload_start)]
+    pos = payload_start
+    while pos < file_bytes or (n is not None and len(ranges) < n):
+        ranges.append((pos, min(pos + chunk_bytes, file_bytes)))
+        pos += chunk_bytes
+    return ranges
+
+
+def _sidecar_record(path: str, header_size: int = 0,
+                    chunk_bytes: int = CRC_CHUNK) -> dict:
+    """The sidecar record for ``path``'s current bytes."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    payload_start = checkpoint_mod.parse_metadata(raw, header_size)[6]
+    ranges = _chunk_ranges(payload_start, len(raw), chunk_bytes)
+    crcs = [zlib.crc32(raw[lo:hi]) & 0xFFFFFFFF for lo, hi in ranges]
+    return {"format": SIDECAR_FORMAT, "chunk_bytes": chunk_bytes,
+            "file_bytes": len(raw), "payload_start": payload_start,
+            "header_size": header_size, "crc32": crcs}
+
+
+def _write_sidecar_record(side: str, rec: dict) -> None:
+    tmp = side + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, side)
+
+
+def write_sidecar(filename: str, header_size: int = 0,
+                  chunk_bytes: int = CRC_CHUNK) -> str:
+    """Checksum ``filename`` into its ``.crc`` sidecar: CRC32 of the
+    metadata block (chunk 0), then one CRC32 per ``chunk_bytes`` of
+    payload. The ``.dc`` file itself is untouched (the golden byte
+    format stays pinned)."""
+    side = sidecar_path(filename)
+    _write_sidecar_record(side, _sidecar_record(filename, header_size,
+                                                chunk_bytes))
+    return side
+
+
+def read_sidecar(filename: str):
+    """The parsed sidecar record, or None when none exists. An
+    unparseable sidecar raises CheckpointCorruptionError (corruption
+    hit the sidecar itself — the checkpoint cannot be trusted)."""
+    side = sidecar_path(filename)
+    if not os.path.exists(side):
+        return None
+    try:
+        with open(side) as f:
+            rec = json.load(f)
+        if rec.get("format") != SIDECAR_FORMAT:
+            raise ValueError(f"unknown sidecar format {rec.get('format')!r}")
+        # a sidecar corrupted at rest can still parse as JSON — reject
+        # implausible geometry here rather than hanging or crashing
+        # the chunk-range math downstream
+        cb = int(rec["chunk_bytes"])
+        fb = int(rec["file_bytes"])
+        ps = int(rec["payload_start"])
+        crcs = rec["crc32"]
+        if (cb <= 0 or fb < 0 or not 0 <= ps <= fb
+                or not isinstance(crcs, list)
+                or not all(isinstance(c, int) for c in crcs)):
+            raise ValueError("implausible sidecar geometry")
+        return rec
+    except (ValueError, KeyError, TypeError) as e:
+        raise CheckpointCorruptionError(
+            f"unreadable checksum sidecar {side}: {e}") from e
+
+
+def _rec_ranges(rec) -> list:
+    return _chunk_ranges(int(rec["payload_start"]), int(rec["file_bytes"]),
+                         int(rec["chunk_bytes"]), n=len(rec["crc32"]))
+
+
+def _chunk_name(i: int, ranges) -> str:
+    if i >= len(ranges):  # the trailing-garbage sentinel
+        return "trailing bytes past the recorded file size"
+    lo, hi = ranges[i]
+    what = "metadata block" if i == 0 else f"payload chunk {i}"
+    return f"{what} (bytes {lo}-{max(lo, hi - 1)})"
+
+
+def _bad_chunks(filename: str, rec) -> list:
+    """Indices of sidecar chunks whose CRC32 no longer matches.
+    Chunks truncated away count as bad; garbage appended past the
+    recorded size is reported as the sentinel index one past the last
+    chunk — the recorded range may still be fully intact, so salvage
+    just trims the tail instead of zeroing good cells."""
+    want = rec["crc32"]
+    ranges = _rec_ranges(rec)
+    with open(filename, "rb") as f:
+        raw = f.read()
+    bad = [i for i, ((lo, hi), crc) in enumerate(zip(ranges, want))
+           if (zlib.crc32(raw[lo:hi]) & 0xFFFFFFFF) != crc]
+    if len(raw) > int(rec["file_bytes"]):
+        bad.append(len(want))
+    return bad
+
+
+def verify_checkpoint(filename: str, require_sidecar: bool = True) -> list:
+    """Verify ``filename`` against its sidecar. Returns the bad chunk
+    indices (empty = intact). Raises CheckpointCorruptionError when the
+    sidecar is missing and ``require_sidecar``."""
+    rec = read_sidecar(filename)
+    if rec is None:
+        if require_sidecar:
+            raise CheckpointCorruptionError(
+                f"{filename}: no checksum sidecar ({sidecar_path(filename)}); "
+                "wrote with a pre-resilience save, or the sidecar was lost. "
+                "Load with strict=False to proceed unverified."
+            )
+        return []
+    return _bad_chunks(filename, rec)
+
+
+def save_checkpoint(grid, filename: str, header: bytes = b"",
+                    variable=None, sidecar: bool = True, retries: int = 2,
+                    backoff: float = 0.1, chunk_bytes: int = CRC_CHUNK) -> str:
+    """Atomic checkpoint save: the pinned ``.dc`` bytes stream into a
+    temp file in the target directory, fsync, then one rename — a crash
+    at any point leaves either the old or the new checkpoint complete,
+    never a torn file under the final name. Transient I/O errors retry
+    with exponential backoff. With ``sidecar`` (default) the per-chunk
+    CRC32 sidecar is written after the rename."""
+    tmp = filename + f".tmp.{os.getpid()}"
+    side = sidecar_path(filename)
+    rec = None
+    for attempt in range(retries + 1):
+        try:
+            checkpoint_mod.save_grid_data(grid, tmp, header=header,
+                                          variable=variable)
+            faults.fire("checkpoint.write", path=filename, attempt=attempt)
+            with open(tmp, "rb+") as f:
+                f.flush()
+                os.fsync(f.fileno())
+            if sidecar:
+                # checksum the TEMP bytes so the record always matches
+                # the file the rename publishes
+                rec = _sidecar_record(tmp, header_size=len(header),
+                                      chunk_bytes=chunk_bytes)
+            # drop any previous sidecar BEFORE the rename: a crash in
+            # this window leaves the new file with no sidecar — which
+            # strict load refuses conservatively — never a new file
+            # paired with a stale record (which would reject or
+            # destructively 'salvage' an intact checkpoint)
+            if os.path.exists(side):
+                os.unlink(side)
+            os.replace(tmp, filename)
+            _fsync_dir(os.path.dirname(os.path.abspath(filename)))
+            break
+        except OSError as e:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            if attempt >= retries:
+                raise
+            delay = backoff * (2 ** attempt)
+            logger.warning(
+                "checkpoint save of %s failed (%s); retry %d/%d in %.2fs",
+                filename, e, attempt + 1, retries, delay)
+            time.sleep(delay)
+    if rec is not None:
+        _write_sidecar_record(side, rec)
+    # post-write corruption injection happens AFTER the sidecar records
+    # the good bytes — exactly the at-rest corruption CRCs exist for
+    faults.corrupt_file(filename)
+    return filename
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - exotic filesystems
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+@dataclass
+class SalvageReport:
+    """What a non-strict load had to work around."""
+
+    bad_chunks: list = dataclass_field(default_factory=list)
+    corrupt_cells: np.ndarray = dataclass_field(
+        default_factory=lambda: np.empty(0, np.uint64))
+    sidecar_missing: bool = False
+
+    @property
+    def clean(self) -> bool:
+        return not self.bad_chunks and not self.sidecar_missing
+
+
+def load_checkpoint(filename: str, cell_data, mesh=None,
+                    header_size: int = 0, variable=None, strict: bool = True,
+                    load_balancing_method=None):
+    """Restart from a checkpoint with integrity verification.
+
+    Returns ``(grid, header, report)``. With ``strict`` (default) any
+    checksum mismatch — or a missing sidecar — raises
+    :class:`CheckpointCorruptionError` naming the bad chunk. With
+    ``strict=False`` intact chunks are salvaged: corrupt byte ranges
+    are zeroed before the load, so affected cells come back with
+    default (zero) values — variable-size fields read a zero count —
+    and are listed in ``report.corrupt_cells``. Corruption inside the
+    metadata block (mapping/geometry/offset table) is never salvageable
+    and raises in both modes."""
+    rec = read_sidecar(filename)
+    if rec is None:
+        if strict:
+            raise CheckpointCorruptionError(
+                f"{filename}: no checksum sidecar; load with strict=False "
+                "to proceed unverified")
+        logger.warning("%s: loading without checksum verification "
+                       "(sidecar missing)", filename)
+        grid, header = checkpoint_mod.load_grid(
+            filename, cell_data, mesh=mesh, header_size=header_size,
+            variable=variable, load_balancing_method=load_balancing_method)
+        return grid, header, SalvageReport(sidecar_missing=True)
+
+    bad = _bad_chunks(filename, rec)
+    if not bad:
+        grid, header = checkpoint_mod.load_grid(
+            filename, cell_data, mesh=mesh, header_size=header_size,
+            variable=variable, load_balancing_method=load_balancing_method)
+        return grid, header, SalvageReport()
+
+    all_ranges = _rec_ranges(rec)
+    names = ", ".join(_chunk_name(i, all_ranges) for i in bad)
+    if strict:
+        raise CheckpointCorruptionError(
+            f"{filename}: checksum mismatch in {names}", bad_chunks=bad)
+
+    # -- salvage: zero the corrupt ranges, load, report the cells -----
+    if 0 in bad:
+        raise CheckpointCorruptionError(
+            f"{filename}: corruption in the {names}; the metadata block "
+            "(mapping/geometry/offset table) cannot be trusted — not "
+            "salvageable", bad_chunks=bad)
+    file_bytes = int(rec["file_bytes"])
+    with open(filename, "rb") as f:
+        raw = bytearray(f.read())
+    # a truncated file is padded back to the recorded size with zeros
+    # (the missing tail is inside a corrupt range anyway)
+    if len(raw) < file_bytes:
+        raw += bytes(file_bytes - len(raw))
+    del raw[file_bytes:]
+
+    # the trailing-garbage sentinel has no in-range bytes to zero —
+    # `del raw[file_bytes:]` below already trims it
+    ranges = [all_ranges[i] for i in bad if i < len(all_ranges)]
+    try:
+        meta = checkpoint_mod.parse_metadata(bytes(raw), header_size)
+    except Exception as e:  # metadata CRC passed but parse still failed
+        raise CheckpointCorruptionError(
+            f"{filename}: metadata unreadable ({e}); corruption in {names} "
+            "is not salvageable", bad_chunks=bad) from e
+    cells, offsets = meta[4], meta[5]
+
+    for lo, hi in ranges:
+        raw[lo:hi] = bytes(hi - lo)
+
+    # per-cell payload extents from the (intact) offset table
+    offs = offsets.astype(np.int64)
+    ends = np.empty_like(offs)
+    ends[:-1] = offs[1:]
+    if len(ends):
+        ends[-1] = file_bytes
+    hit = np.zeros(len(cells), dtype=bool)
+    for lo, hi in ranges:
+        hit |= (offs < hi) & (ends > lo)
+    corrupt_cells = cells[hit].copy()
+
+    tmp = filename + f".salvage.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(bytes(raw))
+        grid, header = checkpoint_mod.load_grid(
+            tmp, cell_data, mesh=mesh, header_size=header_size,
+            variable=variable, load_balancing_method=load_balancing_method)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    logger.warning(
+        "%s: salvaged around %s — %d cell(s) restored with default "
+        "values: %s", filename, names, len(corrupt_cells),
+        corrupt_cells[:16].tolist())
+    return grid, header, SalvageReport(bad_chunks=bad,
+                                       corrupt_cells=corrupt_cells)
+
+
+# ---------------------------------------------------------------------
+# numerics watchdog
+# ---------------------------------------------------------------------
+
+def _inexact_fields(grid, fields=None):
+    import jax.numpy as jnp
+
+    names = list(fields) if fields is not None else list(grid.fields)
+    return [n for n in names
+            if jnp.issubdtype(grid.fields[n][1], jnp.inexact)]
+
+
+def check_finite(grid, fields=None) -> bool:
+    """Device-side watchdog probe: every element of the watched fields
+    isfinite, reduced to ONE scalar crossing to the host (per-device
+    ``all`` then a psum-style min over the mesh via comm.py). Cheap
+    enough to run every few steps; locate the offenders with
+    :func:`assert_finite` / verify.find_nonfinite_cells only on a
+    trip."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from . import comm
+    from .compat import shard_map
+
+    names = _inexact_fields(grid, fields)
+    if not names:
+        return True
+    key = ("finite", tuple(names),
+           tuple(tuple(grid.fields[n][0]) for n in names))
+    fn = grid._program_cache.get(key)
+    if fn is None:
+        axis, mesh = grid.axis, grid.mesh
+
+        def body(*arrs):
+            return comm.all_finite([a[0] for a in arrs], axis)[None]
+
+        mapped = shard_map(
+            body, mesh=mesh, in_specs=(P(axis),) * len(names),
+            out_specs=P(axis), check_vma=False)
+        fn = jax.jit(mapped)
+        grid._program_cache[key] = fn
+    out = fn(*(grid.data[n] for n in names))
+    return bool(int(out[0]))
+
+
+def assert_finite(grid, fields=None, step=None) -> None:
+    """Raise :class:`NumericsError` (naming fields and cell ids, found
+    host-side via verify.py) when the watchdog probe trips."""
+    if check_finite(grid, fields):
+        return
+    from . import verify
+
+    details = verify.find_nonfinite_cells(grid, fields)
+    where = "" if step is None else f" at step {step}"
+    names = {n: ids[:8].tolist() for n, ids in details.items()}
+    raise NumericsError(
+        f"non-finite values{where} in {names or 'ghost/pad rows only'}",
+        details=details)
+
+
+# ---------------------------------------------------------------------
+# OOM-aware step dispatch: the gather-mode fallback chain
+# ---------------------------------------------------------------------
+
+_GATHER_ENV = ("DCCRG_ROLL_STENCIL", "DCCRG_FORCE_TABLES")
+FALLBACK_CHAIN = ("current", "roll", "tables")
+
+
+def _is_resource_exhausted(e: BaseException) -> bool:
+    return ("RESOURCE_EXHAUSTED" in str(e)
+            or isinstance(e, faults.SimulatedResourceExhausted))
+
+
+# the env each forced gather mode pins (None = unset). DCCRG_FORCE_TABLES
+# is read at PLAN BUILD time (uniform.py), DCCRG_ROLL_STENCIL at program
+# build — forcing a mode therefore needs a plan rebuild.
+_MODE_ENV = {
+    "roll": {"DCCRG_FORCE_TABLES": None, "DCCRG_ROLL_STENCIL": "1"},
+    "tables": {"DCCRG_FORCE_TABLES": "1", "DCCRG_ROLL_STENCIL": "0"},
+}
+
+
+def _apply_mode(grid, mode: str) -> None:
+    """Pin the gather env for ``mode`` and rebuild the plan if it was
+    last built under a different forced mode. Cells/owners (and the
+    sticky capacity memo) are unchanged by the rebuild, so the row
+    layout — and with it every field array — stays valid."""
+    if mode == "current":
+        return
+    for v, val in _MODE_ENV[mode].items():
+        if val is None:
+            os.environ.pop(v, None)
+        else:
+            os.environ[v] = val
+    # _build_plan clears the marker, so any external rebuild (AMR
+    # commit, load balance) correctly invalidates it
+    if getattr(grid, "_plan_gather_mode", None) != mode:
+        grid._build_plan(grid.plan.cells, grid.plan.owner)
+        grid._plan_gather_mode = mode
+
+
+@contextmanager
+def _restore_env():
+    saved = {v: os.environ.get(v) for v in _GATHER_ENV}
+    try:
+        yield saved
+    finally:
+        for v, val in saved.items():
+            if val is None:
+                os.environ.pop(v, None)
+            else:
+                os.environ[v] = val
+
+
+def guarded_step(grid, kernel, fields_in, fields_out, n_steps=1, *,
+                 exchange_fields=None, neighborhood_id=None,
+                 extra_args=()) -> str:
+    """Dispatch ``Grid.run_steps`` with graceful OOM degradation.
+
+    On XLA ``RESOURCE_EXHAUSTED`` (real, or injected through
+    faults.resource_exhausted) the dispatch walks the fallback chain
+    *current mode -> slot-wise roll -> dense tables*, logging each
+    downgrade, and returns the mode that completed. Fallback entries
+    whose forced env equals the caller's current env are skipped
+    (retrying the identical configuration would just re-OOM), and a
+    successful downgrade is remembered on the grid: later guarded
+    dispatches start from the working mode even after a structural
+    rebuild reverted the plan. When every mode exhausts HBM,
+    :class:`ResilienceExhaustedError` surfaces with the last error
+    chained. The caller's env vars are restored either way."""
+    from .grid import DEFAULT_NEIGHBORHOOD_ID
+
+    hood = (DEFAULT_NEIGHBORHOOD_ID if neighborhood_id is None
+            else neighborhood_id)
+    failed = []
+    with _restore_env() as saved:
+        sticky = getattr(grid, "_sticky_gather_mode", None)
+        if sticky is not None:
+            chain = [m for m in FALLBACK_CHAIN[1:]
+                     if FALLBACK_CHAIN.index(m) >= FALLBACK_CHAIN.index(sticky)]
+        else:
+            chain = ["current"] + [m for m in FALLBACK_CHAIN[1:]
+                                   if _MODE_ENV[m] != saved]
+        for mode in chain:
+            try:
+                _apply_mode(grid, mode)
+                faults.fire("step.dispatch", mode=mode)
+                grid.run_steps(kernel, fields_in, fields_out, n_steps,
+                               exchange_fields=exchange_fields,
+                               neighborhood_id=hood, extra_args=extra_args)
+                if mode != "current":
+                    grid._sticky_gather_mode = mode
+                if failed:
+                    logger.warning(
+                        "step completed in fallback gather mode %r "
+                        "(exhausted: %s); the downgrade sticks for "
+                        "later guarded dispatches", mode,
+                        [m for m, _ in failed])
+                return mode
+            except Exception as e:  # noqa: BLE001 - filtered just below
+                if not _is_resource_exhausted(e):
+                    raise
+                logger.warning(
+                    "RESOURCE_EXHAUSTED dispatching step in gather mode "
+                    "%r; falling back (%s)", mode, e)
+                failed.append((mode, e))
+    raise ResilienceExhaustedError(
+        f"every gather mode in {[m for m, _ in failed]} exhausted device "
+        "memory") from failed[-1][1]
+
+
+# ---------------------------------------------------------------------
+# the resilient step loop: watchdog + checkpoint + rollback
+# ---------------------------------------------------------------------
+
+def watchdog_interval(default: int = 0) -> int:
+    """The DCCRG_WATCHDOG env knob: check every ~N steps (0 = off)."""
+    try:
+        return int(os.environ.get("DCCRG_WATCHDOG", "") or default)
+    except ValueError:
+        return default
+
+
+class ResilientRunner:
+    """Run a step loop that survives numerical blow-ups.
+
+    ``step_fn(grid, step_index)`` advances the simulation by one step
+    (typically a ``run_steps``/:func:`guarded_step` call). Every
+    ``checkpoint_every`` steps the state is checkpointed atomically
+    (CRC sidecar included); every ``check_every`` steps the watchdog
+    probes for non-finite values. On a trip the runner
+
+    1. dumps a diagnostic bundle (step, offending fields, cell ids)
+       into ``diagnostics_dir``,
+    2. rolls the grid back to the last *verified* checkpoint,
+    3. backs off exponentially and resumes.
+
+    Retries are bounded: ``max_retries`` consecutive trips without
+    passing the previous trip point raise
+    :class:`ResilienceExhaustedError`. Because the checkpoint holds
+    exact field bytes and the step programs are deterministic, a
+    recovered run reconverges to the bitwise-identical state of an
+    undisturbed one (pinned by tests/test_resilience.py).
+    """
+
+    def __init__(self, grid, step_fn, checkpoint_path, *, fields=None,
+                 check_every=None, checkpoint_every=10, max_retries=3,
+                 backoff=0.05, header=b"", variable=None,
+                 diagnostics_dir=None):
+        self.grid = grid
+        self.step_fn = step_fn
+        self.checkpoint_path = checkpoint_path
+        self.fields = fields
+        self.check_every = (check_every if check_every is not None
+                            else (watchdog_interval(0) or 1))
+        self.checkpoint_every = checkpoint_every
+        self.max_retries = max_retries
+        self.backoff = backoff
+        self.header = header
+        self.variable = variable
+        self.diagnostics_dir = (diagnostics_dir
+                                or os.path.dirname(os.path.abspath(
+                                    checkpoint_path)))
+        self.step = 0
+        self.trips = []  # diagnostic bundles, newest last
+        self.rollbacks = 0
+        self.checkpoints = 0
+        self._ckpt_step = None
+        self._retry_streak = 0
+        self._streak_step = -1
+
+    # -- checkpoint plumbing ------------------------------------------
+
+    def _save(self) -> None:
+        save_checkpoint(self.grid, self.checkpoint_path,
+                        header=self.header, variable=self.variable)
+        self._ckpt_step = self.step
+        self.checkpoints += 1
+
+    def _rollback(self) -> None:
+        bad = verify_checkpoint(self.checkpoint_path)
+        if bad:
+            raise CheckpointCorruptionError(
+                f"rollback target {self.checkpoint_path} is itself "
+                f"corrupt (chunks {bad})", bad_chunks=bad)
+        checkpoint_mod.load_grid_data(
+            self.grid, self.checkpoint_path, header_size=len(self.header),
+            variable=self.variable)
+        # the load scatters LOCAL rows only; ghost copies of fields the
+        # step loop treats as static (never re-exchanged) would stay
+        # zero — refresh every field's ghosts so the resumed run sees
+        # exactly the checkpointed state
+        self.grid.update_copies_of_remote_neighbors()
+        self.step = self._ckpt_step
+        self.rollbacks += 1
+
+    # -- trip handling ------------------------------------------------
+
+    def _dump_diagnostics(self, details) -> dict:
+        bundle = {
+            "step": self.step,
+            "rollback_to": self._ckpt_step,
+            "retry": self._retry_streak,
+            "fields": {n: ids[:64].tolist() for n, ids in details.items()},
+            "checkpoint": self.checkpoint_path,
+            "wall_time": time.time(),
+        }
+        path = os.path.join(
+            self.diagnostics_dir,
+            f"dccrg_diag_step{self.step}_try{self._retry_streak}.json")
+        try:
+            with open(path, "w") as f:
+                json.dump(bundle, f, indent=1)
+            bundle["path"] = path
+        except OSError as e:  # diagnostics must never kill recovery
+            logger.warning("could not write diagnostic bundle: %s", e)
+        self.trips.append(bundle)
+        return bundle
+
+    def _trip(self) -> None:
+        from . import verify
+
+        details = verify.find_nonfinite_cells(self.grid, self.fields)
+        if self.step > self._streak_step:
+            self._retry_streak = 0  # progress since the last trip
+        self._streak_step = self.step
+        self._retry_streak += 1
+        bundle = self._dump_diagnostics(details)
+        logger.warning(
+            "watchdog trip at step %d (fields %s); rolling back to "
+            "step %s (retry %d/%d)", self.step,
+            list(details) or "<ghost rows>", self._ckpt_step,
+            self._retry_streak, self.max_retries)
+        if self._retry_streak > self.max_retries:
+            raise ResilienceExhaustedError(
+                f"watchdog tripped {self._retry_streak} times at step "
+                f"{self.step} without progress; diagnostics: "
+                f"{bundle.get('path', '<unwritten>')}")
+        if self.backoff:
+            time.sleep(self.backoff * (2 ** (self._retry_streak - 1)))
+        self._rollback()
+
+    # -- the loop -----------------------------------------------------
+
+    def run(self, n_steps: int) -> "ResilientRunner":
+        """Advance to ``n_steps`` total steps, recovering as needed.
+        Returns self (``.step``, ``.trips``, ``.rollbacks``,
+        ``.checkpoints`` carry the story)."""
+        if self._ckpt_step is None:
+            self._save()  # rollback target always exists
+        while self.step < n_steps:
+            self.step_fn(self.grid, self.step)
+            self.step += 1
+            faults.poison_step(self.grid, self.step)
+            ckpt_due = self.step % self.checkpoint_every == 0
+            # a checkpoint step ALWAYS checks first — the rollback
+            # target must never capture unverified (poisoned) state,
+            # whatever the check/checkpoint cadence ratio
+            if (ckpt_due or self.step % self.check_every == 0
+                    or self.step == n_steps) \
+                    and not check_finite(self.grid, self.fields):
+                self._trip()
+                continue
+            if ckpt_due:
+                self._save()
+        return self
+
+
+# ---------------------------------------------------------------------
+# device probing that cannot hang
+# ---------------------------------------------------------------------
+
+def safe_devices(timeout: float = 90.0, retries: int = 2,
+                 backoff: float = 2.0, platform=None):
+    """``jax.devices()`` that cannot hang the caller: the backend is
+    probed first in a SUBPROCESS (killed hard on timeout — the axon
+    client is known to survive SIGTERM) with bounded retries and
+    exponential backoff; only a successful probe lets the in-process
+    call proceed. Raises :class:`DeviceProbeError` when the budget is
+    spent. ``platform`` routes both the probe and the in-process jax
+    through ``jax.config.update('jax_platforms', ...)`` (env vars are
+    too late once the image's site hook has imported jax)."""
+    code = "import jax; "
+    if platform:
+        code += f"jax.config.update('jax_platforms', {platform!r}); "
+    code += "print(len(jax.devices()))"
+    last = "no probe attempted"
+    for attempt in range(retries + 1):
+        try:
+            faults.fire("device.probe", attempt=attempt)
+            out = subprocess.run(
+                [sys.executable, "-c", code], timeout=timeout,
+                capture_output=True, text=True)
+            if out.returncode == 0:
+                import jax
+
+                if platform:
+                    jax.config.update("jax_platforms", platform)
+                return jax.devices()
+            last = (out.stderr or out.stdout).strip()[-200:]
+        except (subprocess.TimeoutExpired, faults.InjectedProbeHang) as e:
+            last = f"probe timed out after {timeout}s ({type(e).__name__})"
+        if attempt < retries:
+            delay = backoff * (2 ** attempt)
+            logger.warning("device probe failed (%s); retry %d/%d in %.1fs",
+                           last, attempt + 1, retries, delay)
+            time.sleep(delay)
+    raise DeviceProbeError(
+        f"device backend unreachable after {retries + 1} probe(s): {last}")
+
+
+def _main(argv=None) -> int:
+    """CLI probe for shell scripts: ``python -m dccrg_tpu.resilience
+    [--timeout S] [--retries N] [--platform P]`` exits 0 and prints the
+    devices when the backend answers, 1 otherwise — never hangs."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=_main.__doc__)
+    ap.add_argument("--timeout", type=float, default=90.0)
+    ap.add_argument("--retries", type=int, default=0)
+    ap.add_argument("--backoff", type=float, default=2.0)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args(argv)
+    try:
+        devs = safe_devices(timeout=args.timeout, retries=args.retries,
+                            backoff=args.backoff, platform=args.platform)
+        print("OK", devs)
+        return 0
+    except DeviceProbeError as e:
+        print("DOWN", e)
+        return 1
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    sys.exit(_main())

@@ -16,6 +16,11 @@ between operations:
 - ``verify_user_data``    — field storage layout (dccrg.hpp:12984-13011)
 - ``pin_requests_succeeded`` — pinned cells sit on their device (dccrg.hpp:13017-13035)
 - ``verify_all``          — everything above
+- ``find_nonfinite_cells`` — locate NaN/Inf per field (the resilience
+                            watchdog's diagnostic pass: the cheap
+                            device-side probe in resilience.py only
+                            says *that* something blew up; this names
+                            the field and cells for the bundle)
 
 Setting ``DCCRG_DEBUG=1`` makes ``Grid`` run ``verify_all`` after every
 structure rebuild (init, AMR commit, load balance) — the reference's
@@ -194,6 +199,28 @@ def pin_requests_succeeded(grid) -> None:
             continue  # pinned cell no longer exists (refined away)
         if plan.owner[pos] != dev:
             _fail(f"pinned cell {cid} is on device {plan.owner[pos]}, not {dev}")
+
+
+def find_nonfinite_cells(grid, fields=None) -> dict:
+    """Locate non-finite values: ``{field: cell ids}`` for every
+    watched inexact field holding a NaN/Inf in a LOCAL row (ghost
+    copies mirror some other device's local row, so local rows cover
+    every real offender). Host-side and O(grid) — run it only after
+    the cheap device-side probe (resilience.check_finite) has tripped,
+    to name the offenders in the diagnostic bundle."""
+    out = {}
+    cells = grid.get_cells()
+    names = list(fields) if fields is not None else list(grid.fields)
+    for name in names:
+        if not np.issubdtype(np.dtype(grid.fields[name][1]), np.inexact):
+            continue
+        vals = np.asarray(grid.get(name, cells))
+        bad = ~np.isfinite(vals)
+        while bad.ndim > 1:
+            bad = bad.any(axis=-1)
+        if bad.any():
+            out[name] = np.asarray(cells)[bad]
+    return out
 
 
 def verify_all(grid) -> None:

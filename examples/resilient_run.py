@@ -1,0 +1,91 @@
+"""Resilience walkthrough: a run that survives injected disasters.
+
+An advection run is wrapped in ResilientRunner (atomic checksummed
+checkpoints + numerics watchdog + auto-rollback) while a FaultPlan
+injects a NaN blow-up mid-run and a simulated device OOM at dispatch.
+The run must (a) trip, roll back and reconverge BITWISE-identically to
+an undisturbed run, and (b) complete the OOM'd step through the
+gather-mode fallback chain.
+
+Run: python examples/resilient_run.py
+"""
+
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from dccrg_tpu import FaultPlan, ResilientRunner, resilience  # noqa: E402
+from dccrg_tpu.models.advection import GridAdvection  # noqa: E402
+
+
+def make_runner(tmp, name):
+    solver = GridAdvection(n=16, nz=4)
+    dt = 0.5 * solver.max_time_step()
+
+    def step_fn(grid, _i):
+        grid.run_steps(solver._kernel, ["density", "vx", "vy"],
+                       ["density"], 1, extra_args=(jnp.float32(dt),))
+
+    runner = ResilientRunner(
+        solver.grid, step_fn, str(Path(tmp) / f"{name}.dc"),
+        fields=("density",), check_every=1, checkpoint_every=5,
+        backoff=0.0, diagnostics_dir=tmp)
+    return solver, runner, dt
+
+
+def main():
+    with tempfile.TemporaryDirectory() as tmp:
+        # undisturbed reference run
+        ref_solver, ref_runner, _ = make_runner(tmp, "ref")
+        ref_runner.run(20)
+        ref = np.asarray(ref_solver.grid.get("density",
+                                             ref_solver.grid.plan.cells))
+
+        # the same run, with a NaN landing in the density field after
+        # step 13 — the watchdog must trip, roll back to the step-10
+        # checkpoint, and resume
+        solver, runner, dt = make_runner(tmp, "guarded")
+        plan = FaultPlan(seed=42)
+        plan.nan_poison("density", step=13)
+        with plan:
+            runner.run(20)
+        got = np.asarray(solver.grid.get("density",
+                                         solver.grid.plan.cells))
+        print(f"trips={len(runner.trips)} rollbacks={runner.rollbacks} "
+              f"checkpoints={runner.checkpoints} "
+              f"diag={runner.trips[0].get('path')}")
+        assert runner.rollbacks == 1
+        assert got.tobytes() == ref.tobytes(), \
+            "recovered run diverged from the undisturbed one"
+        print("rollback reconverged bitwise-identically")
+
+        # a simulated RESOURCE_EXHAUSTED on the first dispatch: the
+        # fallback chain (current -> roll -> tables) completes the step
+        plan2 = FaultPlan()
+        plan2.resource_exhausted(times=1, mode="current")
+        with plan2:
+            mode = resilience.guarded_step(
+                solver.grid, solver._kernel, ["density", "vx", "vy"],
+                ["density"], n_steps=1, extra_args=(jnp.float32(dt),))
+        print(f"OOM'd dispatch completed in fallback gather mode {mode!r}")
+
+    print("PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,255 @@
+"""Checkpoint integrity: CRC sidecar verification, atomic save, and
+salvage-around-corruption (resilience.py), exercised with deterministic
+fault injection (faults.py).
+
+Every corruption class we have to survive is injected here: truncated
+files, flipped payload bits, a wrecked endianness magic, a lost
+sidecar, corruption inside ragged (variable-size) payloads, and I/O
+errors during the save itself. The golden ``.dc`` byte format is
+pinned separately by tests/test_golden.py — the sidecar lives in its
+own file, so byte identity of the checkpoint is untouched (re-checked
+here too)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from dccrg_tpu import faults, resilience
+from dccrg_tpu.resilience import CheckpointCorruptionError
+from golden_fixture import GOLDEN_SCHEMA, GOLDEN_VARIABLE, build_golden_grid
+
+pytestmark = pytest.mark.faultinject
+
+HEADER = b"integrity-v1\n"
+# small chunks so single corruptions map onto a few cells, not the
+# whole payload
+CHUNK = 128
+
+
+@pytest.fixture
+def saved(tmp_path):
+    g = build_golden_grid(Mesh(np.array(jax.devices()[:4]), ("dev",)))
+    fn = str(tmp_path / "ck.dc")
+    resilience.save_checkpoint(g, fn, header=HEADER,
+                               variable=GOLDEN_VARIABLE, chunk_bytes=CHUNK)
+    return g, fn
+
+
+def _load(fn, strict=True):
+    return resilience.load_checkpoint(
+        fn, GOLDEN_SCHEMA, header_size=len(HEADER),
+        variable=GOLDEN_VARIABLE, strict=strict)
+
+
+def _assert_equal_on(g_ref, g_got, cells):
+    if not len(cells):
+        return
+    counts = g_ref.get("count", cells)
+    for name in GOLDEN_SCHEMA:
+        want = g_ref.get(name, cells)
+        got = g_got.get(name, cells)
+        if name in GOLDEN_VARIABLE:
+            # ragged field: only rows < count are stored/restored
+            keep = np.arange(want.shape[1])[None, :] < counts[:, None]
+            want = np.where(keep[..., None], want, 0)
+            got = np.where(keep[..., None], got, 0)
+        np.testing.assert_array_equal(got, want, err_msg=f"field {name!r}")
+
+
+def test_clean_roundtrip(saved):
+    g, fn = saved
+    assert os.path.exists(fn + ".crc")
+    assert resilience.verify_checkpoint(fn) == []
+    g2, header, report = _load(fn)
+    assert header == HEADER
+    assert report.clean
+    _assert_equal_on(g, g2, np.asarray(g.plan.cells))
+
+
+def test_sidecar_does_not_change_dc_bytes(saved, tmp_path):
+    """save_checkpoint writes byte-identical .dc content to the plain
+    (golden-pinned) save path."""
+    g, fn = saved
+    plain = tmp_path / "plain.dc"
+    g.save_grid_data(str(plain), header=HEADER, variable=GOLDEN_VARIABLE)
+    assert plain.read_bytes() == open(fn, "rb").read()
+
+
+def test_flipped_payload_bit_detected_and_salvaged(saved):
+    g, fn = saved
+    rec = json.load(open(fn + ".crc"))
+    # flip one bit in the middle of the payload
+    byte = (rec["payload_start"] + rec["file_bytes"]) // 2
+    faults.flip_bit(fn, byte, bit=5)
+    with pytest.raises(CheckpointCorruptionError, match=r"payload chunk \d+"):
+        _load(fn)
+    g2, _, report = _load(fn, strict=False)
+    assert len(report.bad_chunks) == 1
+    assert len(report.corrupt_cells)
+    # every cell OUTSIDE the bad chunk is recovered exactly
+    ok = np.setdiff1d(np.asarray(g.plan.cells), report.corrupt_cells)
+    assert len(ok) > len(report.corrupt_cells)  # fine-grained salvage
+    _assert_equal_on(g, g2, ok)
+    # corrupt cells come back zeroed, not garbage
+    np.testing.assert_array_equal(
+        g2.get("density", report.corrupt_cells),
+        np.zeros(len(report.corrupt_cells), np.float32))
+
+
+def test_every_single_byte_flip_is_detected(saved):
+    """ANY single flipped byte anywhere in the file fails verification
+    (sampled across the whole file for speed, always including the
+    first/last byte and chunk boundaries)."""
+    _, fn = saved
+    size = os.path.getsize(fn)
+    good = open(fn, "rb").read()
+    probe = sorted({0, size - 1, CHUNK, CHUNK + 1, size // 2}
+                   | set(range(7, size, max(1, size // 19))))
+    for byte in probe:
+        faults.flip_bit(fn, byte, bit=1)
+        assert resilience.verify_checkpoint(fn), f"flip at {byte} missed"
+        with open(fn, "wb") as f:
+            f.write(good)
+    assert resilience.verify_checkpoint(fn) == []
+
+
+def test_truncated_file(saved):
+    g, fn = saved
+    faults.truncate_file(fn, 2 * CHUNK + 7)
+    with pytest.raises(CheckpointCorruptionError):
+        _load(fn)
+    g2, _, report = _load(fn, strict=False)
+    ok = np.setdiff1d(np.asarray(g.plan.cells), report.corrupt_cells)
+    _assert_equal_on(g, g2, ok)
+
+
+def test_wrong_endianness_magic(saved):
+    """A corrupt magic is metadata corruption: named as such, and not
+    salvageable in either mode. Without a sidecar the legacy parse
+    error still fires."""
+    _, fn = saved
+    faults.flip_bit(fn, len(HEADER) + 2, bit=0)  # inside the magic u64
+    with pytest.raises(CheckpointCorruptionError, match="metadata block"):
+        _load(fn)
+    with pytest.raises(CheckpointCorruptionError, match="metadata"):
+        _load(fn, strict=False)
+    os.unlink(fn + ".crc")  # no sidecar: the parser's own check fires
+    with pytest.raises(ValueError, match="bad endianness magic"):
+        _load(fn, strict=False)
+
+
+def test_missing_sidecar(saved):
+    g, fn = saved
+    os.unlink(fn + ".crc")
+    with pytest.raises(CheckpointCorruptionError, match="sidecar"):
+        _load(fn)
+    g2, _, report = _load(fn, strict=False)
+    assert report.sidecar_missing
+    _assert_equal_on(g, g2, np.asarray(g.plan.cells))
+
+
+def test_ragged_payload_corruption(saved):
+    """Corruption inside a variable-size (ragged) cell's rows: strict
+    names the chunk; salvage zeroes that cell's count (no corrupt-count
+    explosion) and recovers everything else."""
+    g, fn = saved
+    rec = json.load(open(fn + ".crc"))
+    # the LAST bytes of the payload belong to the highest-offset cell's
+    # ragged tail (pos rows, GOLDEN_VARIABLE truncates by count)
+    faults.flip_bit(fn, rec["file_bytes"] - 3, bit=7)
+    with pytest.raises(CheckpointCorruptionError, match="payload chunk"):
+        _load(fn)
+    g2, _, report = _load(fn, strict=False)
+    assert len(report.corrupt_cells)
+    ok = np.setdiff1d(np.asarray(g.plan.cells), report.corrupt_cells)
+    _assert_equal_on(g, g2, ok)
+    # the corrupt ragged rows come back zeroed (the cells' counts live
+    # in an earlier, intact chunk and survive — consistent state, no
+    # corrupt-count explosion)
+    pos = g2.get("pos", report.corrupt_cells)
+    counts = g2.get("count", report.corrupt_cells)
+    for i, c in enumerate(counts):
+        np.testing.assert_array_equal(pos[i, :c], 0.0)
+
+
+def test_trailing_garbage_detected_but_salvage_keeps_all_cells(saved):
+    """Appended garbage past the recorded size fails verification, but
+    the recorded byte range is intact — salvage trims the tail and
+    recovers EVERY cell (no destructive zeroing of the last chunk)."""
+    g, fn = saved
+    with open(fn, "ab") as f:
+        f.write(b"\xde\xad" * 5)
+    assert resilience.verify_checkpoint(fn)
+    with pytest.raises(CheckpointCorruptionError, match="trailing"):
+        _load(fn)
+    g2, _, report = _load(fn, strict=False)
+    assert not len(report.corrupt_cells)
+    _assert_equal_on(g, g2, np.asarray(g.plan.cells))
+
+
+def test_corrupt_sidecar_geometry_rejected_not_hung(saved):
+    """A sidecar damaged into parseable-but-implausible JSON (zero
+    chunk size) raises CheckpointCorruptionError instead of hanging
+    the chunk-range walk."""
+    _, fn = saved
+    rec = json.load(open(fn + ".crc"))
+    rec["chunk_bytes"] = 0
+    json.dump(rec, open(fn + ".crc", "w"))
+    with pytest.raises(CheckpointCorruptionError, match="sidecar"):
+        resilience.verify_checkpoint(fn)
+    rec["chunk_bytes"] = "lots"
+    json.dump(rec, open(fn + ".crc", "w"))
+    with pytest.raises(CheckpointCorruptionError, match="sidecar"):
+        _load(fn, strict=False)
+
+
+def test_transient_io_error_retries(saved, tmp_path):
+    """A transient I/O failure during save retries and succeeds; the
+    fault log records exactly one firing."""
+    g, fn = saved
+    out = str(tmp_path / "retry.dc")
+    plan = faults.FaultPlan()
+    plan.io_error(times=1)
+    with plan:
+        resilience.save_checkpoint(g, out, header=HEADER,
+                                   variable=GOLDEN_VARIABLE, backoff=0.0)
+    assert plan.fired("checkpoint.write") == 1
+    assert resilience.verify_checkpoint(out) == []
+
+
+def test_failed_save_preserves_previous_checkpoint(saved, tmp_path):
+    """A save that dies mid payload stream (torn temp file) never
+    replaces the previous checkpoint, and leaves no temp litter."""
+    g, fn = saved
+    before = open(fn, "rb").read()
+    plan = faults.FaultPlan()
+    plan.chunk_io_error(times=faults.EVERY)  # every attempt dies
+    with plan, pytest.raises(OSError):
+        resilience.save_checkpoint(g, fn, header=HEADER,
+                                   variable=GOLDEN_VARIABLE,
+                                   retries=1, backoff=0.0)
+    assert open(fn, "rb").read() == before
+    assert resilience.verify_checkpoint(fn) == []
+    assert not [p for p in os.listdir(os.path.dirname(fn))
+                if ".tmp." in p]
+
+
+def test_corruption_injected_through_plan(saved, tmp_path):
+    """The FaultPlan file-corruption path (seeded random bit flip after
+    a save) is caught by verification — the end-to-end story a torn
+    disk gives us."""
+    g, _ = saved
+    out = str(tmp_path / "planned.dc")
+    plan = faults.FaultPlan(seed=11)
+    plan.bit_flip(times=1)
+    with plan:
+        resilience.save_checkpoint(g, out, header=HEADER,
+                                   variable=GOLDEN_VARIABLE,
+                                   chunk_bytes=CHUNK)
+    assert plan.fired("checkpoint.file") == 1
+    assert resilience.verify_checkpoint(out)

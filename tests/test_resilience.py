@@ -1,0 +1,327 @@
+"""Resilience layer: numerics watchdog, auto-rollback, OOM fallback
+chain, hang-proof device probing — all driven by deterministic fault
+injection (dccrg_tpu.faults).
+
+The acceptance pin: a NaN injected at step k must roll the run back to
+the last checkpoint and reconverge to the BITWISE-identical final
+state of an uninjected run (advection model, CPU backend)."""
+
+import json
+import os
+import glob
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dccrg_tpu import faults, resilience
+from dccrg_tpu.models.advection import GridAdvection
+from dccrg_tpu.resilience import (
+    NumericsError, ResilienceExhaustedError, ResilientRunner)
+
+pytestmark = pytest.mark.faultinject
+
+
+def _advection(n=8, nz=4):
+    """Small advection solver + a one-step step_fn for the runner."""
+    s = GridAdvection(n=n, nz=nz)
+    dt = 0.5 * s.max_time_step()
+
+    def step_fn(grid, _i):
+        grid.run_steps(s._kernel, ["density", "vx", "vy"], ["density"], 1,
+                       extra_args=(jnp.float32(dt),))
+
+    return s, step_fn, dt
+
+
+# -- watchdog ---------------------------------------------------------
+
+def test_check_finite_and_assert(tmp_path):
+    s, _, _ = _advection()
+    g = s.grid
+    assert resilience.check_finite(g)
+    assert resilience.check_finite(g, fields=("density",))
+    cells = g.get_cells()
+    g.set("density", cells[3:4], np.array([np.inf], np.float32))
+    assert not resilience.check_finite(g)
+    with pytest.raises(NumericsError) as ei:
+        resilience.assert_finite(g, step=7)
+    assert "density" in ei.value.details
+    np.testing.assert_array_equal(ei.value.details["density"], cells[3:4])
+    assert "step 7" in str(ei.value)
+
+
+def test_find_nonfinite_cells_names_field_and_cells():
+    from dccrg_tpu import verify
+
+    s, _, _ = _advection()
+    g = s.grid
+    cells = g.get_cells()
+    g.set("vx", cells[5:7], np.array([np.nan, np.nan], np.float32))
+    out = verify.find_nonfinite_cells(g)
+    assert list(out) == ["vx"]
+    np.testing.assert_array_equal(out["vx"], cells[5:7])
+
+
+def test_watchdog_env_knob_in_run_steps(monkeypatch):
+    """DCCRG_WATCHDOG=N makes plain Grid.run_steps self-check: a
+    poisoned field surfaces as NumericsError instead of silently
+    stepping garbage."""
+    s, _, dt = _advection()
+    g = s.grid
+    cells = g.get_cells()
+    g.set("density", cells[:1], np.array([np.nan], np.float32))
+    monkeypatch.setenv("DCCRG_WATCHDOG", "2")
+    with pytest.raises(NumericsError):
+        g.run_steps(s._kernel, ["density", "vx", "vy"], ["density"], 4,
+                    extra_args=(jnp.float32(dt),))
+
+
+# -- auto-rollback ----------------------------------------------------
+
+def _run(tmp_path, name, n_steps=12, plan=None, **kw):
+    s, step_fn, _ = _advection()
+    runner = ResilientRunner(
+        s.grid, step_fn, str(tmp_path / f"{name}.dc"),
+        fields=("density",), check_every=1, checkpoint_every=5,
+        backoff=0.0, diagnostics_dir=str(tmp_path), **kw)
+    if plan is not None:
+        with plan:
+            runner.run(n_steps)
+    else:
+        runner.run(n_steps)
+    return runner, np.asarray(s.grid.get("density", s.grid.plan.cells))
+
+
+def test_nan_rollback_reconverges_bitwise(tmp_path):
+    """THE acceptance pin: injected NaN at step 8, checkpoint cadence
+    5 -> trip, rollback to step 5, resume; final state bitwise equals
+    the uninjected run's."""
+    _, ref = _run(tmp_path, "ref")
+
+    plan = faults.FaultPlan(seed=3)
+    plan.nan_poison("density", step=8)
+    runner, got = _run(tmp_path, "inj", plan=plan)
+
+    assert plan.fired("step.poison") == 1
+    assert runner.rollbacks == 1
+    assert len(runner.trips) == 1
+    assert runner.trips[0]["step"] == 8
+    assert runner.trips[0]["rollback_to"] == 5
+    assert got.tobytes() == ref.tobytes()
+
+
+def test_checkpoint_step_checks_before_saving(tmp_path):
+    """checkpoint_every NOT a multiple of check_every: a NaN landing
+    exactly on a checkpoint step must trip BEFORE the save, so the
+    rollback target never captures poisoned state and the run still
+    reconverges."""
+    s, step_fn, _ = _advection()
+    ref_runner = ResilientRunner(
+        s.grid, step_fn, str(tmp_path / "r.dc"), fields=("density",),
+        check_every=3, checkpoint_every=10, backoff=0.0,
+        diagnostics_dir=str(tmp_path))
+    ref_runner.run(12)
+    ref = np.asarray(s.grid.get("density", s.grid.plan.cells))
+
+    s2, step_fn2, _ = _advection()
+    plan = faults.FaultPlan(seed=9)
+    plan.nan_poison("density", step=10)  # 10 % 3 != 0: not a check step
+    runner = ResilientRunner(
+        s2.grid, step_fn2, str(tmp_path / "i.dc"), fields=("density",),
+        check_every=3, checkpoint_every=10, backoff=0.0,
+        diagnostics_dir=str(tmp_path))
+    with plan:
+        runner.run(12)
+    assert runner.rollbacks == 1
+    got = np.asarray(s2.grid.get("density", s2.grid.plan.cells))
+    assert got.tobytes() == ref.tobytes()
+
+
+def test_trip_dumps_diagnostic_bundle(tmp_path):
+    plan = faults.FaultPlan(seed=1)
+    plan.nan_poison("density", step=3)
+    runner, _ = _run(tmp_path, "diag", n_steps=6, plan=plan)
+    paths = glob.glob(str(tmp_path / "dccrg_diag_step3_*.json"))
+    assert len(paths) == 1
+    bundle = json.load(open(paths[0]))
+    assert bundle["step"] == 3
+    assert bundle["rollback_to"] == 0
+    assert bundle["fields"]["density"]  # offending cells are named
+
+
+def test_persistent_nan_exhausts_retries(tmp_path):
+    """A NaN that reappears every replay (poison pinned to the same
+    step, every time) trips max_retries rollbacks then surfaces."""
+    plan = faults.FaultPlan(seed=2)
+    plan.nan_poison("density", step=3, times=8)
+    with pytest.raises(ResilienceExhaustedError, match="step 3"):
+        _run(tmp_path, "persist", n_steps=6, plan=plan, max_retries=2)
+    assert plan.fired("step.poison") == 3  # initial + 2 retries
+
+
+def test_rollback_refuses_corrupt_checkpoint(tmp_path):
+    """If the rollback target itself is corrupt the runner surfaces
+    CheckpointCorruptionError rather than resuming from garbage."""
+    s, step_fn, _ = _advection()
+    ck = str(tmp_path / "cc.dc")
+    runner = ResilientRunner(s.grid, step_fn, ck, fields=("density",),
+                             check_every=1, checkpoint_every=100,
+                             backoff=0.0, diagnostics_dir=str(tmp_path))
+    runner.run(2)  # writes the step-0 checkpoint
+    faults.flip_bit(ck, os.path.getsize(ck) - 5, 1)
+    cells = s.grid.get_cells()
+    s.grid.set("density", cells[:1], np.array([np.nan], np.float32))
+    with pytest.raises(resilience.CheckpointCorruptionError):
+        runner.run(4)
+
+
+# -- OOM fallback chain -----------------------------------------------
+
+def test_resource_exhausted_falls_back_and_matches(tmp_path):
+    """Acceptance pin: simulated RESOURCE_EXHAUSTED on the current
+    (dense dispatch) path walks the logged fallback chain; the step
+    completes with results equal to the direct slot-wise path."""
+    s_ref, _, dt = _advection()
+    s_ref.grid.run_steps(s_ref._kernel, ["density", "vx", "vy"],
+                         ["density"], 3, extra_args=(jnp.float32(dt),))
+    ref = np.asarray(s_ref.grid.get("density", s_ref.grid.plan.cells))
+
+    s, _, _ = _advection()
+    plan = faults.FaultPlan()
+    plan.resource_exhausted(times=1, mode="current")
+    with plan:
+        mode = resilience.guarded_step(
+            s.grid, s._kernel, ["density", "vx", "vy"], ["density"],
+            n_steps=3, extra_args=(jnp.float32(dt),))
+    assert mode == "roll"
+    assert plan.fired("step.dispatch") == 1
+    got = np.asarray(s.grid.get("density", s.grid.plan.cells))
+    np.testing.assert_array_equal(got, ref)
+    # the downgrade sticks: later guarded dispatches start from the
+    # working mode instead of re-trying the one that OOM'd
+    assert s.grid._sticky_gather_mode == "roll"
+    assert resilience.guarded_step(
+        s.grid, s._kernel, ["density", "vx", "vy"], ["density"],
+        n_steps=1, extra_args=(jnp.float32(dt),)) == "roll"
+
+
+def test_forced_env_mode_is_not_retried(monkeypatch):
+    """With roll already forced via env, the chain skips the redundant
+    'roll' retry and goes current -> tables."""
+    monkeypatch.delenv("DCCRG_FORCE_TABLES", raising=False)
+    monkeypatch.setenv("DCCRG_ROLL_STENCIL", "1")
+    s, _, dt = _advection()
+    plan = faults.FaultPlan()
+    plan.resource_exhausted(times=1, mode="current")
+    with plan:
+        mode = resilience.guarded_step(
+            s.grid, s._kernel, ["density", "vx", "vy"], ["density"],
+            n_steps=1, extra_args=(jnp.float32(dt),))
+    assert mode == "tables"
+    assert [m for _s, _k, m in
+            [(l[0], l[1], l[2].get("mode")) for l in plan.log]] == ["current"]
+
+
+def test_fallback_reaches_tables_and_matches(tmp_path):
+    s_ref, _, dt = _advection()
+    s_ref.grid.run_steps(s_ref._kernel, ["density", "vx", "vy"],
+                         ["density"], 3, extra_args=(jnp.float32(dt),))
+    ref = np.asarray(s_ref.grid.get("density", s_ref.grid.plan.cells))
+
+    s, _, _ = _advection()
+    plan = faults.FaultPlan()
+    plan.resource_exhausted(times=1, mode="current")
+    plan.resource_exhausted(times=1, mode="roll")
+    with plan:
+        mode = s.grid.run_steps_guarded(
+            s._kernel, ["density", "vx", "vy"], ["density"], 3,
+            extra_args=(jnp.float32(dt),))
+    assert mode == "tables"
+    got = np.asarray(s.grid.get("density", s.grid.plan.cells))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_fallback_chain_exhausted():
+    s, _, dt = _advection()
+    plan = faults.FaultPlan()
+    plan.resource_exhausted(times=faults.EVERY)
+    with plan, pytest.raises(ResilienceExhaustedError):
+        resilience.guarded_step(
+            s.grid, s._kernel, ["density", "vx", "vy"], ["density"],
+            n_steps=1, extra_args=(jnp.float32(dt),))
+
+
+def test_gather_mode_env_restored():
+    """The fallback chain restores the caller's gather env vars."""
+    s, _, dt = _advection()
+    os.environ.pop("DCCRG_FORCE_TABLES", None)
+    before = {v: os.environ.get(v)
+              for v in ("DCCRG_FORCE_TABLES", "DCCRG_ROLL_STENCIL")}
+    plan = faults.FaultPlan()
+    plan.resource_exhausted(times=1, mode="current")
+    plan.resource_exhausted(times=1, mode="roll")
+    with plan:
+        s.grid.run_steps_guarded(
+            s._kernel, ["density", "vx", "vy"], ["density"], 1,
+            extra_args=(jnp.float32(dt),))
+    after = {v: os.environ.get(v)
+             for v in ("DCCRG_FORCE_TABLES", "DCCRG_ROLL_STENCIL")}
+    assert after == before
+
+
+def test_unrelated_errors_are_not_swallowed():
+    """Only RESOURCE_EXHAUSTED walks the chain; anything else
+    propagates untouched."""
+    s, _, dt = _advection()
+    with pytest.raises(KeyError):
+        resilience.guarded_step(
+            s.grid, s._kernel, ["density", "nope"], ["density"],
+            n_steps=1, extra_args=(jnp.float32(dt),))
+
+
+# -- device probing ---------------------------------------------------
+
+def test_safe_devices_cpu():
+    devs = resilience.safe_devices(timeout=120, retries=0, platform="cpu")
+    assert len(devs) == len(jax.devices())
+
+
+def test_safe_devices_hung_probe_times_out_with_backoff():
+    plan = faults.FaultPlan()
+    plan.probe_hang(times=faults.EVERY)
+    with plan, pytest.raises(resilience.DeviceProbeError, match="probe"):
+        resilience.safe_devices(timeout=1, retries=2, backoff=0.0,
+                                platform="cpu")
+    assert plan.fired("device.probe") == 3  # initial + 2 retries
+
+
+def test_safe_devices_recovers_after_transient_hang():
+    plan = faults.FaultPlan()
+    plan.probe_hang(times=1)
+    with plan:
+        devs = resilience.safe_devices(timeout=120, retries=1, backoff=0.0,
+                                       platform="cpu")
+    assert len(devs) >= 1
+
+
+# -- endurance (slow tier) --------------------------------------------
+
+@pytest.mark.slow
+def test_endurance_inject_trip_rollback_resume_50_steps(tmp_path):
+    """50 steps with a NaN injected every ~7th step: every trip rolls
+    back and resumes, and the final state still bitwise-matches the
+    uninjected run."""
+    _, ref = _run(tmp_path, "ref50", n_steps=50)
+
+    plan = faults.FaultPlan(seed=50)
+    poison_steps = list(range(7, 50, 7))
+    for k in poison_steps:
+        plan.nan_poison("density", step=k)
+    runner, got = _run(tmp_path, "inj50", n_steps=50, plan=plan)
+
+    assert plan.fired("step.poison") == len(poison_steps)
+    assert runner.rollbacks == len(poison_steps)
+    assert got.tobytes() == ref.tobytes()
