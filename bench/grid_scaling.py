@@ -31,8 +31,23 @@ from jax.sharding import Mesh  # noqa: E402
 from dccrg_tpu.models.advection import GridAdvection  # noqa: E402
 
 
+_devices = None
+
+
+def _safe_device_list():
+    # hang-proof probe (ROUND6 gotcha): never call raw jax.devices()
+    # first from a bench script — a dead accelerator tunnel hangs it;
+    # probed once in a subprocess, then cached
+    global _devices
+    if _devices is None:
+        from dccrg_tpu.resilience import safe_devices
+
+        _devices = safe_devices(timeout=120, retries=1, platform="cpu")
+    return _devices
+
+
 def run_once(n, nz, n_dev, steps):
-    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("dev",))
+    mesh = Mesh(np.array(_safe_device_list()[:n_dev]), ("dev",))
     s = GridAdvection(n=n, nz=nz, mesh=mesh)
     dt = 0.5 * s.max_time_step()
     s.run(1, dt)

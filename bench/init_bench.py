@@ -114,14 +114,21 @@ def time_multi_device_init(n, n_dev):
 
     from dccrg_tpu.grid import DEFAULT_NEIGHBORHOOD_ID
 
-    if len(jax.devices()) < n_dev:
+    # probe through the hang-proof subprocess path (ROUND6 gotcha: a
+    # wedged accelerator tunnel survives SIGTERM; raw jax.devices()
+    # can block forever even when this script targets the CPU backend
+    # via a pre-imported, mis-pointed jax)
+    from dccrg_tpu.resilience import safe_devices
+
+    devices = safe_devices(timeout=120, retries=1, platform="cpu")
+    if len(devices) < n_dev:
         raise RuntimeError(
-            f"--devices {n_dev} requested but only {len(jax.devices())} "
+            f"--devices {n_dev} requested but only {len(devices)} "
             "devices exist (inherited XLA_FLAGS already pins "
             "xla_force_host_platform_device_count?)"
         )
     out = []
-    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("dev",))
+    mesh = Mesh(np.array(devices[:n_dev]), ("dev",))
     for part in ("block", "morton"):
         t0 = time.time()
         g = (

@@ -32,6 +32,8 @@ from .grid import (DEFAULT_NEIGHBORHOOD_ID, Grid, SlotwiseKernel,
                    default_mesh)
 from .dense import DenseGrid, dense_mesh
 from .verify import VerificationError, verify_all
+from .txn import (GridInvariantError, MutationAbortedError, MutationError,
+                  grid_transaction)
 from .faults import FaultPlan
 from .resilience import (CheckpointCorruptionError, DeviceProbeError,
                          NumericsError, ResilienceExhaustedError,
@@ -57,6 +59,10 @@ __all__ = [
     "dense_mesh",
     "VerificationError",
     "verify_all",
+    "GridInvariantError",
+    "MutationAbortedError",
+    "MutationError",
+    "grid_transaction",
     "FaultPlan",
     "CheckpointCorruptionError",
     "DeviceProbeError",

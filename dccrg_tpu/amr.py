@@ -30,6 +30,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from . import faults
 from .mapping import Mapping
 from .topology import GridTopology
 
@@ -344,6 +345,10 @@ def resolve_adaptation(
         for kids0 in removed.reshape(-1, 8) if len(removed) else []:
             for k in kids0:
                 weights.pop(int(k), None)
+
+    # the pins/weights dicts were just mutated IN PLACE (inheritance):
+    # a fault here pins that the transaction snapshot restores them
+    faults.fire("adapt.resolve", phase="pins")
 
     return AmrResult(
         cells=new_cells_all[order],

@@ -68,6 +68,13 @@ class InjectedProbeHang(TimeoutError):
     """Injected device-probe timeout (a dead accelerator tunnel)."""
 
 
+class InjectedMutationError(RuntimeError):
+    """Injected failure inside a structural mutation (AMR commit, load
+    balance, plan rebuild). The transactional layer in txn.py must
+    catch it, roll the grid back to the pre-mutation snapshot and
+    re-raise as MutationAbortedError — the atomicity tests pin that."""
+
+
 @dataclass
 class _Rule:
     site: str
@@ -79,12 +86,36 @@ class _Rule:
     def matches(self, site: str, ctx: dict) -> bool:
         if self.site != site or self.fired >= self.times:
             return False
-        for key in ("mode", "step"):
+        for key in ("mode", "step", "phase"):
             want = self.params.get(key)
             if want is not None and ctx.get(key) != want:
                 return False
         return True
 
+
+# Canonical (site, phase) fault points of the transactional mutation
+# paths, grouped by the mutation that reaches them — THE single table
+# the fuzzer (fuzz._FAULT_SITES) and the per-point atomicity tests
+# (tests/test_txn.py) both consume, so a newly instrumented
+# ``fire(site, phase=...)`` call only needs registering here to be
+# exercised everywhere.
+MUTATION_FAULT_SITES = {
+    "adapt": (
+        ("adapt.commit", "resolve"), ("adapt.commit", "resolved"),
+        ("adapt.commit", "preserved"), ("adapt.resolve", "pins"),
+        ("grid.restructure", "planned"), ("grid.restructure", "moved"),
+        ("hybrid.recommit", "classified"), ("hybrid.recommit", "cached"),
+    ),
+    "balance": (
+        ("partition.compute", None), ("balance.commit", "partition"),
+        ("balance.commit", "stage"), ("balance.commit", "finish"),
+        ("balance.commit", "land"), ("grid.restructure", "planned"),
+        ("grid.restructure", "moved"),
+        # a balance on a REFINED grid rebuilds through the hybrid
+        # builder too — its fault points are reachable from both paths
+        ("hybrid.recommit", "classified"), ("hybrid.recommit", "cached"),
+    ),
+}
 
 _active: "FaultPlan | None" = None
 
@@ -151,6 +182,26 @@ class FaultPlan:
         """Device probe times out (dead accelerator tunnel)."""
         return self._add("device.probe", "hang", times)
 
+    def mutation_error(self, site="adapt.commit", times=1, phase=None):
+        """Fault inside a structural mutation. Sites (each names where
+        in the commit the failure lands; ``phase`` narrows to one):
+
+        - ``adapt.commit``     — stop_refining (phases ``resolve``,
+                                 ``resolved``, ``preserved``)
+        - ``adapt.resolve``    — end of resolve_adaptation, after the
+                                 pins/weights inheritance (phase ``pins``)
+        - ``grid.restructure`` — plan rebuild + data move, shared by
+                                 adapt and balance (phases ``planned``,
+                                 ``moved``)
+        - ``balance.commit``   — balance_load stages (phases
+                                 ``partition``, ``stage``, ``finish``,
+                                 ``land``)
+        - ``hybrid.recommit``  — the hybrid plan builder for refined
+                                 grids (phases ``classified``, ``cached``)
+        - ``partition.compute``— inside the SFC partitioner
+        """
+        return self._add(site, "mutation", times, phase=phase)
+
     # -- installation -------------------------------------------------
 
     def __enter__(self):
@@ -199,6 +250,9 @@ def fire(site: str, **ctx) -> None:
         raise SimulatedResourceExhausted(f"at {site} {ctx}")
     if rule.kind == "hang":
         raise InjectedProbeHang(f"injected probe timeout at {site}")
+    if rule.kind == "mutation":
+        raise InjectedMutationError(
+            f"injected mutation fault at {site} {ctx}".rstrip())
     raise AssertionError(f"rule kind {rule.kind!r} cannot fire at {site}")
 
 

@@ -1,0 +1,461 @@
+"""Seeded stateful fuzzing of structural grid mutations.
+
+Property-based testing of the *stateful* Grid API (the discipline
+Hypothesis calls rule-based state machines): a deterministic seeded
+driver applies random op sequences — refine/unrefine at random
+coordinates, load balances with random curves, checkpoint save/load
+round trips, halo exchanges, fused step loops, host writes, structure
+queries — and after EVERY op checks
+
+1. every grid invariant (:func:`dccrg_tpu.verify.verify_all`), and
+2. a slow pure-numpy **oracle**: an independent ``{cell id: value}``
+   mirror of the cell data, advanced with plain numpy (projection on
+   refine/unrefine, neighbor-sum steps recomputed through the numpy
+   reference engine), plus brute-force cross-checks of the structure
+   queries (``get_existing_cell`` resolved by scanning every cell's
+   index box; per-cell neighbor lists recomputed from scratch).
+
+With ``fault_rate > 0`` the fuzzer also injects a
+:class:`~dccrg_tpu.faults.FaultPlan` mutation fault at a random fault
+point before some mutations and asserts the transactional guarantee:
+the grid is bitwise either fully rolled back (checkpoint-bytes
+identical to the pre-op snapshot) or fully committed, and the retried
+mutation succeeds.
+
+Failures raise :class:`FuzzFailure` carrying the seed, op index, the
+recent op log and the offending cell ids — everything needed to
+replay: two runs with the same seed and config perform the identical
+op sequence.
+
+CLI::
+
+    python -m dccrg_tpu.fuzz --seed 0 --ops 200 [--fault-rate 0.3]
+    python -m dccrg_tpu.fuzz --seeds 25 --ops 40     # the CI sweep
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+from . import txn
+from .faults import MUTATION_FAULT_SITES, FaultPlan
+from .grid import DEFAULT_NEIGHBORHOOD_ID, Grid
+from .neighbors import _dedup_entries, _find_neighbors_of_numpy
+from .txn import MutationAbortedError, MutationError
+from .verify import VerificationError, format_cells, verify_all
+
+
+class FuzzFailure(AssertionError):
+    """An invariant or oracle cross-check failed during a fuzz run."""
+
+    def __init__(self, msg, seed=None, op_index=None, cells=(), log=()):
+        self.seed = seed
+        self.op_index = op_index
+        self.cells = tuple(int(c) for c in cells)
+        msg = (f"seed {seed} op {op_index}: {msg}"
+               + format_cells(self.cells))
+        if log:
+            msg += f" (recent ops: {'; '.join(list(log)[-6:])})"
+        super().__init__(msg)
+
+
+def _step_kernel(cell, nbr, offs, mask, *extra):
+    """Neighbor-averaging diffusion step, mirrored exactly by the
+    oracle: 0.5*self + 0.5*mean(neighbor entries)."""
+    import jax.numpy as jnp
+
+    cnt = jnp.maximum(jnp.sum(mask, axis=1), 1).astype(jnp.float32)
+    s = jnp.sum(jnp.where(mask, nbr["rho"], jnp.float32(0)), axis=1)
+    return {"rho": (jnp.float32(0.5) * cell["rho"]
+                    + jnp.float32(0.5) * s / cnt)}
+
+
+# fault points reachable from each mutation kind — the canonical
+# table lives next to the fire() sites (faults.py)
+_FAULT_SITES = MUTATION_FAULT_SITES
+
+_probed_devices = None
+
+
+def _default_devices():
+    """Device list via the hang-proof subprocess probe (ROUND6 gotcha:
+    raw jax.devices() can block forever on a wedged accelerator
+    tunnel, surviving SIGTERM), memoized — one probe per process, not
+    one per fuzzer."""
+    global _probed_devices
+    if _probed_devices is None:
+        from .resilience import safe_devices
+
+        _probed_devices = list(safe_devices(timeout=120, retries=1))
+    return _probed_devices
+
+
+class GridFuzzer:
+    """One deterministic fuzz run (see module docstring).
+
+    ``GridFuzzer(seed, ops=40).run()`` raises :class:`FuzzFailure` on
+    the first violated property; attributes afterwards:
+    ``ops_run``, ``faults_injected``, ``log`` (op trail).
+    """
+
+    # op weights: mutations dominate (they are what the harness hunts)
+    _OPS = ("refine", "unrefine", "balance", "set", "step",
+            "exchange", "checkpoint", "query")
+    _WEIGHTS = (0.20, 0.15, 0.13, 0.13, 0.13, 0.10, 0.08, 0.08)
+    _BALANCE_METHODS = ("morton", "hilbert", "rcb", "block")
+
+    def __init__(self, seed, *, ops=40, length=(4, 4, 2), max_lvl=1,
+                 n_dev=2, fault_rate=0.0, devices=None):
+        from jax.sharding import Mesh
+
+        self.seed = int(seed)
+        self.n_ops = int(ops)
+        self.rng = np.random.default_rng(self.seed)
+        self.fault_rate = float(fault_rate)
+        devs = list(devices if devices is not None else _default_devices())
+        self.mesh = Mesh(np.array(devs[:min(int(n_dev), len(devs))]),
+                         ("dev",))
+        self.grid = (
+            Grid(cell_data={"rho": np.float32})
+            .set_initial_length(length)
+            .set_maximum_refinement_level(int(max_lvl))
+            .set_periodic(True, True, True)
+            .set_neighborhood_length(1)
+            .set_geometry("cartesian", start=(0.0, 0.0, 0.0),
+                          level_0_cell_length=(1.0, 1.0, 1.0))
+            .initialize(self.mesh)
+        )
+        cells = self.grid.get_cells()
+        vals = self.rng.random(len(cells)).astype(np.float32)
+        self.grid.set("rho", cells, vals)
+        # the oracle: independent host mirror of every cell's value
+        self.oracle = {int(c): np.float32(v) for c, v in zip(cells, vals)}
+        self.log = []
+        self.ops_run = 0
+        self.faults_injected = 0
+
+    # -- driver -------------------------------------------------------
+
+    def run(self) -> "GridFuzzer":
+        self._check(0)
+        for i in range(1, self.n_ops + 1):
+            name = str(self.rng.choice(self._OPS, p=self._WEIGHTS))
+            try:
+                detail = getattr(self, "_op_" + name)()
+            except FuzzFailure:
+                raise
+            except MutationError as e:
+                raise FuzzFailure(
+                    f"unexpected mutation failure in {name}: {e}",
+                    seed=self.seed, op_index=i,
+                    cells=getattr(e, "cells", ()), log=self.log) from e
+            self.log.append(f"{i}:{name}" + (f"({detail})" if detail else ""))
+            self.ops_run = i
+            self._check(i)
+        return self
+
+    def _check(self, i):
+        """Invariants + oracle sweep after every op."""
+        try:
+            verify_all(self.grid, check_pins=False)
+        except VerificationError as e:
+            raise FuzzFailure(
+                f"invariant violated: {e}", seed=self.seed, op_index=i,
+                cells=getattr(e, "cells", ()), log=self.log) from e
+        cells = self.grid.get_cells()
+        if set(map(int, cells)) != set(self.oracle):
+            odd = set(map(int, cells)) ^ set(self.oracle)
+            raise FuzzFailure(
+                "grid cell set diverged from the oracle",
+                seed=self.seed, op_index=i, cells=sorted(odd)[:16],
+                log=self.log)
+        got = np.asarray(self.grid.get("rho", cells), dtype=np.float32)
+        want = np.array([self.oracle[int(c)] for c in cells],
+                        dtype=np.float32)
+        close = np.isclose(got, want, rtol=1e-4, atol=1e-5)
+        if not close.all():
+            raise FuzzFailure(
+                f"cell data diverged from the oracle "
+                f"(max err {np.abs(got - want).max():.3e})",
+                seed=self.seed, op_index=i,
+                cells=np.asarray(cells)[~close][:16], log=self.log)
+        # re-sync: keep sub-tolerance float drift from accumulating
+        for c, v in zip(cells, got):
+            self.oracle[int(c)] = np.float32(v)
+
+    # -- mutations (transactional, optionally fault-injected) ---------
+
+    def _guarded(self, kind, commit):
+        """Run a mutation to COMMITTED state. With probability
+        ``fault_rate`` a mutation fault is injected first; the abort
+        must leave the grid bitwise identical to the pre-op snapshot,
+        and the retry must succeed."""
+        if self.fault_rate and self.rng.random() < self.fault_rate:
+            sites = _FAULT_SITES[kind]
+            site, phase = sites[int(self.rng.integers(len(sites)))]
+            before = txn.grid_state_bytes(self.grid)
+            plan = FaultPlan(seed=int(self.rng.integers(1 << 31)))
+            plan.mutation_error(site=site, times=1, phase=phase)
+            aborted = False
+            try:
+                with plan:
+                    result = commit()
+            except MutationAbortedError:
+                aborted = True
+            if not aborted:
+                # the chosen site was not on this op's path (e.g. the
+                # hybrid builder on a still-uniform grid): committed
+                return result, f"fault:{site}:unreached"
+            self.faults_injected += 1
+            after = txn.grid_state_bytes(self.grid)
+            if after != before:
+                raise FuzzFailure(
+                    f"rollback after injected {site}/{phase} fault is "
+                    f"not bitwise identical", seed=self.seed,
+                    op_index=self.ops_run + 1, log=self.log)
+            return commit(), f"fault:{site}:rolled-back"
+        return commit(), ""
+
+    def _commit_adapt(self):
+        """stop_refining + data projection, mirrored in the oracle."""
+        g = self.grid
+        new, detail = self._guarded("adapt", g.stop_refining)
+        g.assign_children_from_parents()
+        g.average_parents_from_children()
+        removed = g.get_removed_cells()
+        if len(new):
+            parents = g.mapping.get_parent(new)
+            for c, p in zip(new, parents):
+                self.oracle[int(c)] = self.oracle[int(p)]
+            for p in np.unique(parents):
+                self.oracle.pop(int(p), None)
+        up = g._unrefined_parents
+        if len(up):
+            kids = g.mapping.get_all_children(up)  # [n, 8]
+            means = {
+                int(p): np.float32(np.mean(
+                    [self.oracle[int(k)] for k in ks], dtype=np.float32))
+                for p, ks in zip(up, kids)
+            }
+            for k in removed:
+                self.oracle.pop(int(k), None)
+            self.oracle.update(means)
+        g.clear_refined_unrefined_data()
+        return len(new), len(removed), detail
+
+    def _op_refine(self):
+        cells = self.grid.get_cells()
+        cid = int(cells[self.rng.integers(len(cells))])
+        if not self.grid.refine_completely(cid):
+            return f"{cid}:at-max-level"
+        n_new, _n_rm, detail = self._commit_adapt()
+        return f"{cid}:+{n_new}" + (f":{detail}" if detail else "")
+
+    def _op_unrefine(self):
+        g = self.grid
+        cells = g.get_cells()
+        lvls = g.mapping.get_refinement_level(cells)
+        fine = np.asarray(cells)[lvls > 0]
+        if len(fine) == 0:
+            return "no-fine-cells"
+        cid = int(fine[self.rng.integers(len(fine))])
+        if not g.unrefine_completely(cid):
+            return f"{cid}:rejected"
+        _n_new, n_rm, detail = self._commit_adapt()
+        return f"{cid}:-{n_rm}" + (f":{detail}" if detail else "")
+
+    def _op_balance(self):
+        method = str(self.rng.choice(self._BALANCE_METHODS))
+        self.grid.set_load_balancing_method(method)
+        _res, detail = self._guarded("balance", self.grid.balance_load)
+        return method + (f":{detail}" if detail else "")
+
+    # -- data ops ------------------------------------------------------
+
+    def _op_set(self):
+        cells = np.asarray(self.grid.get_cells())
+        k = int(self.rng.integers(1, max(2, len(cells) // 2)))
+        pick = self.rng.choice(len(cells), size=k, replace=False)
+        vals = self.rng.random(k).astype(np.float32)
+        self.grid.set("rho", cells[pick], vals)
+        for c, v in zip(cells[pick], vals):
+            self.oracle[int(c)] = np.float32(v)
+        return f"{k} cells"
+
+    def _op_step(self):
+        """One fused exchange+stencil step; the oracle advances through
+        the numpy reference engine over the SAME dedup'd entry stream
+        the gather tables were built from."""
+        g = self.grid
+        cells = g.plan.cells
+        vals = np.array([self.oracle[int(c)] for c in cells],
+                        dtype=np.float32)
+        src, nbr, _off, _item = _dedup_entries(
+            g.mapping, cells, *_find_neighbors_of_numpy(
+                g.mapping, g.topology, cells, cells,
+                g.neighborhoods[DEFAULT_NEIGHBORHOOD_ID]))
+        acc = np.zeros(len(cells), dtype=np.float32)
+        cnt = np.zeros(len(cells), dtype=np.float32)
+        np.add.at(acc, src, vals[np.searchsorted(cells, nbr)])
+        np.add.at(cnt, src, np.float32(1))
+        expected = (np.float32(0.5) * vals
+                    + np.float32(0.5) * acc / np.maximum(cnt, 1))
+        g.run_steps(_step_kernel, ["rho"], ["rho"], 1)
+        for c, v in zip(cells, expected):
+            self.oracle[int(c)] = np.float32(v)
+        return ""
+
+    def _op_exchange(self):
+        """Halo exchange; every ghost row must then hold the owner's
+        value (read straight from the sharded arrays)."""
+        g = self.grid
+        g.update_copies_of_remote_neighbors()
+        host = np.asarray(g.data["rho"])
+        L = g.plan.L
+        for d in range(g.n_dev):
+            gids = g.plan.ghost_ids[d]
+            if not len(gids):
+                continue
+            want = np.array([self.oracle[int(c)] for c in gids],
+                            dtype=np.float32)
+            got = host[d, L:L + len(gids)]
+            close = np.isclose(got, want, rtol=1e-4, atol=1e-5)
+            if not close.all():
+                raise FuzzFailure(
+                    f"ghost rows on device {d} diverged after exchange",
+                    seed=self.seed, op_index=self.ops_run + 1,
+                    cells=gids[~close][:16], log=self.log)
+        return ""
+
+    def _op_checkpoint(self):
+        """Save/load round trip into the live grid; bytes must be
+        stable across an immediate re-save."""
+        g = self.grid
+        fd, path = tempfile.mkstemp(suffix=".dc", prefix="dccrg_fuzz_")
+        os.close(fd)
+        try:
+            g.save_grid_data(path)
+            with open(path, "rb") as f:
+                first = f.read()
+            g.load_grid_data(path)
+            g.save_grid_data(path)
+            with open(path, "rb") as f:
+                second = f.read()
+        finally:
+            os.unlink(path)
+        if first != second:
+            raise FuzzFailure(
+                "checkpoint round trip is not byte-stable",
+                seed=self.seed, op_index=self.ops_run + 1, log=self.log)
+        return f"{len(first)}B"
+
+    # -- structure queries vs brute-force oracle ----------------------
+
+    def _op_query(self):
+        g = self.grid
+        # 1. get_existing_cell vs scanning every cell's index box
+        ilen = g.mapping.get_index_length().astype(np.float64)
+        scale = float(1 << g.mapping.max_refinement_level)
+        coord = tuple(
+            (self.rng.integers(int(ilen[d])) + self.rng.uniform(0.15, 0.85))
+            / scale
+            for d in range(3)
+        )
+        got = int(g.get_existing_cell(coord))
+        want = self._oracle_existing_cell(coord)
+        if got != want:
+            raise FuzzFailure(
+                f"get_existing_cell({coord}) = {got}, oracle says {want}",
+                seed=self.seed, op_index=self.ops_run + 1,
+                cells=[c for c in (got, want) if c], log=self.log)
+        # 2. per-cell neighbor list vs fresh numpy recomputation
+        cells = g.plan.cells
+        cid = cells[self.rng.integers(len(cells))]
+        got_n = {(int(n), o) for n, o in g.get_neighbors_of(int(cid))}
+        src, nbr, off, _item = _dedup_entries(
+            g.mapping, np.asarray([cid], dtype=np.uint64),
+            *_find_neighbors_of_numpy(
+                g.mapping, g.topology, cells,
+                np.asarray([cid], dtype=np.uint64),
+                g.neighborhoods[DEFAULT_NEIGHBORHOOD_ID]))
+        want_n = {(int(n), tuple(int(x) for x in o))
+                  for n, o in zip(nbr, off)}
+        if got_n != want_n:
+            odd = {c for c, _o in got_n ^ want_n}
+            raise FuzzFailure(
+                f"get_neighbors_of({int(cid)}) diverged from the "
+                f"numpy oracle", seed=self.seed,
+                op_index=self.ops_run + 1, cells=sorted(odd)[:16],
+                log=self.log)
+        return ""
+
+    def _oracle_existing_cell(self, coordinate) -> int:
+        """Brute force: the unique leaf whose index box contains the
+        coordinate, by scanning EVERY cell (unit level-0 cells at the
+        origin, so physical coordinate * 2^max_lvl = smallest-cell
+        index)."""
+        g = self.grid
+        cells = g.plan.cells
+        idx = g.mapping.get_indices(cells).astype(np.int64)
+        lvl = g.mapping.get_refinement_level(cells).astype(np.int64)
+        size = (1 << (g.mapping.max_refinement_level - lvl))[:, None]
+        p = np.asarray(coordinate, dtype=np.float64) * float(
+            1 << g.mapping.max_refinement_level)
+        inside = ((idx <= p) & (p < idx + size)).all(axis=1)
+        hits = cells[inside]
+        return int(hits[0]) if len(hits) else 0
+
+
+# -- CLI --------------------------------------------------------------
+
+def _main(argv=None) -> int:
+    """``python -m dccrg_tpu.fuzz --seed N --ops M`` — run one (or
+    ``--seeds K``: seeds 0..K-1) deterministic fuzz run and report."""
+    import argparse
+    import time
+
+    ap = argparse.ArgumentParser(description=_main.__doc__)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--seeds", type=int, default=None,
+                    help="sweep seeds 0..K-1 instead of --seed")
+    ap.add_argument("--ops", type=int, default=40)
+    ap.add_argument("--fault-rate", type=float, default=0.0)
+    ap.add_argument("--length", type=int, nargs=3, default=(4, 4, 2))
+    ap.add_argument("--max-level", type=int, default=1)
+    ap.add_argument("--devices", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    seeds = range(args.seeds) if args.seeds is not None else [args.seed]
+    t0 = time.time()
+    total_faults = 0
+    for s in seeds:
+        try:
+            fz = GridFuzzer(
+                s, ops=args.ops, length=tuple(args.length),
+                max_lvl=args.max_level, n_dev=args.devices,
+                fault_rate=args.fault_rate,
+            ).run()
+        except FuzzFailure as e:
+            print(f"FAIL {e}")
+            return 1
+        total_faults += fz.faults_injected
+        print(f"seed {s}: {fz.ops_run} ops ok"
+              + (f", {fz.faults_injected} fault(s) rolled back"
+                 if fz.faults_injected else ""))
+    print(f"OK {len(list(seeds))} seed(s) x {args.ops} ops, "
+          f"{total_faults} injected fault(s), {time.time() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via CLI
+    # standalone gotcha (ROUND6_NOTES): the image's site hook may have
+    # pre-imported jax pointed at a dead accelerator tunnel; force the
+    # CPU backend AFTER import unless the caller opted out
+    if os.environ.get("DCCRG_FUZZ_BACKEND", "cpu") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    raise SystemExit(_main())

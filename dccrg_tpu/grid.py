@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .compat import shard_map as _shard_map
 
+from . import faults
 from .geometry import CartesianGeometry, NoGeometry, StretchedCartesianGeometry
 from .mapping import Mapping
 from .neighbors import (
@@ -53,6 +54,7 @@ from .partition import (
     partition_cells_hierarchical,
 )
 from .topology import GridTopology
+from .txn import grid_transaction
 from .types import ERROR_CELL
 from . import uniform as uniform_mod
 
@@ -671,6 +673,7 @@ class Grid:
         # that lands in the same buckets reuses every compiled program
         self._program_cache = {}
         self._pending = {}
+        self._txn_depth = 0  # reentrancy counter (txn.grid_transaction)
         self._debug = os.environ.get("DCCRG_DEBUG") == "1"
         # extensible iteration-cache items (dccrg.hpp:7404-7518)
         self._cell_items = {}
@@ -1114,7 +1117,11 @@ class Grid:
         # continuous self-checking, like the reference's DEBUG builds
         # (dccrg.hpp:12454-13036). User data is still mid-migration at
         # this point; _restructure/_allocate_fields check it after.
-        if self._debug:
+        # Inside a transaction the post-commit verify_all covers these
+        # same checks (and more) on the final state — skip the
+        # mid-commit pass rather than paying the O(grid) neighbor
+        # recompute twice per mutation.
+        if self._debug and not getattr(self, "_txn_depth", 0):
             from . import verify as _verify
 
             _verify.is_consistent(self)
@@ -2986,10 +2993,16 @@ class Grid:
         """Repartition cells over devices and move their data: the
         reference's balance_load (dccrg.hpp:1046). ``use_zoltan=False``
         keeps the partition from pin requests only (parity with the
-        reference's flag)."""
-        self.initialize_balance_load(use_zoltan)
-        self.continue_balance_load()
-        self.finish_balance_load()
+        reference's flag).
+
+        Atomic: the three stages run in ONE transaction — a failure
+        in any of them rolls the whole balance back
+        (:class:`~dccrg_tpu.txn.MutationAbortedError`) and the grid
+        keeps its previous partition, data placement and staging."""
+        with grid_transaction(self, op="balance_load"):
+            self.initialize_balance_load(use_zoltan)
+            self.continue_balance_load()
+            self.finish_balance_load()
 
     def initialize_balance_load(self, use_zoltan: bool = True) -> None:
         """Stage 1: compute the new partition (dccrg.hpp:3770-3909).
@@ -2998,6 +3011,10 @@ class Grid:
         pins with Zoltan output (dccrg.hpp:8552-8576)."""
         if getattr(self, "_pending_owner", None) is not None:
             raise RuntimeError("balance_load already initialized")
+        with grid_transaction(self, op="initialize_balance_load"):
+            self._initialize_balance_load_impl(use_zoltan)
+
+    def _initialize_balance_load_impl(self, use_zoltan: bool) -> None:
         self._staged_balance = {}
         cells = self.plan.cells
         if use_zoltan:
@@ -3047,6 +3064,7 @@ class Grid:
                 pos = np.searchsorted(cells, np.uint64(cid))
                 if pos < len(cells) and cells[pos] == np.uint64(cid):
                     new_owner[pos] = dest
+        faults.fire("balance.commit", phase="partition")
         self._pending_owner = new_owner
 
     def continue_balance_load(self, fields=None) -> None:
@@ -3061,20 +3079,27 @@ class Grid:
         by any continue call move atomically at finish."""
         if getattr(self, "_pending_owner", None) is None:
             raise RuntimeError("initialize_balance_load not called")
-        moving = self.plan.cells[self._pending_owner != self.plan.owner]
         names = list(fields) if fields is not None else list(self.fields)
         for n in names:
             if n not in self.fields:
                 raise KeyError(f"unknown field {n!r}")
-            # DEVICE-side staging: jax arrays are immutable, so the
-            # stage is a zero-copy snapshot reference — the captured
-            # version survives later set()s (which install new arrays)
-            # and the landing at finish is an on-device gather; moved
-            # payloads never leave HBM (the reference moves balance
-            # payloads rank-to-rank, dccrg.hpp:3932-3964)
-            self._staged_balance[n] = (
-                moving.copy(), self.data[n] if len(moving) else None
-            )
+        # validate=False: staging only captures snapshot references in
+        # _staged_balance — no structure the verifiers check can change,
+        # so the (repeatable) stage skips the O(grid) debug validation
+        with grid_transaction(self, op="continue_balance_load",
+                              validate=False):
+            faults.fire("balance.commit", phase="stage")
+            moving = self.plan.cells[self._pending_owner != self.plan.owner]
+            for n in names:
+                # DEVICE-side staging: jax arrays are immutable, so the
+                # stage is a zero-copy snapshot reference — the captured
+                # version survives later set()s (which install new arrays)
+                # and the landing at finish is an on-device gather; moved
+                # payloads never leave HBM (the reference moves balance
+                # payloads rank-to-rank, dccrg.hpp:3932-3964)
+                self._staged_balance[n] = (
+                    moving.copy(), self.data[n] if len(moving) else None
+                )
 
     def staged_balance_data(self, field: str):
         """(moving cell ids, values) captured by continue_balance_load
@@ -3103,10 +3128,16 @@ class Grid:
     def finish_balance_load(self) -> None:
         """Stage 3: install the new partition, rebuild all derived
         structure (dccrg.hpp:3980-4182), and land the staged field
-        groups at their destinations."""
-        new_owner = getattr(self, "_pending_owner", None)
-        if new_owner is None:
+        groups at their destinations. Atomic: a failure rolls back to
+        the staged (post-continue) state, so finish can be retried."""
+        if getattr(self, "_pending_owner", None) is None:
             raise RuntimeError("initialize_balance_load not called")
+        with grid_transaction(self, op="finish_balance_load"):
+            self._finish_balance_load_impl()
+
+    def _finish_balance_load_impl(self) -> None:
+        new_owner = self._pending_owner
+        faults.fire("balance.commit", phase="finish")
         moved = self.plan.cells[new_owner != self.plan.owner]
         # per-device view of the movement (reference
         # get_cells_added/removed_by_balance_load, dccrg.hpp)
@@ -3129,6 +3160,7 @@ class Grid:
                    for n, (ids, snap) in staged.items() if snap is not None}
         old_R = self.plan.R
         self._restructure(self.plan.cells.copy(), new_owner)
+        faults.fire("balance.commit", phase="land")
         if self._debug:
             from . import verify as _verify
 
@@ -3396,51 +3428,63 @@ class Grid:
         """Commit all refinement requests; returns the created cells
         (dccrg.hpp:3483-3507). Data of refined parents and removed
         cells stays readable through get_old_data() until
-        clear_refined_unrefined_data()."""
+        clear_refined_unrefined_data().
+
+        Atomic: a failure anywhere inside the commit (including
+        injected faults) rolls the grid — requests included — back to
+        its pre-commit state and re-raises as
+        :class:`~dccrg_tpu.txn.MutationAbortedError`; retrying the
+        commit is then safe. With ``DCCRG_DEBUG=1`` the committed
+        state is verified and rolled back on a broken invariant
+        (:class:`~dccrg_tpu.txn.GridInvariantError`)."""
         from .amr import resolve_adaptation
 
-        res = resolve_adaptation(
-            self.mapping,
-            self.plan.cells,
-            self.plan.owner,
-            self.neighborhoods[DEFAULT_NEIGHBORHOOD_ID],
-            self._refines,
-            self._unrefines,
-            self._dont_refines,
-            self._dont_unrefines,
-            pins=self._pins,
-            weights=self._weights,
-            topology=self.topology,
-            hood_len=self._hood_len,
-        )
-        self._refines.clear()
-        self._unrefines.clear()
-        self._dont_refines.clear()
-        self._dont_unrefines.clear()
+        with grid_transaction(self, op="stop_refining"):
+            faults.fire("adapt.commit", phase="resolve")
+            res = resolve_adaptation(
+                self.mapping,
+                self.plan.cells,
+                self.plan.owner,
+                self.neighborhoods[DEFAULT_NEIGHBORHOOD_ID],
+                self._refines,
+                self._unrefines,
+                self._dont_refines,
+                self._dont_unrefines,
+                pins=self._pins,
+                weights=self._weights,
+                topology=self.topology,
+                hood_len=self._hood_len,
+            )
+            faults.fire("adapt.commit", phase="resolved")
+            self._refines.clear()
+            self._unrefines.clear()
+            self._dont_refines.clear()
+            self._dont_unrefines.clear()
 
-        # preserve data of disappearing cells for the app's projection
-        old_ids = np.concatenate([res.refined_parents, res.removed_cells])
-        self._removed_data = {}
-        if len(old_ids):
-            # gather the disappearing cells' rows ON DEVICE and pull
-            # only that slice (not every field's full array), through
-            # the psum gather whose replicated (structure-derived) args
-            # make it consistent across processes too; the sticky cap
-            # keeps the program from retracing per epoch
-            dev, rows = self._host_rows(old_ids)
-            capn = self._sticky_cap("removed", len(old_ids))
-            for name in self.fields:
-                self._removed_data[name] = (
-                    old_ids, self._device_gather(name, dev, rows, cap=capn)
-                )
-        else:
-            self._removed_data = {name: (old_ids, None) for name in self.fields}
-        self._removed_cells = res.removed_cells
-        self._new_cells = res.new_cells
-        self._unrefined_parents = res.unrefined_parents
+            # preserve data of disappearing cells for the app's projection
+            old_ids = np.concatenate([res.refined_parents, res.removed_cells])
+            self._removed_data = {}
+            if len(old_ids):
+                # gather the disappearing cells' rows ON DEVICE and pull
+                # only that slice (not every field's full array), through
+                # the psum gather whose replicated (structure-derived) args
+                # make it consistent across processes too; the sticky cap
+                # keeps the program from retracing per epoch
+                dev, rows = self._host_rows(old_ids)
+                capn = self._sticky_cap("removed", len(old_ids))
+                for name in self.fields:
+                    self._removed_data[name] = (
+                        old_ids, self._device_gather(name, dev, rows, cap=capn)
+                    )
+            else:
+                self._removed_data = {name: (old_ids, None) for name in self.fields}
+            faults.fire("adapt.commit", phase="preserved")
+            self._removed_cells = res.removed_cells
+            self._new_cells = res.new_cells
+            self._unrefined_parents = res.unrefined_parents
 
-        self._restructure(res.cells, res.owner)
-        return res.new_cells.copy()
+            self._restructure(res.cells, res.owner)
+            return res.new_cells.copy()
 
     def _restructure(self, new_cells, new_owner):
         """Rebuild the plan for a new cell set, carrying over the data
@@ -3464,6 +3508,7 @@ class Grid:
         old_flat = old_dev.astype(np.int64) * old_R + old_rows
 
         self._build_plan(new_cells, new_owner)
+        faults.fire("grid.restructure", phase="planned")
         new_dev, new_rows = self._host_rows(surviving)
         new_flat = new_dev.astype(np.int64) * self.plan.R + new_rows
 
@@ -3512,8 +3557,11 @@ class Grid:
                 self.data[name] = jnp.asarray(
                     arr.reshape((self.n_dev, self.plan.R) + shape), device=sh
                 )
+        faults.fire("grid.restructure", phase="moved")
 
-        if self._debug:
+        # covered by the transaction's post-commit verify_all when one
+        # is active (every mutation path); kept for direct callers
+        if self._debug and not getattr(self, "_txn_depth", 0):
             from . import verify as _verify
 
             _verify.verify_user_data(self)
@@ -3573,16 +3621,18 @@ class Grid:
 
         cells = np.sort(np.asarray(cells, dtype=np.uint64))
         verify_tiling(self.mapping, cells)
-        owner = partition_cells(
-            self.mapping, cells, self.n_dev, self._lb_method, pins=self._pins or None
-        )
-        self._cells_epoch = getattr(self, "_cells_epoch", 0) + 1
-        self._build_plan(cells, owner)
-        self._allocate_fields()
-        if self._debug:
-            from . import verify as _verify
+        with grid_transaction(self, op="load_cells"):
+            owner = partition_cells(
+                self.mapping, cells, self.n_dev, self._lb_method,
+                pins=self._pins or None
+            )
+            self._cells_epoch = getattr(self, "_cells_epoch", 0) + 1
+            self._build_plan(cells, owner)
+            self._allocate_fields()
+            if self._debug:
+                from . import verify as _verify
 
-            _verify.pin_requests_succeeded(self)
+                _verify.pin_requests_succeeded(self)
 
     # -- VTK output (dccrg.hpp:3320-3392) ------------------------------
 

@@ -43,6 +43,8 @@ import time
 
 import numpy as np
 
+from . import faults
+
 
 def _phase_timer():
     """Phase-boundary logger, enabled with DCCRG_TIMING=1."""
@@ -229,6 +231,7 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     hard_pos.sort(kind="stable")
     hard_cells = cells[hard_pos]
     mark(f"classify (hard {len(hard_pos)}/{n})")
+    faults.fire("hybrid.recommit", phase="classified")
 
     # --- hard streams (generic engine on the hard shell) --------------
     # Epoch-to-epoch reuse: a hard cell whose whole search box is
@@ -323,6 +326,9 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     if reuse is not None:
         reuse.clear()
         reuse.update(new_cache)
+    # the reuse cache was just swapped IN PLACE: a fault here pins that
+    # the transaction snapshot restores its previous contents too
+    faults.fire("hybrid.recommit", phase="cached")
     mark(f"hard streams (reused {0 if reusable is None else len(reusable)}"
          f"/{len(hard_cells)})")
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from . import faults
 from .mapping import Mapping
 
 PARTITION_METHODS = ("block", "morton", "hilbert", "rcb", "cut")
@@ -433,6 +434,7 @@ def partition_cells(
     n = len(cells)
     if method not in PARTITION_METHODS:
         raise ValueError(f"unknown partition method {method!r}, have {PARTITION_METHODS}")
+    faults.fire("partition.compute", mode=method)
 
     if weights is not None:
         w = np.asarray(weights, dtype=np.float64)

@@ -173,6 +173,15 @@ def read_sidecar(filename: str):
                 or not isinstance(crcs, list)
                 or not all(isinstance(c, int) for c in crcs)):
             raise ValueError("implausible sidecar geometry")
+        # the crc list must cover the whole recorded file: a sidecar
+        # whose tail entries were lost (still valid JSON) would
+        # otherwise leave trailing payload chunks silently unverified
+        # (_bad_chunks zips against the shorter list)
+        want_chunks = 1 + max(0, -(-(fb - ps) // cb))
+        if len(crcs) != want_chunks:
+            raise ValueError(
+                f"sidecar records {len(crcs)} chunk crc(s), geometry "
+                f"implies {want_chunks}")
         return rec
     except (ValueError, KeyError, TypeError) as e:
         raise CheckpointCorruptionError(
@@ -254,10 +263,33 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
             # this window leaves the new file with no sidecar — which
             # strict load refuses conservatively — never a new file
             # paired with a stale record (which would reject or
-            # destructively 'salvage' an intact checkpoint)
+            # destructively 'salvage' an intact checkpoint). Keep the
+            # old record's bytes: if the rename itself fails, the OLD
+            # checkpoint is still the intact one under the final name
+            # and must stay verifiable for rollback.
+            old_side = None
             if os.path.exists(side):
+                with open(side, "rb") as f:
+                    old_side = f.read()
                 os.unlink(side)
-            os.replace(tmp, filename)
+            try:
+                os.replace(tmp, filename)
+            except OSError:
+                if old_side is not None:
+                    # atomic restore (same tmp+fsync+rename discipline
+                    # as _write_sidecar_record), best effort: a torn
+                    # restore must not shadow the original failure,
+                    # and a missing sidecar is the conservative state
+                    try:
+                        rtmp = side + f".tmp.{os.getpid()}"
+                        with open(rtmp, "wb") as f:
+                            f.write(old_side)
+                            f.flush()
+                            os.fsync(f.fileno())
+                        os.replace(rtmp, side)
+                    except OSError:  # pragma: no cover - double fault
+                        pass
+                raise
             _fsync_dir(os.path.dirname(os.path.abspath(filename)))
             break
         except OSError as e:
@@ -683,10 +715,11 @@ class ResilientRunner:
         self.trips.append(bundle)
         return bundle
 
-    def _trip(self) -> None:
+    def _trip(self, details=None) -> None:
         from . import verify
 
-        details = verify.find_nonfinite_cells(self.grid, self.fields)
+        if details is None:
+            details = verify.find_nonfinite_cells(self.grid, self.fields)
         if self.step > self._streak_step:
             self._retry_streak = 0  # progress since the last trip
         self._streak_step = self.step
@@ -712,10 +745,29 @@ class ResilientRunner:
         """Advance to ``n_steps`` total steps, recovering as needed.
         Returns self (``.step``, ``.trips``, ``.rollbacks``,
         ``.checkpoints`` carry the story)."""
+        from .txn import MutationAbortedError
+
         if self._ckpt_step is None:
             self._save()  # rollback target always exists
         while self.step < n_steps:
-            self.step_fn(self.grid, self.step)
+            try:
+                self.step_fn(self.grid, self.step)
+            except MutationAbortedError as e:
+                # a structural mutation inside the step (adapt /
+                # balance) failed and already rolled itself back;
+                # recover like a watchdog trip: diagnostics, rollback
+                # to the last checkpoint, bounded retry
+                logger.warning("step %d: %s", self.step, e)
+                self._trip(details={"mutation": np.asarray(
+                    e.cells, dtype=np.uint64)})
+                continue
+            except NumericsError as e:
+                # the DCCRG_WATCHDOG hook inside run_steps tripped
+                # mid-step: same recovery as the runner's own check
+                # (it already names the offending fields and cells)
+                logger.warning("step %d: %s", self.step, e)
+                self._trip(details=e.details if e.details else None)
+                continue
             self.step += 1
             faults.poison_step(self.grid, self.step)
             ckpt_due = self.step % self.checkpoint_every == 0

@@ -1,0 +1,223 @@
+"""Transactional grid mutations.
+
+The reference guards every structural mutation (refinement commit,
+induced 2:1 balancing, load balancing) with ``#ifdef DEBUG`` invariant
+checkers because a half-applied mutation silently corrupts neighbor
+lists, and every later halo exchange then moves garbage. This module
+makes the mutation paths of :class:`~dccrg_tpu.grid.Grid` **atomic**:
+
+    with grid_transaction(grid, op="stop_refining"):
+        ... mutate cells / owners / plan / field arrays ...
+
+- On entry the minimal mutable structural state is snapshotted: the
+  plan reference (plans are replaced wholesale, never edited in
+  place), the field-array dict (jax arrays are immutable, so the
+  snapshot is a dict of references), the AMR request sets, the staged
+  balance state, pins/weights (``resolve_adaptation`` mutates them in
+  place for inheritance), capacity memos, and the hybrid builder's
+  epoch-reuse cache (``build_hybrid_plan`` swaps its contents in
+  place).
+- Any exception — including injected :class:`~dccrg_tpu.faults`
+  faults — restores every snapshotted attribute and re-raises as
+  :class:`MutationAbortedError` with the original failure as
+  ``__cause__``. The grid is then bitwise identical to its
+  pre-mutation state (pinned by tests/test_txn.py via checkpoint-bytes
+  comparison) and the same mutation can simply be retried: the
+  request sets were part of the snapshot.
+- On successful commit, when ``DCCRG_DEBUG=1`` (or
+  ``validate=True``), ``verify_all`` runs against the NEW state; a
+  broken invariant rolls back too and raises
+  :class:`GridInvariantError` naming the offending cells — the
+  runtime equivalent of XLA running HloVerifier after every transform.
+
+Transactions are reentrant: the composite ``balance_load`` opens one
+transaction and its three stages (each transactional on its own for
+the staged multi-phase API) join it, so a fault anywhere inside rolls
+back the whole balance.
+
+Only HOST state is snapshotted, and only by reference or one-level
+copy — no field payload is copied, so a transaction costs O(#cells
+dict entries), not O(data). That relies on two properties the rest of
+the codebase maintains: jax arrays are immutable (a "write" installs a
+new array into ``grid.data``), and plan/numpy structure arrays are
+rebuilt, never edited in place, by every mutation path.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from contextlib import contextmanager
+
+from . import verify as verify_mod
+
+
+class MutationError(RuntimeError):
+    """Base of the mutation-boundary error hierarchy. ``cells`` names
+    the offending cell ids when known (empty tuple otherwise)."""
+
+    def __init__(self, msg: str, cells=()):
+        self.cells = tuple(int(c) for c in cells)
+        super().__init__(msg + verify_mod.format_cells(self.cells))
+
+
+class MutationAbortedError(MutationError):
+    """A structural mutation failed mid-flight and the grid was rolled
+    back to its pre-mutation state. ``op`` names the mutation, the
+    original failure is ``__cause__``; the pending requests survived
+    the rollback, so the same mutation can be retried."""
+
+    def __init__(self, op: str, cause: BaseException, cells=()):
+        self.op = op
+        super().__init__(
+            f"{op} aborted, grid rolled back "
+            f"({type(cause).__name__}: {cause})", cells=cells)
+
+
+class GridInvariantError(MutationError):
+    """Post-commit validation found a broken grid invariant; the
+    commit was rolled back. The underlying
+    :class:`~dccrg_tpu.verify.VerificationError` is ``__cause__``."""
+
+    def __init__(self, op: str, cause: BaseException, cells=()):
+        self.op = op
+        super().__init__(
+            f"{op} violated a grid invariant, commit rolled back "
+            f"({cause})", cells=cells)
+
+
+_MISSING = object()
+
+# Attributes whose values are REPLACED wholesale by the mutation paths
+# (restore = re-assign the old reference).
+_REF_ATTRS = (
+    "plan",
+    "_pending_owner",
+    "_cells_epoch",
+    "_cut_edges",
+    "_plan_gather_mode",
+    "_removed_cells",
+    "_new_cells",
+    "_unrefined_parents",
+)
+
+# Dict attributes mutated in place — item assignment, or clear+update
+# (``_hybrid_reuse``); snapshot = one-level copy. Values are never
+# edited in place (jax arrays / rebuilt numpy arrays / fresh tuples).
+_DICT_ATTRS = (
+    "data",
+    "_removed_data",
+    "_staged_balance",
+    "_pins",
+    "_weights",
+    "_cap_memo",
+    "_balance_added",
+    "_balance_removed",
+    "_cell_item_values",
+    "_neighbor_item_values",
+    "_hybrid_reuse",
+)
+
+# Set attributes (the AMR request queues) cleared by the commit.
+_SET_ATTRS = ("_refines", "_unrefines", "_dont_refines", "_dont_unrefines")
+
+
+def snapshot_state(grid) -> dict:
+    """Capture the minimal mutable structural state (see module
+    docstring). O(host dict/set sizes); no device data is copied."""
+    snap = {}
+    for name in _REF_ATTRS:
+        snap[name] = getattr(grid, name, _MISSING)
+    for name in _DICT_ATTRS:
+        val = getattr(grid, name, _MISSING)
+        snap[name] = dict(val) if isinstance(val, dict) else val
+    for name in _SET_ATTRS:
+        val = getattr(grid, name, _MISSING)
+        snap[name] = set(val) if isinstance(val, set) else val
+    return snap
+
+
+def restore_state(grid, snap: dict) -> None:
+    """Reinstall a :func:`snapshot_state` capture. Dict/set attributes
+    get fresh copies so a snapshot can restore more than once."""
+    for name in _REF_ATTRS:
+        _put(grid, name, snap[name])
+    for name in _DICT_ATTRS:
+        val = snap[name]
+        _put(grid, name, dict(val) if isinstance(val, dict) else val)
+    for name in _SET_ATTRS:
+        val = snap[name]
+        _put(grid, name, set(val) if isinstance(val, set) else val)
+
+
+def _put(grid, name, val):
+    if val is _MISSING:
+        if hasattr(grid, name):
+            delattr(grid, name)
+    else:
+        setattr(grid, name, val)
+
+
+@contextmanager
+def grid_transaction(grid, op: str = "mutation", validate=None):
+    """Run a structural mutation atomically (see module docstring).
+
+    ``validate=None`` validates post-commit iff the grid runs in
+    DEBUG mode (``DCCRG_DEBUG=1``); ``True``/``False`` force it.
+    Reentrant: a transaction opened while another is active on the
+    same grid joins it — rollback and validation belong to the
+    outermost one."""
+    if getattr(grid, "_txn_depth", 0):
+        grid._txn_depth += 1
+        try:
+            yield
+        finally:
+            grid._txn_depth -= 1
+        return
+
+    snap = snapshot_state(grid)
+    grid._txn_depth = 1
+    try:
+        try:
+            yield
+        except Exception as e:
+            restore_state(grid, snap)
+            raise MutationAbortedError(
+                op, e, cells=tuple(getattr(e, "cells", ()) or ())) from e
+        except BaseException:
+            # KeyboardInterrupt & co.: still leave a consistent grid,
+            # but re-raise untouched
+            restore_state(grid, snap)
+            raise
+        check = (getattr(grid, "_debug", False)
+                 if validate is None else validate)
+        if check:
+            try:
+                # pins are requests until a balance applies them; the
+                # balance paths check placement in their own DEBUG hook
+                verify_mod.verify_all(grid, check_pins=False)
+            except Exception as e:
+                # a VerificationError is a diagnosed invariant break; a
+                # verifier CRASHING on malformed state is the same
+                # verdict with less detail — either way the commit is
+                # suspect, so all-or-nothing demands the rollback
+                restore_state(grid, snap)
+                raise GridInvariantError(
+                    op, e, cells=getattr(e, "cells", ())) from e
+    finally:
+        grid._txn_depth = 0
+
+
+def grid_state_bytes(grid, header: bytes = b"") -> bytes:
+    """The grid's exact ``.dc`` checkpoint bytes (structure metadata +
+    every field payload) — the canonical fingerprint the atomicity
+    tests and the fuzzer compare to assert a rolled-back mutation left
+    the grid bitwise identical to its pre-mutation state."""
+    fd, path = tempfile.mkstemp(suffix=".dc", prefix="dccrg_txn_")
+    os.close(fd)
+    try:
+        grid.save_grid_data(path, header)
+        with open(path, "rb") as f:
+            return f.read()
+    finally:
+        os.unlink(path)

@@ -1,0 +1,13 @@
+#!/bin/sh
+# DCCRG_DEBUG CI leg: a short tier-1 marker subset (the mutation-heavy
+# fuzz + faultinject suites) with continuous invariant verification
+# enabled, so an invariant regression surfaces immediately even though
+# the main tier-1 run keeps DEBUG off for speed. Mirrors the
+# reference's -DDEBUG CI builds.
+#
+# Usage: tests/ci_debug_leg.sh [extra pytest args]
+set -e
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m "(fuzz or faultinject) and not slow" --dccrg-debug \
+    -p no:cacheprovider "$@"

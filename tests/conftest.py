@@ -34,6 +34,21 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--dccrg-debug", action="store_true", default=False,
+        help="set DCCRG_DEBUG=1 for the whole run: invariant verifiers "
+             "at every structure rebuild plus transactional post-commit "
+             "validation (the reference's -DDEBUG builds). The CI leg "
+             "tests/ci_debug_leg.sh runs a tier-1 marker subset with it.",
+    )
+
+
+def pytest_configure(config):
+    if config.getoption("--dccrg-debug"):
+        os.environ["DCCRG_DEBUG"] = "1"
+
+
 @pytest.fixture(autouse=True)
 def _tpu_mode_scope(request):
     """DCCRG_TEST_TPU=1 exists to run the Pallas kernel tests on the

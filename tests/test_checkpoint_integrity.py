@@ -208,6 +208,41 @@ def test_corrupt_sidecar_geometry_rejected_not_hung(saved):
         _load(fn, strict=False)
 
 
+def test_failed_rename_keeps_old_checkpoint_verifiable(saved, monkeypatch):
+    """A save whose rename fails on every retry must leave the OLD
+    checkpoint — the intact one still under the final name — with its
+    sidecar, so strict load and rollback still accept it."""
+    g, fn = saved
+    real_replace = os.replace
+
+    def bad_replace(src, dst):
+        if dst == fn:
+            raise OSError("injected rename failure")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", bad_replace)
+    with pytest.raises(OSError, match="rename"):
+        resilience.save_checkpoint(g, fn, header=HEADER,
+                                   variable=GOLDEN_VARIABLE,
+                                   chunk_bytes=CHUNK, retries=1,
+                                   backoff=0.0)
+    monkeypatch.undo()
+    assert resilience.verify_checkpoint(fn) == []
+
+
+def test_truncated_sidecar_crc_list_rejected(saved):
+    """A sidecar whose crc list lost tail entries (still valid JSON,
+    plausible geometry) must be rejected — otherwise the uncovered
+    trailing payload chunks would verify as clean."""
+    _, fn = saved
+    rec = json.load(open(fn + ".crc"))
+    assert len(rec["crc32"]) >= 2
+    rec["crc32"] = rec["crc32"][:-1]
+    json.dump(rec, open(fn + ".crc", "w"))
+    with pytest.raises(CheckpointCorruptionError, match="sidecar"):
+        resilience.verify_checkpoint(fn)
+
+
 def test_transient_io_error_retries(saved, tmp_path):
     """A transient I/O failure during save retries and succeeds; the
     fault log records exactly one firing."""

@@ -1,0 +1,77 @@
+"""Invariant-fuzzing chaos harness (dccrg_tpu/fuzz.py).
+
+Tier-1 runs 25 distinct seeds x 40 ops each in the fast config, with
+verify_all + numpy-oracle cross-checks after every op, plus
+fault-injecting runs that abort mutations mid-flight and assert the
+grid is bitwise either fully rolled back or fully committed. Long
+runs live under the ``slow`` marker.
+"""
+
+import numpy as np
+import pytest
+
+from dccrg_tpu.fuzz import FuzzFailure, GridFuzzer
+from dccrg_tpu.grid import DEFAULT_NEIGHBORHOOD_ID
+
+pytestmark = pytest.mark.fuzz
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_fuzz_seeded(seed):
+    fz = GridFuzzer(seed, ops=40).run()
+    assert fz.ops_run == 40
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_fuzz_fault_injecting(seed):
+    """Mutations aborted mid-flight at random fault points must roll
+    back bitwise and commit on retry (asserted inside the fuzzer)."""
+    fz = GridFuzzer(seed, ops=25, fault_rate=0.6).run()
+    assert fz.ops_run == 25
+
+
+def test_fuzz_deeper_amr_and_devices():
+    """A taller octree and a wider mesh in one tier-1 smoke run."""
+    fz = GridFuzzer(7, ops=30, length=(4, 4, 4), max_lvl=2, n_dev=4).run()
+    assert fz.ops_run == 30
+
+
+def test_fuzz_is_deterministic():
+    """Same seed + config => the identical op trail (the replay
+    property every FuzzFailure report relies on)."""
+    a = GridFuzzer(11, ops=15).run()
+    b = GridFuzzer(11, ops=15).run()
+    assert a.log == b.log
+
+
+def test_planted_invariant_break_is_caught(monkeypatch):
+    """A deliberately corrupted neighbor list must surface as a
+    FuzzFailure naming the offending cells."""
+    fz = GridFuzzer(3, ops=5).run()
+    nl = fz.grid.plan.hoods[DEFAULT_NEIGHBORHOOD_ID].lists
+    corrupted = nl.of_neighbor.copy()
+    corrupted[0] = corrupted[1]
+    monkeypatch.setattr(nl, "of_neighbor", corrupted)
+    with pytest.raises(FuzzFailure) as ei:
+        fz._check(99)
+    assert ei.value.cells, "failure must name cells"
+    assert ei.value.seed == 3 and ei.value.op_index == 99
+    assert "cells" in str(ei.value)
+
+
+def test_planted_data_corruption_is_caught():
+    """A value written behind the oracle's back must trip the sweep."""
+    fz = GridFuzzer(4, ops=5).run()
+    victim = int(fz.grid.get_cells()[0])
+    fz.grid.set("rho", [victim], np.asarray([123.0], dtype=np.float32))
+    with pytest.raises(FuzzFailure) as ei:
+        fz._check(99)
+    assert victim in ei.value.cells
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_long_runs(seed):
+    fz = GridFuzzer(seed, ops=200, length=(4, 4, 4), max_lvl=2,
+                    n_dev=4, fault_rate=0.25).run()
+    assert fz.ops_run == 200

@@ -14,6 +14,8 @@ single-controller result — two faked processes writing one file must
 reproduce the single-save file exactly.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -280,6 +282,11 @@ def test_ppermute_exchange_never_materializes_dense_pair_tables():
     arrays must stay unmaterialized unless the all_to_all fallback or
     a host introspection API asks for them."""
     from dccrg_tpu.grid import DEFAULT_NEIGHBORHOOD_ID
+
+    if os.environ.get("DCCRG_DEBUG") == "1":
+        pytest.skip("DEBUG verifiers materialize the dense pair tables "
+                    "by design (verify_remote_neighbor_info reads "
+                    "send_rows/recv_rows)")
 
     g = _mk()
     cells = g.plan.cells

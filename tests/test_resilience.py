@@ -307,6 +307,66 @@ def test_safe_devices_recovers_after_transient_hang():
     assert len(devs) >= 1
 
 
+def test_runner_survives_failed_adapt(tmp_path):
+    """A fault landing INSIDE an AMR commit during the step loop: the
+    transaction rolls the grid back to the pre-mutation state, the
+    runner treats the MutationAbortedError like a watchdog trip
+    (diagnostics + checkpoint rollback + bounded retry), and the replay
+    — with the one-shot fault exhausted — commits and completes."""
+    s, base_step, _dt = _advection()
+    adapt_at = 3
+    adapted = []
+
+    def step_fn(grid, i):
+        base_step(grid, i)
+        if i == adapt_at and not adapted:
+            grid.refine_completely(int(grid.get_cells()[0]))
+            grid.stop_refining()
+            grid.assign_children_from_parents()
+            adapted.append(i)
+
+    runner = ResilientRunner(
+        s.grid, step_fn, str(tmp_path / "adapt.ckpt"),
+        check_every=1, checkpoint_every=2, backoff=0.0)
+    plan = faults.FaultPlan(seed=9)
+    plan.mutation_error(site="adapt.commit", times=1, phase="resolved")
+    with plan:
+        runner.run(6)
+    assert plan.fired("adapt.commit") == 1
+    assert runner.rollbacks == 1
+    assert runner.step == 6
+    assert adapted  # the replayed adapt committed
+    assert runner.trips and "mutation" in runner.trips[0]["fields"]
+    from dccrg_tpu import verify
+
+    verify.verify_all(s.grid, check_pins=False)
+
+
+def test_runner_survives_watchdog_hook_numerics_error(tmp_path, monkeypatch):
+    """DCCRG_WATCHDOG fires INSIDE step_fn (run_steps' own self-check
+    raises NumericsError mid-step): the runner must recover exactly
+    like its own between-steps check — not crash through."""
+    s, base_step, _dt = _advection()
+    monkeypatch.setenv("DCCRG_WATCHDOG", "1")
+    poisoned = []
+
+    def step_fn(grid, i):
+        if i == 2 and not poisoned:
+            poisoned.append(i)
+            grid.set("density", grid.get_cells()[:1],
+                     np.array([np.nan], np.float32))
+        base_step(grid, i)  # the env hook trips in here
+
+    runner = ResilientRunner(
+        s.grid, step_fn, str(tmp_path / "wd.ckpt"),
+        check_every=100, checkpoint_every=100, backoff=0.0)
+    runner.run(5)
+    assert runner.rollbacks == 1
+    assert runner.step == 5
+    assert runner.trips and "density" in runner.trips[0]["fields"]
+    assert resilience.check_finite(s.grid)
+
+
 # -- endurance (slow tier) --------------------------------------------
 
 @pytest.mark.slow

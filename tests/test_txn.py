@@ -1,0 +1,241 @@
+"""Transactional mutation atomicity (txn.py).
+
+A FaultPlan fault injected at EVERY fault point inside the adapt
+commit and balance_load must leave the grid bitwise identical to its
+pre-mutation snapshot (checkpoint-bytes comparison), pass verify_all,
+and allow the same mutation to be retried successfully — the
+reference's all-or-nothing structure-rebuild discipline.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dccrg_tpu import (FaultPlan, Grid, GridInvariantError,
+                       MutationAbortedError, VerificationError, verify_all)
+from dccrg_tpu import verify as V
+from dccrg_tpu.faults import InjectedMutationError
+from dccrg_tpu.txn import grid_state_bytes, grid_transaction
+
+pytestmark = pytest.mark.faultinject
+
+
+def make_grid(n_dev=4, length=(4, 4, 2), max_lvl=2, refined=True):
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("dev",))
+    g = (
+        Grid(cell_data={"rho": jnp.float32, "mom": ((3,), jnp.float32)})
+        .set_initial_length(length)
+        .set_maximum_refinement_level(max_lvl)
+        .set_periodic(True, True, True)
+        .set_neighborhood_length(1)
+        .initialize(mesh)
+    )
+    if refined:
+        g.refine_completely(int(g.get_cells()[0]))
+        g.stop_refining()
+    rng = np.random.default_rng(7)
+    cells = g.get_cells()
+    g.set("rho", cells, rng.random(len(cells)).astype(np.float32))
+    g.set("mom", cells, rng.random((len(cells), 3)).astype(np.float32))
+    g.pin(int(cells[-1]), 1)
+    g.set_cell_weight(int(cells[0]), 2.0)
+    g.balance_load()  # apply the pin so full verify_all holds
+    return g
+
+
+# every fault point on each mutation path, from the canonical table
+# next to the fire() sites — a newly instrumented fault point only
+# needs registering there to gain a parametrized atomicity test here
+from dccrg_tpu.faults import MUTATION_FAULT_SITES
+
+ADAPT_SITES = MUTATION_FAULT_SITES["adapt"]
+BALANCE_SITES = MUTATION_FAULT_SITES["balance"]
+
+
+@pytest.mark.parametrize("site,phase", ADAPT_SITES)
+def test_adapt_fault_rolls_back_bitwise(site, phase):
+    g = make_grid()
+    pins_before = g.get_pin_requests()
+    weights_before = dict(g._weights)
+    target = int(g.get_cells()[3])
+    assert g.refine_completely(target)
+    before = grid_state_bytes(g)
+
+    plan = FaultPlan(seed=1)
+    plan.mutation_error(site=site, times=1, phase=phase)
+    with plan:
+        with pytest.raises(MutationAbortedError) as ei:
+            g.stop_refining()
+    assert plan.fired(site) == 1
+    assert isinstance(ei.value.__cause__, InjectedMutationError)
+
+    # bitwise rollback: structure AND every field payload
+    assert grid_state_bytes(g) == before
+    assert g.get_pin_requests() == pins_before
+    assert g._weights == weights_before
+    verify_all(g)
+
+    # the refine request survived the rollback: the retry commits
+    new = g.stop_refining()
+    assert len(new) >= 8
+    assert np.isin(
+        g.mapping.get_all_children(np.uint64(target)), g.get_cells()
+    ).all()
+    verify_all(g)
+
+
+@pytest.mark.parametrize("site,phase", BALANCE_SITES)
+def test_balance_fault_rolls_back_bitwise(site, phase):
+    g = make_grid()
+    before = grid_state_bytes(g)
+    owner_before = g.plan.owner.copy()
+
+    plan = FaultPlan(seed=2)
+    plan.mutation_error(site=site, times=1, phase=phase)
+    with plan:
+        with pytest.raises(MutationAbortedError):
+            g.balance_load()
+    assert plan.fired(site) == 1
+
+    assert grid_state_bytes(g) == before
+    assert np.array_equal(g.plan.owner, owner_before)
+    # the half-applied balance left NO pending stage behind
+    assert getattr(g, "_pending_owner", None) is None
+    assert g._staged_balance == {}
+    verify_all(g)
+
+    g.balance_load()  # retry succeeds
+    verify_all(g)
+
+
+def test_staged_finish_fault_preserves_staging():
+    """The staged multi-phase API: a fault inside finish_balance_load
+    rolls back to the post-continue state (staging intact), so finish
+    alone can be retried."""
+    g = make_grid()
+    g.initialize_balance_load()
+    g.continue_balance_load()
+    pending = g._pending_owner.copy()
+
+    plan = FaultPlan(seed=3)
+    plan.mutation_error(site="balance.commit", times=1, phase="land")
+    with plan:
+        with pytest.raises(MutationAbortedError):
+            g.finish_balance_load()
+
+    # staging survived the rollback
+    assert np.array_equal(g._pending_owner, pending)
+    assert set(g._staged_balance) == set(g.fields)
+    g.finish_balance_load()
+    verify_all(g)
+
+
+def test_unrefine_fault_rolls_back(tmp_path):
+    g = make_grid()
+    lvl1 = [int(c) for c in g.get_cells()
+            if g.mapping.get_refinement_level(np.uint64(c)) == 1]
+    assert g.unrefine_completely(lvl1[0])
+    before = grid_state_bytes(g)
+
+    plan = FaultPlan(seed=4)
+    plan.mutation_error(site="adapt.commit", times=1, phase="preserved")
+    with plan:
+        with pytest.raises(MutationAbortedError):
+            g.stop_refining()
+    assert grid_state_bytes(g) == before
+    g.stop_refining()
+    verify_all(g)
+
+
+def test_post_commit_validation_rolls_back(monkeypatch):
+    """GridInvariantError: a broken invariant detected by the
+    post-commit verify_all rolls the commit back and names the cells."""
+    g = make_grid()
+    g._debug = True  # transactional post-commit validation on
+    before = grid_state_bytes(g)
+
+    def planted(grid):
+        raise VerificationError("planted invariant break", cells=(17, 23))
+
+    # patch a checker only the transaction-level verify_all runs (the
+    # per-rebuild DEBUG hooks inside _finish_plan run the others)
+    monkeypatch.setattr(V, "verify_partition_coverage", planted)
+    assert g.refine_completely(int(g.get_cells()[2]))
+    with pytest.raises(GridInvariantError) as ei:
+        g.stop_refining()
+    assert ei.value.cells == (17, 23)
+    assert "17" in str(ei.value)
+
+    monkeypatch.undo()
+    assert grid_state_bytes(g) == before
+    verify_all(g)
+    # rolled back including the request sets: retry succeeds
+    assert len(g.stop_refining()) >= 8
+
+
+def test_post_commit_validator_crash_rolls_back(monkeypatch):
+    """A verifier CRASHING (raw exception, not VerificationError) on
+    the committed state is the same verdict with less detail — the
+    commit must still roll back under the typed error."""
+    g = make_grid()
+    g._debug = True
+    before = grid_state_bytes(g)
+
+    def crashing(grid):
+        raise ValueError("verifier blew up on malformed state")
+
+    monkeypatch.setattr(V, "verify_partition_coverage", crashing)
+    assert g.refine_completely(int(g.get_cells()[2]))
+    with pytest.raises(GridInvariantError) as ei:
+        g.stop_refining()
+    assert isinstance(ei.value.__cause__, ValueError)
+
+    monkeypatch.undo()
+    assert grid_state_bytes(g) == before
+    verify_all(g)
+
+
+def test_nested_transaction_joins_outer():
+    """A transaction opened inside another must not commit or roll
+    back on its own — rollback belongs to the outermost."""
+    g = make_grid(refined=False)
+    before = grid_state_bytes(g)
+    with pytest.raises(MutationAbortedError):
+        with grid_transaction(g, op="outer"):
+            with grid_transaction(g, op="inner"):
+                g.balance_load()  # joins too (depth 3)
+            raise RuntimeError("outer failure after inner success")
+    assert grid_state_bytes(g) == before
+    assert g._txn_depth == 0
+    verify_all(g)
+
+
+def test_transaction_errors_are_typed():
+    g = make_grid(refined=False)
+    with pytest.raises(RuntimeError):  # the hierarchy stays a RuntimeError
+        with grid_transaction(g, op="noop"):
+            raise ValueError("boom")
+    try:
+        with grid_transaction(g, op="noop"):
+            raise ValueError("boom")
+    except MutationAbortedError as e:
+        assert e.op == "noop"
+        assert isinstance(e.__cause__, ValueError)
+    else:  # pragma: no cover
+        pytest.fail("MutationAbortedError not raised")
+
+
+def test_fault_exhausted_plan_does_not_fire():
+    """A rule with times=1 must not abort the retry."""
+    g = make_grid(refined=False)
+    plan = FaultPlan(seed=5)
+    plan.mutation_error(site="balance.commit", times=1, phase="finish")
+    with plan:
+        with pytest.raises(MutationAbortedError):
+            g.balance_load()
+        g.balance_load()  # same plan still active: rule is exhausted
+    assert plan.fired("balance.commit") == 1
+    verify_all(g)

@@ -1,6 +1,8 @@
 """Uniform (all-level-0) fast-path plan construction vs the generic
 builder: same layout, semantically identical gather tables."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -127,6 +129,9 @@ def test_lazy_single_cell_queries_match_stream(periodic):
     """Single-cell neighbor queries on the fast path answer closed-form
     (without forcing the lazy entry stream) and must equal the
     stream-backed answers entry for entry."""
+    if os.environ.get("DCCRG_DEBUG") == "1":
+        pytest.skip("DEBUG verifiers force every lazy entry stream by "
+                    "design (verify_neighbors recomputes and compares)")
     g = make_grid(length=(5, 4, 3), periodic=periodic, n_dev=2,
                   user_hood=[[1, 0, 0], [0, -1, 0], [1, 1, 1]])
     for hid in (DEFAULT_NEIGHBORHOOD_ID, 42):

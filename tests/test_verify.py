@@ -60,16 +60,20 @@ def test_pin_verified(mesh8):
     V.pin_requests_succeeded(grid)
     # corrupt: claim the pin went elsewhere
     grid._pins[cid] = 5
-    with pytest.raises(VerificationError):
+    with pytest.raises(VerificationError) as ei:
         V.pin_requests_succeeded(grid)
+    # typed error names the offending cell
+    assert ei.value.cells == (cid,)
+    assert str(cid) in str(ei.value)
 
 
 def test_corrupt_owner_detected(mesh8):
     grid = make_grid(mesh8)
     grid.plan.owner = grid.plan.owner.copy()
     grid.plan.owner[0] = 99
-    with pytest.raises(VerificationError):
+    with pytest.raises(VerificationError) as ei:
         V.is_consistent(grid)
+    assert int(grid.plan.cells[0]) in ei.value.cells
 
 
 def test_corrupt_neighbor_list_detected(mesh8):
@@ -77,8 +81,9 @@ def test_corrupt_neighbor_list_detected(mesh8):
     nl = grid.plan.hoods[DEFAULT_NEIGHBORHOOD_ID].lists
     nl.of_neighbor = nl.of_neighbor.copy()
     nl.of_neighbor[0] = nl.of_neighbor[1]
-    with pytest.raises(VerificationError):
+    with pytest.raises(VerificationError) as ei:
         V.verify_neighbors(grid)
+    assert len(ei.value.cells) >= 1
 
 
 def test_corrupt_send_list_detected(mesh8):
@@ -111,3 +116,75 @@ def test_debug_env_hook(mesh8, monkeypatch):
     ids = grid.get_cells()
     grid.refine_completely(int(ids[0]))
     grid.stop_refining()  # runs verify_all internally via _build_plan
+
+
+def test_partition_coverage_detects_double_ownership(mesh8):
+    grid = make_grid(mesh8)
+    V.verify_partition_coverage(grid)
+    # corrupt: device 1 also claims a cell device 0 owns
+    stolen = grid.plan.local_ids[0][0]
+    grid.plan.local_ids[1] = np.concatenate(
+        [grid.plan.local_ids[1], [stolen]]
+    )
+    with pytest.raises(VerificationError) as ei:
+        V.verify_partition_coverage(grid)
+    assert ei.value.cells == (int(stolen),)
+
+
+def test_partition_coverage_detects_dropped_cell(mesh8):
+    grid = make_grid(mesh8)
+    dropped = grid.plan.local_ids[2][-1]
+    grid.plan.local_ids[2] = grid.plan.local_ids[2][:-1]
+    with pytest.raises(VerificationError) as ei:
+        V.verify_partition_coverage(grid)
+    assert int(dropped) in ei.value.cells
+
+
+def test_refinement_balance_detects_level_jump(mesh8):
+    """Plant a >1 level jump: replace one level-1 child with its 8
+    level-2 children while a face neighbor stays at level 0 — a valid
+    tiling (so load-style checks pass) that violates 2:1."""
+    grid = make_grid(mesh8, max_lvl=2)
+    grid.refine_completely(1)
+    grid.stop_refining()
+    V.verify_refinement_balance(grid)
+    lvl = grid.mapping.get_refinement_level(grid.plan.cells)
+    child = grid.plan.cells[lvl == 1][0]
+    grandkids = grid.mapping.get_all_children(np.uint64(child))
+    cells = np.sort(np.concatenate([
+        grid.plan.cells[grid.plan.cells != child], grandkids
+    ]))
+    grid.plan.cells = cells  # structure-only corruption
+    with pytest.raises(VerificationError) as ei:
+        V.verify_refinement_balance(grid)
+    assert len(ei.value.cells) >= 2
+    assert any(int(k) in ei.value.cells for k in grandkids)
+
+
+def test_neighbor_symmetry_detects_dropped_edge(mesh8, monkeypatch):
+    """The two-engine cross-check: drop one edge from the to-subset
+    engine's answer and the symmetry verifier must flag it."""
+    grid = make_grid(mesh8)
+    V.verify_neighbor_symmetry(grid)
+    real = V.find_neighbors_to_subset
+
+    def lossy(mapping, topology, cells, query, offsets):
+        qi, src, off = real(mapping, topology, cells, query, offsets)
+        return qi[:-1], src[:-1], off[:-1]
+
+    monkeypatch.setattr(V, "find_neighbors_to_subset", lossy)
+    with pytest.raises(VerificationError) as ei:
+        V.verify_neighbor_symmetry(grid)
+    assert len(ei.value.cells) >= 1
+
+
+def test_verify_all_check_pins_flag(mesh8):
+    """A pending (unapplied) pin request is not an invariant break at
+    non-balance mutation boundaries."""
+    grid = make_grid(mesh8)
+    cid = int(grid.get_cells()[0])
+    cur = grid.get_process(cid)
+    grid.pin(cid, (cur + 1) % 8)
+    verify_all(grid, check_pins=False)
+    with pytest.raises(VerificationError):
+        verify_all(grid)  # strict mode still enforces placement
