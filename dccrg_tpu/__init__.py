@@ -14,7 +14,10 @@ reference dccrg library (header-only C++/MPI/Zoltan; see SURVEY.md):
 - parallel checkpoint/restart,
 - a resilience layer (checksummed atomic checkpoints, a numerics
   watchdog with auto-rollback, OOM-aware gather-mode fallback and
-  hang-proof device probing) with deterministic fault injection.
+  hang-proof device probing) with deterministic fault injection,
+- a distributed-coordination layer (``coord``: timeout-guarded
+  barriers, two-phase-commit multi-process checkpoints, cross-rank
+  trip consensus, guarded ``jax.distributed`` bring-up).
 
 Reference: /root/reference (dccrg.hpp and friends). This package is a
 re-design for TPU, not a translation: structure (cell lists, neighbor
@@ -35,6 +38,9 @@ from .verify import VerificationError, verify_all
 from .txn import (GridInvariantError, MutationAbortedError, MutationError,
                   grid_transaction)
 from .faults import FaultPlan
+from .coord import (BarrierTimeoutError, CheckpointCommitError,
+                    DistributedInitError, barrier, distributed_init,
+                    trip_consensus)
 from .resilience import (CheckpointCorruptionError, DeviceProbeError,
                          NumericsError, ResilienceExhaustedError,
                          ResilientRunner, guarded_step, load_checkpoint,
@@ -64,6 +70,12 @@ __all__ = [
     "MutationError",
     "grid_transaction",
     "FaultPlan",
+    "BarrierTimeoutError",
+    "CheckpointCommitError",
+    "DistributedInitError",
+    "barrier",
+    "distributed_init",
+    "trip_consensus",
     "CheckpointCorruptionError",
     "DeviceProbeError",
     "NumericsError",

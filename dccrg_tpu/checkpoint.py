@@ -48,7 +48,9 @@ contract.
 
 from __future__ import annotations
 
+import os
 import struct
+import zlib
 
 import numpy as np
 
@@ -248,7 +250,8 @@ def _interleave(nc, fixed, var_host, var_nbytes, fixed_bytes, var_spec):
 
 
 def save_grid_data(grid, filename: str, header: bytes = b"",
-                   variable=None) -> None:
+                   variable=None, *, sidecar: bool = False,
+                   sidecar_chunk_bytes: int | None = None) -> None:
     """Write the grid and all cell data (dccrg.hpp:1109-1736), payloads
     streamed in bounded chunks with the device pull of chunk k+1
     overlapping the file write of chunk k (the reference overlaps via
@@ -256,7 +259,16 @@ def save_grid_data(grid, filename: str, header: bytes = b"",
     prefetch pipeline gives the same pull/write concurrency on the
     single controller). ``variable={"field": "count_field"}`` stores
     that field truncated to each cell's count (two-pass loadable
-    ragged payloads, dccrg.hpp:2108-2123)."""
+    ragged payloads, dccrg.hpp:2108-2123).
+
+    Multi-process meshes take the TWO-PHASE-COMMIT path
+    (:func:`_save_process_slice`): slices land in ``<file>.mp-tmp``,
+    per-rank CRCs are collected at a commit barrier, and the committing
+    rank verifies + renames — atomic under rank death. ``sidecar=True``
+    additionally has the committing rank write the resilience CRC32
+    sidecar (with the per-rank slice table); on the single-controller
+    path the sidecar is resilience.save_checkpoint's job and these
+    kwargs are ignored."""
     from concurrent.futures import ThreadPoolExecutor
 
     cells = grid.get_cells()
@@ -292,7 +304,9 @@ def save_grid_data(grid, filename: str, header: bytes = b"",
 
     if grid._multiproc:
         _save_process_slice(grid, filename, bytes(meta), cells, offsets,
-                            sizes, counts, fixed_spec, fixed_bytes, var_spec)
+                            sizes, counts, fixed_spec, fixed_bytes, var_spec,
+                            header_size=len(header), sidecar=sidecar,
+                            sidecar_chunk_bytes=sidecar_chunk_bytes)
         return
 
     starts = list(range(0, len(cells), CHUNK))
@@ -335,65 +349,333 @@ def _replicated_pull(grid, field, cells):
     return np.concatenate(out)
 
 
+MP_TMP_SUFFIX = ".mp-tmp"
+
+# Faked-split CRC staging: {tmp_path: {dev: (rank, [crc per run])}}.
+# REAL multi-process meshes never touch this — their CRCs cross ranks
+# through the device all-gather at the commit barrier; the table only
+# bridges the SEQUENTIAL per-rank passes of the faked test protocol
+# (tests/test_multiprocess.py runs rank 0's pass, then rank 1's, in
+# one process). The meta-writing pass resets the entry, so an aborted
+# earlier attempt can never leak stale CRCs into a later save.
+_MP_CRC_STAGE: dict = {}
+
+
+def _device_runs(n_dev, owner, offsets, sizes):
+    """Per-device contiguous payload runs ``[(dev, positions, lo,
+    hi)]`` in device order — derived from the replicated plan only, so
+    every process computes the IDENTICAL run table (the shared frame of
+    reference the commit-time CRC exchange needs; the reference gets
+    the same from its allgathered cell lists, dccrg.hpp:1594-1659)."""
+    runs = []
+    offs = offsets.astype(np.int64)
+    szs = sizes.astype(np.int64)
+    for d in range(n_dev):
+        pos = np.flatnonzero(owner == d)
+        if not len(pos):
+            continue
+        brk = np.flatnonzero(np.diff(pos) != 1) + 1
+        for seg in np.split(pos, brk):
+            runs.append((d, seg, int(offs[seg[0]]),
+                         int(offs[seg[-1]] + szs[seg[-1]])))
+    return runs
+
+
+def _gather_run_crcs(grid, runs, local_crcs, rank, tmp, real):
+    """Collect every rank's per-run CRC32s into one replicated table
+    ``{dev: (rank, [crc, ...])}``.
+
+    Real multi-process meshes exchange through ``comm.host_all_gather``
+    at the commit barrier: each process uploads a [n_dev, 1 + 2K]
+    uint32 row block for its own devices — rank+1, then (present, crc)
+    per run, so a never-written run is distinguishable from any
+    legitimate CRC value — and the gather replicates the full table to
+    every rank. uint32 on purpose: with ``jax_enable_x64`` off (JAX's
+    default; the library never flips it) 64-bit dtypes are silently
+    canonicalized to 32 bits inside the device put, which would wrap
+    half of all CRC32 values and make healthy ranks look dead. Faked
+    test splits merge the in-process stage table instead (their passes
+    run sequentially — there is nothing to gather *from* yet when the
+    first pass runs)."""
+    by_dev: dict = {}
+    for gri, (d, _seg, _lo, _hi) in enumerate(runs):
+        by_dev.setdefault(d, []).append(gri)
+    if not real:
+        stage = _MP_CRC_STAGE.setdefault(tmp, {})
+        for d, gris in by_dev.items():
+            if grid._proc_local_dev[d]:
+                stage[d] = (rank, [local_crcs[g] for g in gris])
+        return dict(stage)
+    from . import comm
+
+    K = max((len(v) for v in by_dev.values()), default=0)
+    table = np.zeros((grid.n_dev, 1 + 2 * K), dtype=np.uint32)
+    for d, gris in by_dev.items():
+        if grid._proc_local_dev[d]:
+            table[d, 0] = rank + 1
+            for k, g in enumerate(gris):
+                table[d, 1 + 2 * k] = 1  # presence marker
+                table[d, 2 + 2 * k] = local_crcs[g]
+    full = comm.host_all_gather(grid.mesh, table)[0]
+    out = {}
+    for d, gris in by_dev.items():
+        if full[d, 0] > 0:
+            out[d] = (int(full[d, 0]) - 1,
+                      [int(full[d, 2 + 2 * k]) for k in range(len(gris))
+                       if full[d, 1 + 2 * k] == 1])
+    return out
+
+
 def _save_process_slice(grid, filename, meta, cells, offsets, sizes, counts,
-                        fixed_spec, fixed_bytes, var_spec):
-    """Multi-process save: every process writes its OWN cells' payload
-    ranges into the shared file — the reference's collective MPI-IO
-    write with per-rank file views (dccrg.hpp:1594-1659). Process 0
-    writes the (replicated) metadata and cell/offset table; payload
-    ranges are grouped into contiguous runs (one run per process under
-    block partitions) so writes are large and few."""
+                        fixed_spec, fixed_bytes, var_spec, header_size=0,
+                        sidecar=False, sidecar_chunk_bytes=None):
+    """Two-phase-commit multi-process save.
+
+    Every process writes its OWN cells' payload runs — the reference's
+    collective MPI-IO write with per-rank file views
+    (dccrg.hpp:1594-1659) — but into ``<file>.mp-tmp``, never the final
+    name, recording a CRC32 per run as it streams:
+
+    1. **prepare** — the meta-writing rank lays down the (replicated)
+       metadata + cell/offset table and pre-sizes the temp file; a
+       timeout-guarded barrier releases the slice writers; every rank
+       pwrites its runs (same one-deep prefetch pipeline as the
+       single-controller path) and fsyncs.
+    2. **commit** — a second barrier collects every rank's run CRCs
+       (comm.host_all_gather on real meshes); the committing rank
+       re-reads the temp file, verifies EVERY slice against its
+       writer's CRC (raising :class:`~dccrg_tpu.coord
+       .CheckpointCommitError` naming the dead/torn ranks on any
+       mismatch or missing slice), fsyncs, and atomically renames.
+
+    A rank death or I/O fault at ANY rank/phase therefore leaves
+    either the old or the new checkpoint bitwise intact under the
+    final name; a lost rank turns into a
+    :class:`~dccrg_tpu.coord.BarrierTimeoutError` instead of a hang.
+    With ``sidecar``, the committing rank also writes the resilience
+    CRC32 sidecar extended with the per-rank slice table ``[dev, rank,
+    lo, hi, crc]`` so a salvage load can name the dead rank's cells."""
     import jax
 
-    writes_meta = getattr(grid, "_ckpt_writes_meta",
-                          jax.process_index() == 0)
-    local = grid._proc_local_dev[grid.plan.owner]
-    my = np.flatnonzero(local)
-    end = int(offsets[-1] + sizes[-1]) if len(cells) else len(meta) + 16 * len(cells)
+    from . import coord
+
+    real = jax.process_count() > 1  # vs. a faked test split
+    rank = coord.process_rank(grid)
+    writes_meta = getattr(grid, "_ckpt_writes_meta", None)
+    if writes_meta is None:
+        writes_meta = (jax.process_index() == 0) if real else True
+    commits = getattr(grid, "_ckpt_commits", None)
+    if commits is None:
+        commits = writes_meta
+    tmp = filename + MP_TMP_SUFFIX
+    # per-grid save-attempt epoch in every barrier tag: ranks ENTER the
+    # save collectively even when a previous attempt failed at
+    # different points on different ranks, so tagging by attempt
+    # re-aligns the whole barrier sequence on a collective retry
+    # (coord.barrier's per-tag counters cover everything else)
+    attempt = getattr(grid, "_mp_save_epoch", 0) + 1
+    grid._mp_save_epoch = attempt
+    base = f"{os.path.basename(filename)}#{attempt}"
+    end = int(offsets[-1] + sizes[-1]) if len(cells) else len(meta)
+    runs = _device_runs(grid.n_dev, grid.plan.owner, offsets, sizes)
+
+    # -- phase 1: prepare — meta + slice runs into the temp file ------
+    faults.fire("checkpoint.mp", phase="meta", rank=rank, path=filename)
     if writes_meta:
-        with open(filename, "wb") as f:
+        _MP_CRC_STAGE.pop(tmp, None)  # fresh attempt (faked protocol)
+        with open(tmp, "wb") as f:
             f.write(meta)
             pairs = np.empty((len(cells), 2), dtype=np.uint64)
             pairs[:, 0] = cells
             pairs[:, 1] = offsets
             f.write(pairs.tobytes())
             f.truncate(end)  # pre-size so every process can pwrite
-    if jax.process_count() > 1:  # not under a faked test split
-        from jax.experimental import multihost_utils
+            f.flush()
+            os.fsync(f.fileno())
+    coord.barrier(f"save_prepare:{base}")
 
-        multihost_utils.sync_global_devices(f"dccrg_save:{filename}")
     from concurrent.futures import ThreadPoolExecutor
 
-    with open(filename, "r+b") as f, ThreadPoolExecutor(1) as pool:
-        # runs of consecutive local cells share one write; the same
+    mine = [g for g, r in enumerate(runs) if grid._proc_local_dev[r[0]]]
+    local_crcs: dict = {g: 0 for g in mine}
+    with open(tmp, "r+b") as f, ThreadPoolExecutor(1) as pool:
+        # runs of consecutive local cells share one seek; the same
         # one-deep prefetch pipeline as the single-controller path, so
         # the shard pull of piece k+1 overlaps the file write of k
-        if len(my):
-            brk = np.flatnonzero(np.diff(my) != 1) + 1
-            pieces = [
-                (int(offsets[run[0]] if s == 0 else 0), s == 0,
-                 run[s : s + CHUNK])
-                for run in np.split(my, brk)
-                for s in range(0, len(run), CHUNK)
-            ]
+        pieces = [
+            (g, s == 0, runs[g][1][s : s + CHUNK], runs[g][2])
+            for g in mine
+            for s in range(0, len(runs[g][1]), CHUNK)
+        ]
 
-            def assemble(piece):
-                return _chunk_bytes(grid, cells, counts, 0, fixed_spec,
-                                    fixed_bytes, var_spec,
-                                    reader=grid._shard_read, idx=piece[2])
+        def assemble(piece):
+            return _chunk_bytes(grid, cells, counts, 0, fixed_spec,
+                                fixed_bytes, var_spec,
+                                reader=grid._shard_read, idx=piece[2])
 
-            fut = pool.submit(assemble, pieces[0])
-            for i, (off_here, is_run_start, _idx) in enumerate(pieces):
-                buf = fut.result()
-                if i + 1 < len(pieces):
-                    fut = pool.submit(assemble, pieces[i + 1])
-                if is_run_start:
-                    f.seek(off_here)
-                f.write(buf)
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+        fut = pool.submit(assemble, pieces[0]) if pieces else None
+        for i, (g, is_run_start, _idx, lo) in enumerate(pieces):
+            buf = fut.result()
+            if i + 1 < len(pieces):
+                fut = pool.submit(assemble, pieces[i + 1])
+            faults.fire("checkpoint.mp", phase="slice", rank=rank,
+                        piece=i, path=filename)
+            if is_run_start:
+                f.seek(lo)
+            f.write(buf)
+            local_crcs[g] = zlib.crc32(buf, local_crcs[g])
+        f.flush()
+        os.fsync(f.fileno())
+    faults.fire("checkpoint.mp", phase="written", rank=rank, path=filename)
 
-        multihost_utils.sync_global_devices(f"dccrg_save_done:{filename}")
+    # -- phase 2: commit barrier, CRC exchange, verify + publish ------
+    coord.barrier(f"save_commit:{base}")
+    crc_table = _gather_run_crcs(grid, runs, local_crcs, rank, tmp, real)
+    status_key = f"dccrg_commit:{base}"
+    client = coord._coordination_client() if real else None
+    if commits:
+        # the metadata + offset table is REPLICATED state — the
+        # committing rank recomputes its exact bytes locally, so a tear
+        # in the meta region needs no CRC exchange to be caught
+        pairs = np.empty((len(cells), 2), dtype=np.uint64)
+        pairs[:, 0] = cells
+        pairs[:, 1] = offsets
+        # crc32 reads the buffer protocol directly: no tobytes() copy
+        # of a table that is ~2 GB at the 512^3 scale
+        meta_crc = zlib.crc32(pairs, zlib.crc32(meta))
+        commit_err = None
+        try:
+            _commit_process_slices(grid, filename, tmp, runs, crc_table,
+                                   header_size, sidecar,
+                                   sidecar_chunk_bytes, rank,
+                                   meta_crc & 0xFFFFFFFF,
+                                   len(meta) + 16 * len(cells))
+        except faults.InjectedRankDeath:
+            raise  # a dead rank coordinates nothing
+        except Exception as e:  # noqa: BLE001 - re-raised below
+            commit_err = e
+        _MP_CRC_STAGE.pop(tmp, None)
+        if client is not None:
+            # publish the outcome BEFORE the done barrier: peers read
+            # it right after and learn of an abort immediately instead
+            # of mistaking a live-but-aborted committer for a dead one.
+            # allow_overwrite: a restarted job (fresh Grid, reset
+            # attempt epoch) may legitimately reuse a key — a stale
+            # value from a previous incarnation must not crash a save
+            # that already published its rename
+            status = ("ok" if commit_err is None
+                      else f"commit aborted on rank {rank}: {commit_err}")
+            try:
+                client.key_value_set(status_key, status,
+                                     allow_overwrite=True)
+            except TypeError:  # older jaxlib without the kwarg
+                try:
+                    client.key_value_set(status_key, status)
+                except Exception:  # pragma: no cover - key collision
+                    pass
+        if commit_err is not None:
+            try:
+                coord.barrier(f"save_done:{base}")
+            except Exception:  # the abort outranks a straggling peer
+                pass
+            raise commit_err
+    coord.barrier(f"save_done:{base}")
+    if not commits and client is not None:
+        try:
+            status = client.blocking_key_value_get(status_key, 10_000)
+        except Exception:  # committer gone: the barrier outcome governs
+            status = None
+        if status is not None and status != "ok":
+            raise coord.CheckpointCommitError(
+                f"{filename}: {status}; the previous checkpoint is "
+                "untouched")
+
+
+def _commit_process_slices(grid, filename, tmp, runs, crc_table,
+                           header_size, sidecar, sidecar_chunk_bytes, rank,
+                           meta_crc, payload_start):
+    """The committing rank's half of the two-phase save: verify the
+    replicated metadata block (against ``meta_crc``, recomputed
+    locally) and every payload slice of the temp file against its
+    writer's CRC, then atomically publish (old-sidecar drop, rename,
+    dir fsync, new sidecar) — the same rename discipline as
+    resilience.save_checkpoint's single-controller path."""
+    from . import coord, resilience
+
+    faults.fire("checkpoint.mp", phase="commit", rank=rank, path=filename)
+    by_dev: dict = {}
+    for gri, (d, _seg, lo, hi) in enumerate(runs):
+        by_dev.setdefault(d, []).append((gri, lo, hi))
+    missing = sorted(d for d in by_dev if d not in crc_table
+                     or len(crc_table[d][1]) != len(by_dev[d]))
+    if missing:
+        raise coord.CheckpointCommitError(
+            f"{filename}: commit aborted — no slice CRCs from device(s) "
+            f"{missing} (their rank died before the commit barrier); the "
+            "previous checkpoint is untouched",
+            ranks=[crc_table[d][0] for d in missing if d in crc_table])
+    # ONE sequential pass over the temp file yields all three CRC
+    # layouts: the metadata block (= chunk 0 of the tiling), the
+    # sidecar's chunk tiling, and the per-rank slice spans (globally
+    # sorted for the streaming overlay, then unpermuted)
+    entries = [(d, k, lo, hi)
+               for d in sorted(by_dev)
+               for k, (_gri, lo, hi) in enumerate(by_dev[d])]
+    order = sorted(range(len(entries)), key=lambda i: entries[i][2])
+    cb = sidecar_chunk_bytes or resilience.CRC_CHUNK
+    file_bytes = os.path.getsize(tmp)
+    chunk_ranges = resilience._chunk_ranges(payload_start, file_bytes, cb)
+    chunk_crcs, sorted_crcs = resilience._stream_crcs(
+        tmp, chunk_ranges, [(entries[i][2], entries[i][3]) for i in order],
+        cb)
+    got = [0] * len(entries)
+    for k, i in enumerate(order):
+        got[i] = sorted_crcs[k]
+    if chunk_crcs[0] != meta_crc:
+        raise coord.CheckpointCommitError(
+            f"{filename}: commit aborted — the metadata/offset-table "
+            "block of the temp file does not match its replicated bytes "
+            "(torn prepare write); the previous checkpoint is untouched")
+    slices = []  # [dev, rank, lo, hi, crc] rows for the sidecar
+    torn = []
+    for i, (d, k, lo, hi) in enumerate(entries):
+        wrank, want = crc_table[d]
+        if got[i] != (want[k] & 0xFFFFFFFF):
+            torn.append((d, wrank))
+        slices.append([int(d), int(wrank), int(lo), int(hi),
+                       int(want[k] & 0xFFFFFFFF)])
+    if torn:
+        devs = sorted({d for d, _r in torn})
+        ranks = sorted({r for _d, r in torn})
+        raise coord.CheckpointCommitError(
+            f"{filename}: commit aborted — slice(s) of device(s) {devs} "
+            f"(written by rank(s) {ranks}) fail their CRC32 in the temp "
+            "file (torn write / rank died mid-slice); the previous "
+            "checkpoint is untouched", ranks=ranks)
+    rec = None
+    if sidecar:
+        rec = {"format": resilience.SIDECAR_FORMAT, "chunk_bytes": cb,
+               "file_bytes": file_bytes, "payload_start": payload_start,
+               "header_size": header_size, "crc32": chunk_crcs,
+               "slices": slices}
+    # drop any previous sidecar BEFORE the rename (same reasoning as
+    # resilience.save_checkpoint: never a new file under a stale
+    # record), keeping its bytes to restore if the rename itself fails
+    side = resilience.sidecar_path(filename)
+    old_side = None
+    if os.path.exists(side):
+        with open(side, "rb") as sf:
+            old_side = sf.read()
+        os.unlink(side)
+    try:
+        os.replace(tmp, filename)
+    except OSError:
+        resilience._restore_sidecar(side, old_side)
+        raise
+    resilience._fsync_dir(os.path.dirname(os.path.abspath(filename)))
+    faults.fire("checkpoint.mp", phase="publish", rank=rank, path=filename)
+    if rec is not None:
+        resilience._write_sidecar_record(side, rec)
 
 
 def _grid_skeleton_matches(grid, mapping, hood_len, topology, geometry):
@@ -513,7 +795,36 @@ def load_grid_data(grid, filename: str, header_size: int = 0,
     fixed_spec, fixed_bytes, var_spec = _payload_spec(grid, variable)
     grid.load_cells(cells)
     _scatter_payloads(grid, raw, cells, offsets, fixed_spec, fixed_bytes, var_spec)
+    _load_done_barrier()
     return header
+
+
+def _load_done_barrier():
+    """On real multi-process meshes, hold every rank until all have
+    finished scattering their slices — a fast rank must not proceed to
+    overwrite/replace the file while a peer is still reading it. A
+    no-op (one process_count check) on a single controller; tagged
+    without the filename because salvage loads read per-rank temp
+    names that must not desynchronize the barrier sequence.
+
+    Best effort by design: THIS rank's load already completed, so a
+    peer that cannot answer (died mid-recovery — exactly when a
+    survivor restores from checkpoint) must not turn a successful
+    local load into a failure. The timeout is logged loudly instead."""
+    import jax
+
+    if jax.process_count() > 1:
+        import logging
+
+        from . import coord
+
+        try:
+            coord.barrier("load_done")
+        except Exception as e:  # noqa: BLE001 - load is locally done
+            logging.getLogger("dccrg_tpu.checkpoint").warning(
+                "load_done barrier did not complete (%s); this rank's "
+                "load IS complete — do not overwrite the file until "
+                "the lost peers are accounted for", e)
 
 
 def load_grid(filename: str, cell_data, mesh=None, header_size: int = 0,
@@ -548,4 +859,5 @@ def load_grid(filename: str, cell_data, mesh=None, header_size: int = 0,
     fixed_spec, fixed_bytes, var_spec = _payload_spec(grid, variable)
     grid.load_cells(cells)
     _scatter_payloads(grid, raw, cells, offsets, fixed_spec, fixed_bytes, var_spec)
+    _load_done_barrier()
     return grid, header

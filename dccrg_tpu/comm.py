@@ -75,40 +75,94 @@ def some_reduce(x, peer_mask, axis_name: str):
     return jnp.tensordot(w, gathered, axes=1)
 
 
-def _mesh_map(mesh: Mesh, fn, *args):
+# Compiled host-collective programs, cached per (collective key, mesh,
+# arg count). The host_* wrappers run EVERY step on hot resilience
+# paths (the watchdog probe, the per-step trip consensus of
+# ResilientRunner, the checkpoint CRC gather) — rebuilding
+# jit(shard_map(...)) per call re-traced the program each time; with a
+# stable jitted callable, jax's own cache makes repeat calls
+# dispatch-only. FIFO-bounded: unlike grid._program_cache (which dies
+# with its grid), this dict outlives every grid, so a long-lived
+# driver cycling through many distinct meshes must not accumulate
+# executables forever (far above the handful any one process uses).
+_MESH_PROGRAMS: dict = {}
+_MESH_PROGRAMS_CAP = 64
+
+
+def _mesh_map(mesh: Mesh, key, build, *args):
+    """Run ``build(axis)``'s body as ``jit(shard_map(...))`` over
+    ``mesh`` with every arg row-sharded along the mesh axis. ``key``
+    names the collective for the program cache (closures have no
+    stable identity)."""
     axis = mesh.axis_names[0]
     spec = NamedSharding(mesh, P(axis))
-    mapped = _shard_map(
-        fn, mesh=mesh,
-        in_specs=(P(axis),) * len(args),
-        out_specs=P(axis),
-        check_vma=False,
-    )
+    ck = (key, mesh, len(args))
+    fn = _MESH_PROGRAMS.get(ck)
+    if fn is None:
+        mapped = _shard_map(
+            build(axis), mesh=mesh,
+            in_specs=(P(axis),) * len(args),
+            out_specs=P(axis),
+            check_vma=False,
+        )
+        fn = jax.jit(mapped)
+        while len(_MESH_PROGRAMS) >= _MESH_PROGRAMS_CAP:
+            _MESH_PROGRAMS.pop(next(iter(_MESH_PROGRAMS)))
+        _MESH_PROGRAMS[ck] = fn
     args = [jnp.asarray(a, device=spec) for a in args]
-    return jax.jit(mapped)(*args)
+    return fn(*args)
+
+
+def pull_replicated(arr) -> np.ndarray:
+    """Host copy of a device array whose value is replicated — or whose
+    per-device rows are identical (any all-gathered / all-reduced
+    result). Fully-addressable arrays pull directly; on a multi-process
+    mesh only this process's first addressable shard is read — the
+    foreign shards hold the same bytes by construction, which is
+    exactly what a plain ``np.asarray`` cannot know (it refuses
+    non-addressable arrays)."""
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    block = np.asarray(arr.addressable_shards[0].data)
+    if block.shape == tuple(arr.shape):  # replicated output (P())
+        return block
+    # row-sharded output with identical rows: replicate the local row
+    return np.broadcast_to(block[0], tuple(arr.shape)).copy()
 
 
 def host_all_gather(mesh: Mesh, x) -> np.ndarray:
     """Run all_gather over ``mesh``; ``x`` is [n_dev, ...] sharded rows.
     Returns [n_dev, n_dev, ...] (each device's view, replicated)."""
-    axis = mesh.axis_names[0]
-    out = _mesh_map(mesh, lambda v: all_gather(v[0], axis)[None], jnp.asarray(x))
-    return np.asarray(out)
+    out = _mesh_map(mesh, "all_gather",
+                    lambda axis: lambda v: all_gather(v[0], axis)[None],
+                    jnp.asarray(x))
+    return pull_replicated(out)
 
 
 def host_all_reduce(mesh: Mesh, x, op: str = "sum") -> np.ndarray:
     """Reduce [n_dev, ...] rows across the mesh axis; returns one row."""
-    axis = mesh.axis_names[0]
-    out = _mesh_map(mesh, lambda v: all_reduce(v[0], axis, op)[None], jnp.asarray(x))
-    return np.asarray(out)[0]
+    out = _mesh_map(mesh, ("all_reduce", op),
+                    lambda axis: lambda v: all_reduce(v[0], axis, op)[None],
+                    jnp.asarray(x))
+    return pull_replicated(out)[0]
 
 
 def host_some_reduce(mesh: Mesh, x, peer_mask) -> np.ndarray:
     """Per-device neighbor-set sum of [n_dev, ...] rows."""
-    axis = mesh.axis_names[0]
-    mask = jnp.asarray(np.asarray(peer_mask, dtype=bool))
+    mask = np.asarray(peer_mask, dtype=bool)
 
-    def body(v):
-        return some_reduce(v[0], mask, axis)[None]
+    def build(axis):
+        def body(v, mask_row):
+            # the mask rides in row-sharded: this device's block IS its
+            # peer row (peer_mask[me]), so the program stays cacheable
+            # across different masks instead of baking one in
+            gathered = all_gather(v[0], axis)  # [n_dev, ...]
+            w = mask_row[0].astype(v.dtype)  # [n_dev]
+            return jnp.tensordot(w, gathered, axes=1)[None]
 
-    return np.asarray(_mesh_map(mesh, body, jnp.asarray(x)))
+        return body
+
+    # per-device results differ — no replicated pull possible (host
+    # introspection of some_reduce stays a single-controller API)
+    return np.asarray(_mesh_map(mesh, "some_reduce", build,
+                                jnp.asarray(x), mask))

@@ -1,0 +1,262 @@
+"""Distributed coordination: timeout-guarded barriers, guarded
+``jax.distributed`` bring-up, and cross-rank trip consensus.
+
+The reference dccrg leans on MPI's collective semantics: a rank that
+dies makes the next collective fail *somewhere*, and the job scheduler
+reaps the rest. JAX multi-controller gives no such courtesy —
+``sync_global_devices`` simply never returns if a participant is gone,
+and a checkpoint save that died on one rank leaves every other rank
+blocked forever with a half-written file on disk. This module is the
+coordination layer the multi-process paths (checkpoint two-phase
+commit, :class:`~dccrg_tpu.resilience.ResilientRunner`) thread their
+rank synchronization through:
+
+- :func:`barrier` — a tagged, timeout-guarded barrier. Real meshes go
+  through the ``jax.distributed`` coordination-service barrier (which
+  has a deadline) when available, else ``sync_global_devices`` under a
+  watchdog thread. Either way a lost rank surfaces as a typed
+  :class:`BarrierTimeoutError` *naming the tag* within the configured
+  bound (``DCCRG_BARRIER_TIMEOUT``, default 120 s) instead of hanging
+  the job. Fault injection (:meth:`~dccrg_tpu.faults.FaultPlan
+  .barrier_hang`) exercises the watchdog deterministically on a single
+  controller.
+- :func:`distributed_init` — ``jax.distributed.initialize`` with
+  bounded retry + exponential backoff for the transient failures of
+  real cluster bring-up (coordination service not listening yet, port
+  races), raising :class:`DistributedInitError` when the budget is
+  spent.
+- :func:`trip_consensus` — all-reduces a per-rank trip code over the
+  mesh (max), so rollback decisions that originate on ONE host (a
+  ``MutationAbortedError``, an OOM, a watchdog hook) are taken by
+  EVERY rank together: all ranks roll back to the same checkpoint
+  instead of deadlocking in a barrier half of them never reach.
+- :class:`CheckpointCommitError` — the abort signal of the two-phase
+  multi-process checkpoint commit (checkpoint._save_process_slice):
+  raised by the committing rank when a slice is missing or fails its
+  CRC, with the previous checkpoint still intact under the final name.
+
+Everything degrades to a no-op on a single controller, so
+single-process code pays one ``process_count()`` check per call.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+
+from . import faults
+
+logger = logging.getLogger("dccrg_tpu.coord")
+
+DEFAULT_BARRIER_TIMEOUT = 120.0
+
+# Barrier ids must be unique AND align across ranks. A PER-TAG counter
+# (not one global sequence) keeps them aligned even when ranks' barrier
+# histories diverge on OTHER tags — e.g. a save that failed mid-protocol
+# on one rank consumed that save's tags only, so an unrelated barrier
+# still matches. Within one tag the contract is: every rank calls it the
+# same number of times; protocols that can fail asymmetrically BETWEEN
+# calls of the same tag must fold an attempt epoch into the tag itself
+# (the two-phase checkpoint save tags carry `#<attempt>` for exactly
+# this — a collective retry re-aligns by construction).
+_tag_seq: dict = {}
+
+
+def _next_seq(tag: str) -> int:
+    seq = _tag_seq.get(tag, 0)
+    _tag_seq[tag] = seq + 1
+    return seq
+
+
+class BarrierTimeoutError(RuntimeError):
+    """A tagged barrier did not complete within its bound: a
+    participating rank is gone (process death, hung collective, dead
+    accelerator tunnel). ``tag``/``timeout`` carry the details."""
+
+    def __init__(self, tag: str, timeout: float):
+        super().__init__(
+            f"barrier {tag!r} did not complete within {timeout:g}s: a "
+            "participating rank is unreachable (process death, hung "
+            "collective, or dead accelerator tunnel)")
+        self.tag = tag
+        self.timeout = timeout
+
+
+class DistributedInitError(RuntimeError):
+    """``jax.distributed.initialize`` failed after every bounded
+    retry."""
+
+
+class CheckpointCommitError(RuntimeError):
+    """The two-phase multi-process checkpoint commit aborted: one or
+    more ranks' slices are missing or fail their CRC32, so the new file
+    was NOT published and the previous checkpoint stays bitwise intact
+    under the final name. ``ranks`` names the writers whose slices
+    failed (the dead/torn ranks)."""
+
+    def __init__(self, msg, ranks=()):
+        super().__init__(msg)
+        self.ranks = sorted({int(r) for r in ranks})
+
+
+def barrier_timeout(default: float = DEFAULT_BARRIER_TIMEOUT) -> float:
+    """The ``DCCRG_BARRIER_TIMEOUT`` env knob: seconds before a
+    coordination barrier gives up on its peers."""
+    try:
+        return float(os.environ.get("DCCRG_BARRIER_TIMEOUT", "") or default)
+    except ValueError:
+        return default
+
+
+def _coordination_client():
+    """The jax.distributed coordination-service client, or None (not
+    initialized, or jax internals drifted)."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover - jax internals drift
+        return None
+
+
+def barrier(tag: str, timeout: float | None = None) -> None:
+    """Synchronize every process at a tagged point, or raise
+    :class:`BarrierTimeoutError` naming the tag within ``timeout``
+    seconds (default: :func:`barrier_timeout`).
+
+    Single-controller meshes return immediately. Real multi-process
+    meshes prefer the coordination-service barrier (deadline built in);
+    when only ``sync_global_devices`` is available it runs on a daemon
+    watchdog thread so the caller can never block past the bound (the
+    hung thread is abandoned — a barrier that lost a rank is not
+    recoverable anyway, only reportable). An injected
+    :meth:`~dccrg_tpu.faults.FaultPlan.barrier_hang` replaces the sync
+    with a sleep, exercising the watchdog machinery deterministically
+    without a cluster."""
+    timeout = barrier_timeout() if timeout is None else float(timeout)
+    faults.fire("coord.barrier", tag=tag)
+    hang = faults.take_barrier_hang(tag)
+    import jax
+
+    real = jax.process_count() > 1
+    if not real and hang is None:
+        return
+    seq = _next_seq(tag)
+    if hang is None:
+        client = _coordination_client()
+        if client is not None:
+            try:
+                client.wait_at_barrier(f"dccrg:{tag}:{seq}",
+                                       int(timeout * 1000))
+                return
+            except Exception as e:
+                # the service reports a lost rank either as our
+                # deadline expiring or as the peer's task failing its
+                # heartbeat — both mean the same thing to the caller
+                msg = str(e)
+                if ("DEADLINE_EXCEEDED" in msg or "Barrier failed" in msg
+                        or "heartbeat timeout" in msg):
+                    raise BarrierTimeoutError(tag, timeout) from e
+                raise
+
+    # watchdog-thread path: sync_global_devices has no deadline of its
+    # own, and the injected hang must exercise this same machinery
+    done = threading.Event()
+    err: list = []
+
+    def _sync():
+        try:
+            if hang is not None:
+                # a simulated lost rank: the sync never happens; a
+                # finite hang_s below the timeout models a slow-but-
+                # alive peer the barrier should survive
+                time.sleep(min(hang, timeout + 30.0))
+            elif real:  # pragma: no cover - needs a real cluster
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(f"dccrg:{tag}:{seq}")
+        except Exception as e:  # surfaced on the caller thread
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_sync, daemon=True,
+                         name=f"dccrg-barrier:{tag}")
+    t.start()
+    if not done.wait(timeout):
+        raise BarrierTimeoutError(tag, timeout)
+    if err:
+        raise err[0]
+
+
+def distributed_init(coordinator_address=None, num_processes=None,
+                     process_id=None, *, retries: int = 3,
+                     backoff: float = 0.5, **kwargs) -> None:
+    """``jax.distributed.initialize`` with bounded retry + exponential
+    backoff: real cluster bring-up fails transiently (the coordinator
+    is not listening yet, a port race, a slow DNS answer) and the raw
+    call just dies. Raises :class:`DistributedInitError` with the last
+    failure chained once the budget is spent."""
+    import jax
+
+    last: Exception | None = None
+    for attempt in range(retries + 1):
+        try:
+            faults.fire("coord.init", attempt=attempt)
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id,
+                **kwargs)
+            return
+        except Exception as e:  # noqa: BLE001 - retried, then surfaced
+            last = e
+            if attempt < retries:
+                delay = backoff * (2 ** attempt)
+                logger.warning(
+                    "distributed init failed (%s); retry %d/%d in %.1fs",
+                    e, attempt + 1, retries, delay)
+                time.sleep(delay)
+    raise DistributedInitError(
+        f"jax.distributed.initialize failed after {retries + 1} "
+        f"attempt(s): {last}") from last
+
+
+def process_rank(grid) -> int:
+    """This controller's rank for checkpoint coordination:
+    ``jax.process_index()``, or the per-pass rank a faked test split
+    pinned on the grid (``grid._ckpt_rank``)."""
+    r = getattr(grid, "_ckpt_rank", None)
+    if r is not None:
+        return int(r)
+    import jax
+
+    return int(jax.process_index())
+
+
+def trip_consensus(grid, code: int) -> int:
+    """All-reduce (max) a per-rank trip code across the mesh.
+
+    :class:`~dccrg_tpu.resilience.ResilientRunner` calls this every
+    step so trip/rollback decisions that originate host-side on ONE
+    rank (``MutationAbortedError`` from a failed adapt, an OOM, the
+    watchdog hook inside ``run_steps``) are taken by EVERY rank: all
+    ranks roll back to the same checkpoint together instead of the
+    tripped rank abandoning a collective its peers are still waiting
+    in. Codes are small ints (0 = no trip; 1-3 recoverable — every
+    rank rolls back together; >= resilience._TRIP_FATAL marks a
+    non-recoverable failure — every rank raises in sync); the max
+    across ranks wins. Single-controller grids return ``code``
+    unchanged — the reduction (a cached compiled collective, see
+    comm._mesh_map) only runs on multi-process meshes."""
+    code = int(code)
+    if not grid._multiproc:
+        return code
+    from . import comm
+
+    flags = np.zeros(grid.n_dev, dtype=np.int32)
+    flags[grid._proc_local_dev] = np.int32(code)
+    return int(comm.host_all_reduce(grid.mesh, flags, "max"))

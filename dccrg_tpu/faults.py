@@ -24,8 +24,13 @@ active plan through the module hooks:
 
 - :func:`fire` — raise a scheduled exception at a named site
   (``checkpoint.write`` transient I/O errors, ``checkpoint.chunk``
-  mid-stream write failures, ``step.dispatch`` simulated
-  ``RESOURCE_EXHAUSTED``, ``device.probe`` hung-probe timeouts).
+  mid-stream write failures, ``checkpoint.mp`` two-phase multi-process
+  save phases incl. :meth:`~FaultPlan.rank_death`, ``step.dispatch``
+  simulated ``RESOURCE_EXHAUSTED``, ``device.probe`` hung-probe
+  timeouts, ``coord.barrier`` / ``coord.init`` coordination faults).
+- :func:`take_barrier_hang` — non-raising query coord.barrier uses to
+  turn a scheduled :meth:`~FaultPlan.barrier_hang` into a simulated
+  lost-rank hang inside its watchdog thread.
 - :func:`corrupt_file` — mutate a file that was just written
   (truncation / torn tail, single bit flips), simulating post-write
   disk corruption the CRC sidecar must catch.
@@ -75,6 +80,16 @@ class InjectedMutationError(RuntimeError):
     re-raise as MutationAbortedError — the atomicity tests pin that."""
 
 
+class InjectedRankDeath(RuntimeError):
+    """Injected death of this rank at an instrumented multi-process
+    point (the two-phase checkpoint phases, coord barriers). The faked
+    test harness catches it at the per-rank pass boundary and asserts
+    the surviving protocol state (old checkpoint intact, commit
+    aborted); the REAL harness (tests/mp_harness.py) lets it propagate
+    out of the child's main and exits the OS process — an actual dead
+    rank, whose peers must then hit their barrier timeouts."""
+
+
 @dataclass
 class _Rule:
     site: str
@@ -86,9 +101,17 @@ class _Rule:
     def matches(self, site: str, ctx: dict) -> bool:
         if self.site != site or self.fired >= self.times:
             return False
-        for key in ("mode", "step", "phase"):
+        for key in ("mode", "step", "phase", "tag", "rank"):
             want = self.params.get(key)
-            if want is not None and ctx.get(key) != want:
+            if want is None:
+                continue
+            have = ctx.get(key)
+            if key == "tag":
+                # barrier tags carry protocol suffixes (the two-phase
+                # save appends `#<attempt>`): a rule tag is a PREFIX
+                if not (isinstance(have, str) and have.startswith(want)):
+                    return False
+            elif have != want:
                 return False
         return True
 
@@ -140,10 +163,13 @@ class FaultPlan:
         self.rules.append(_Rule(site, kind, times, params))
         return self
 
-    def io_error(self, times=1, site="checkpoint.write"):
+    def io_error(self, times=1, site="checkpoint.write", phase=None,
+                 rank=None):
         """Transient I/O error during a checkpoint write (before the
-        atomic rename — the previous checkpoint must survive)."""
-        return self._add(site, "io", times)
+        atomic rename — the previous checkpoint must survive).
+        ``phase``/``rank`` narrow multi-phase sites (e.g. the two-phase
+        save's ``checkpoint.mp``) to one instrumented point."""
+        return self._add(site, "io", times, phase=phase, rank=rank)
 
     def chunk_io_error(self, times=1):
         """I/O error mid payload stream (a torn temp file)."""
@@ -181,6 +207,30 @@ class FaultPlan:
     def probe_hang(self, times=1):
         """Device probe times out (dead accelerator tunnel)."""
         return self._add("device.probe", "hang", times)
+
+    def barrier_hang(self, tag=None, times=1, hang_s=None):
+        """A coordination barrier never completes — the signature of a
+        LOST RANK on a multi-process mesh. ``coord.barrier``'s watchdog
+        must raise :class:`~dccrg_tpu.coord.BarrierTimeoutError` naming
+        the tag within its bound. ``tag`` narrows to one barrier by
+        PREFIX (None: the next one) — the two-phase save suffixes its
+        tags with ``#<attempt>``, so ``tag="save_commit:a.dc"`` hits
+        every attempt; a finite ``hang_s`` below the barrier timeout
+        models a slow-but-alive peer instead (the barrier completes)."""
+        return self._add("coord.barrier_hang", "hang", times, tag=tag,
+                         hang_s=hang_s)
+
+    def rank_death(self, site="checkpoint.mp", phase=None, rank=None,
+                   times=1):
+        """This rank dies at an instrumented multi-process point
+        (raises :class:`InjectedRankDeath`). Phases of the two-phase
+        checkpoint save (``site="checkpoint.mp"``): ``meta`` (before
+        the meta/offset-table prepare), ``slice`` (mid payload-run
+        write), ``written`` (slice complete, before the commit
+        barrier), ``commit`` (on the committing rank, before
+        verify+rename), ``publish`` (after the rename, before the
+        sidecar lands). ``rank`` narrows to one rank's pass."""
+        return self._add(site, "rank_death", times, phase=phase, rank=rank)
 
     def mutation_error(self, site="adapt.commit", times=1, phase=None):
         """Fault inside a structural mutation. Sites (each names where
@@ -253,7 +303,27 @@ def fire(site: str, **ctx) -> None:
     if rule.kind == "mutation":
         raise InjectedMutationError(
             f"injected mutation fault at {site} {ctx}".rstrip())
+    if rule.kind == "rank_death":
+        raise InjectedRankDeath(
+            f"injected rank death at {site} {ctx}".rstrip())
     raise AssertionError(f"rule kind {rule.kind!r} cannot fire at {site}")
+
+
+def take_barrier_hang(tag: str):
+    """Consume a scheduled barrier hang for ``tag``; returns the hang
+    duration in seconds (math.inf for a dead rank) or None. Queried —
+    not raised — by coord.barrier: the hang replaces the sync inside
+    the watchdog thread, so the timeout machinery itself is what gets
+    exercised."""
+    plan = _active
+    if plan is None:
+        return None
+    rule = plan._take("coord.barrier_hang", {"tag": tag})
+    if rule is None:
+        return None
+    plan.log.append(("coord.barrier_hang", "hang", {"tag": tag}))
+    hang = rule.params.get("hang_s")
+    return math.inf if hang is None else float(hang)
 
 
 def corrupt_file(path: str) -> list:

@@ -116,16 +116,79 @@ def _chunk_ranges(payload_start, file_bytes, chunk_bytes, n=None):
     return ranges
 
 
+def _range_crcs(path: str, ranges, block: int = CRC_CHUNK) -> list:
+    """CRC32 of each ``[lo, hi)`` byte range of ``path``, streamed
+    ``block`` bytes at a time — ``zlib.crc32`` is incremental, so no
+    range ever materializes in host RAM (at 512^3 the checkpoint is
+    multi-GB and the save path already streams precisely to bound host
+    memory; the checksum passes must too). A range truncated away
+    checksums only the bytes that exist, so it mismatches — exactly
+    what the caller needs it to do."""
+    out = []
+    with open(path, "rb") as f:
+        for lo, hi in ranges:
+            f.seek(int(lo))
+            crc, left = 0, int(hi) - int(lo)
+            while left > 0:
+                buf = f.read(min(block, left))
+                if not buf:
+                    break
+                crc = zlib.crc32(buf, crc)
+                left -= len(buf)
+            out.append(crc & 0xFFFFFFFF)
+    return out
+
+
+def _stream_crcs(path: str, chunk_ranges, spans, block: int = CRC_CHUNK):
+    """ONE sequential streamed pass computing CRC32s of both the chunk
+    tiling (``chunk_ranges``: contiguous, in order) and an overlay of
+    ``spans`` (sorted by start, non-overlapping — the two-phase save's
+    per-rank slice runs). Returns ``(chunk_crcs, span_crcs)``. The
+    commit rank needs both layouts over the same bytes; reading the
+    (multi-GB at 512^3) temp file once instead of twice halves the
+    publish-path disk traffic."""
+    chunk_crcs = []
+    span_crcs = [0] * len(spans)
+    si = 0
+    with open(path, "rb") as f:
+        for lo, hi in chunk_ranges:
+            f.seek(int(lo))
+            crc, pos, left = 0, int(lo), int(hi) - int(lo)
+            while left > 0:
+                buf = f.read(min(block, left))
+                if not buf:
+                    break
+                crc = zlib.crc32(buf, crc)
+                blo, bhi = pos, pos + len(buf)
+                while si < len(spans) and spans[si][1] <= blo:
+                    si += 1  # spans fully behind this block are done
+                j = si
+                while j < len(spans) and spans[j][0] < bhi:
+                    s = max(int(spans[j][0]), blo)
+                    e = min(int(spans[j][1]), bhi)
+                    if s < e:
+                        span_crcs[j] = zlib.crc32(buf[s - blo:e - blo],
+                                                  span_crcs[j])
+                    j += 1
+                pos = bhi
+                left -= len(buf)
+            chunk_crcs.append(crc & 0xFFFFFFFF)
+    return chunk_crcs, [c & 0xFFFFFFFF for c in span_crcs]
+
+
 def _sidecar_record(path: str, header_size: int = 0,
                     chunk_bytes: int = CRC_CHUNK) -> dict:
-    """The sidecar record for ``path``'s current bytes."""
-    with open(path, "rb") as f:
-        raw = f.read()
+    """The sidecar record for ``path``'s current bytes, checksummed in
+    ``chunk_bytes`` streams (the metadata parse pages in only the head
+    of a memory map — the payload never crosses to host RAM whole)."""
+    file_bytes = os.path.getsize(path)
+    raw = np.memmap(path, dtype=np.uint8, mode="r")
     payload_start = checkpoint_mod.parse_metadata(raw, header_size)[6]
-    ranges = _chunk_ranges(payload_start, len(raw), chunk_bytes)
-    crcs = [zlib.crc32(raw[lo:hi]) & 0xFFFFFFFF for lo, hi in ranges]
+    del raw
+    ranges = _chunk_ranges(payload_start, file_bytes, chunk_bytes)
+    crcs = _range_crcs(path, ranges, chunk_bytes)
     return {"format": SIDECAR_FORMAT, "chunk_bytes": chunk_bytes,
-            "file_bytes": len(raw), "payload_start": payload_start,
+            "file_bytes": file_bytes, "payload_start": payload_start,
             "header_size": header_size, "crc32": crcs}
 
 
@@ -182,6 +245,17 @@ def read_sidecar(filename: str):
             raise ValueError(
                 f"sidecar records {len(crcs)} chunk crc(s), geometry "
                 f"implies {want_chunks}")
+        # two-phase multi-process saves extend the record with a
+        # per-rank slice table [dev, rank, lo, hi, crc]; reject a
+        # mangled one here like the rest of the geometry
+        sl = rec.get("slices")
+        if sl is not None and not (
+                isinstance(sl, list)
+                and all(isinstance(s, list) and len(s) == 5
+                        and all(isinstance(v, int) for v in s)
+                        and 0 <= s[2] <= s[3] <= fb
+                        for s in sl)):
+            raise ValueError("implausible per-rank slice table")
         return rec
     except (ValueError, KeyError, TypeError) as e:
         raise CheckpointCorruptionError(
@@ -202,20 +276,33 @@ def _chunk_name(i: int, ranges) -> str:
 
 
 def _bad_chunks(filename: str, rec) -> list:
-    """Indices of sidecar chunks whose CRC32 no longer matches.
+    """Indices of sidecar chunks whose CRC32 no longer matches,
+    streamed ``chunk_bytes`` at a time (never the whole file in RAM).
     Chunks truncated away count as bad; garbage appended past the
     recorded size is reported as the sentinel index one past the last
     chunk — the recorded range may still be fully intact, so salvage
     just trims the tail instead of zeroing good cells."""
     want = rec["crc32"]
-    ranges = _rec_ranges(rec)
-    with open(filename, "rb") as f:
-        raw = f.read()
-    bad = [i for i, ((lo, hi), crc) in enumerate(zip(ranges, want))
-           if (zlib.crc32(raw[lo:hi]) & 0xFFFFFFFF) != crc]
-    if len(raw) > int(rec["file_bytes"]):
+    got = _range_crcs(filename, _rec_ranges(rec), int(rec["chunk_bytes"]))
+    bad = [i for i, (g, w) in enumerate(zip(got, want))
+           if g != (w & 0xFFFFFFFF)]
+    if os.path.getsize(filename) > int(rec["file_bytes"]):
         bad.append(len(want))
     return bad
+
+
+def _bad_slices(filename: str, rec) -> list:
+    """Indices of per-rank slice entries — two-phase multi-process
+    saves record ``[dev, rank, lo, hi, crc]`` per written run — whose
+    bytes no longer match. The attribution layer over the chunk CRCs:
+    a bad chunk says WHERE the corruption is, a bad slice says WHOSE
+    write it was (the dead/torn rank a salvage report names)."""
+    sl = rec.get("slices") or []
+    if not sl:
+        return []
+    got = _range_crcs(filename, [(int(s[2]), int(s[3])) for s in sl])
+    return [i for i, s in enumerate(sl)
+            if got[i] != (int(s[4]) & 0xFFFFFFFF)]
 
 
 def verify_checkpoint(filename: str, require_sidecar: bool = True) -> list:
@@ -243,6 +330,24 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
     never a torn file under the final name. Transient I/O errors retry
     with exponential backoff. With ``sidecar`` (default) the per-chunk
     CRC32 sidecar is written after the rename."""
+    if grid._multiproc:
+        # multi-process meshes take the TWO-PHASE-COMMIT save
+        # (checkpoint._save_process_slice): every rank streams its
+        # slice runs into <file>.mp-tmp, a timeout-guarded commit
+        # barrier collects per-run CRC32s across ranks, and the
+        # committing rank verifies every slice before the atomic
+        # rename — with the sidecar (extended by the per-rank slice
+        # table) written by that rank. No retry loop here: replaying
+        # the save on ONE rank would desynchronize the ranks' barrier
+        # sequence, so transient-I/O retry on this path belongs to the
+        # caller (who can re-enter collectively on every rank).
+        faults.fire("checkpoint.write", path=filename, attempt=0)
+        checkpoint_mod.save_grid_data(
+            grid, filename, header=header, variable=variable,
+            sidecar=sidecar, sidecar_chunk_bytes=chunk_bytes)
+        faults.corrupt_file(filename)
+        return filename
+
     tmp = filename + f".tmp.{os.getpid()}"
     side = sidecar_path(filename)
     rec = None
@@ -275,20 +380,7 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
             try:
                 os.replace(tmp, filename)
             except OSError:
-                if old_side is not None:
-                    # atomic restore (same tmp+fsync+rename discipline
-                    # as _write_sidecar_record), best effort: a torn
-                    # restore must not shadow the original failure,
-                    # and a missing sidecar is the conservative state
-                    try:
-                        rtmp = side + f".tmp.{os.getpid()}"
-                        with open(rtmp, "wb") as f:
-                            f.write(old_side)
-                            f.flush()
-                            os.fsync(f.fileno())
-                        os.replace(rtmp, side)
-                    except OSError:  # pragma: no cover - double fault
-                        pass
+                _restore_sidecar(side, old_side)
                 raise
             _fsync_dir(os.path.dirname(os.path.abspath(filename)))
             break
@@ -310,6 +402,25 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
     return filename
 
 
+def _restore_sidecar(side: str, old_side) -> None:
+    """Put a displaced sidecar's bytes back after a failed rename —
+    atomic (same tmp+fsync+rename discipline as _write_sidecar_record)
+    and best effort: a torn restore must not shadow the original
+    failure, and a missing sidecar is the conservative state. Shared by
+    the single-controller save and the multi-process commit rank."""
+    if old_side is None:
+        return
+    try:
+        rtmp = side + f".tmp.{os.getpid()}"
+        with open(rtmp, "wb") as f:
+            f.write(old_side)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(rtmp, side)
+    except OSError:  # pragma: no cover - double fault
+        pass
+
+
 def _fsync_dir(path: str) -> None:
     try:
         fd = os.open(path, os.O_RDONLY)
@@ -325,12 +436,17 @@ def _fsync_dir(path: str) -> None:
 
 @dataclass
 class SalvageReport:
-    """What a non-strict load had to work around."""
+    """What a non-strict load had to work around. ``bad_slices`` /
+    ``dead_ranks`` attribute the damage when the sidecar carries a
+    two-phase multi-process slice table: which writer ranks' slices
+    fail their CRC (the dead rank whose cells came back zeroed)."""
 
     bad_chunks: list = dataclass_field(default_factory=list)
     corrupt_cells: np.ndarray = dataclass_field(
         default_factory=lambda: np.empty(0, np.uint64))
     sidecar_missing: bool = False
+    bad_slices: list = dataclass_field(default_factory=list)
+    dead_ranks: list = dataclass_field(default_factory=list)
 
     @property
     def clean(self) -> bool:
@@ -366,13 +482,21 @@ def load_checkpoint(filename: str, cell_data, mesh=None,
 
     bad = _bad_chunks(filename, rec)
     if not bad:
+        # chunk CRCs tile every recorded byte, so clean chunks imply
+        # clean per-rank slices — no second verification pass needed
         grid, header = checkpoint_mod.load_grid(
             filename, cell_data, mesh=mesh, header_size=header_size,
             variable=variable, load_balancing_method=load_balancing_method)
         return grid, header, SalvageReport()
 
+    # attribution: which ranks' two-phase slices cover the damage
+    bad_sl = _bad_slices(filename, rec)
+    dead = sorted({int(rec["slices"][i][1]) for i in bad_sl})
     all_ranges = _rec_ranges(rec)
     names = ", ".join(_chunk_name(i, all_ranges) for i in bad)
+    if dead:
+        names += (f"; slice(s) written by rank(s) {dead} fail their "
+                  "CRC32")
     if strict:
         raise CheckpointCorruptionError(
             f"{filename}: checksum mismatch in {names}", bad_chunks=bad)
@@ -432,7 +556,9 @@ def load_checkpoint(filename: str, cell_data, mesh=None,
         "values: %s", filename, names, len(corrupt_cells),
         corrupt_cells[:16].tolist())
     return grid, header, SalvageReport(bad_chunks=bad,
-                                       corrupt_cells=corrupt_cells)
+                                       corrupt_cells=corrupt_cells,
+                                       bad_slices=bad_sl,
+                                       dead_ranks=dead)
 
 
 # ---------------------------------------------------------------------
@@ -478,7 +604,10 @@ def check_finite(grid, fields=None) -> bool:
         fn = jax.jit(mapped)
         grid._program_cache[key] = fn
     out = fn(*(grid.data[n] for n in names))
-    return bool(int(out[0]))
+    # the min all-reduce leaves identical rows on every device; pull
+    # through comm so real multi-process meshes (where row 0 may not
+    # be addressable) read their local shard instead
+    return bool(int(comm.pull_replicated(out).ravel()[0]))
 
 
 def assert_finite(grid, fields=None, step=None) -> None:
@@ -610,6 +739,14 @@ def guarded_step(grid, kernel, fields_in, fields_out, n_steps=1, *,
 # ---------------------------------------------------------------------
 # the resilient step loop: watchdog + checkpoint + rollback
 # ---------------------------------------------------------------------
+
+# trip codes the per-step consensus all-reduces (max wins): 1-3 are
+# recoverable (mutation / numerics / OOM -> every rank rolls back
+# together); >= _TRIP_FATAL means a rank hit a non-recoverable error
+# and every OTHER rank raises in sync instead of hanging in the dead
+# rank's abandoned collectives
+_TRIP_FATAL = 4
+
 
 def watchdog_interval(default: int = 0) -> int:
     """The DCCRG_WATCHDOG env knob: check every ~N steps (0 = off)."""
@@ -744,12 +881,24 @@ class ResilientRunner:
     def run(self, n_steps: int) -> "ResilientRunner":
         """Advance to ``n_steps`` total steps, recovering as needed.
         Returns self (``.step``, ``.trips``, ``.rollbacks``,
-        ``.checkpoints`` carry the story)."""
+        ``.checkpoints`` carry the story).
+
+        On multi-process meshes every trip decision is put through
+        :func:`dccrg_tpu.coord.trip_consensus` (a max all-reduce of a
+        per-rank trip code) BEFORE acting on it: a
+        ``MutationAbortedError``, an OOM, or a watchdog-hook
+        ``NumericsError`` raised host-side on ONE rank makes EVERY
+        rank roll back to the same checkpoint together, instead of the
+        tripped rank abandoning a barrier its peers then hang in. The
+        device-side ``check_finite`` probe is a global collective and
+        agrees by construction."""
+        from . import coord
         from .txn import MutationAbortedError
 
         if self._ckpt_step is None:
             self._save()  # rollback target always exists
         while self.step < n_steps:
+            code, details = 0, None
             try:
                 self.step_fn(self.grid, self.step)
             except MutationAbortedError as e:
@@ -758,15 +907,47 @@ class ResilientRunner:
                 # recover like a watchdog trip: diagnostics, rollback
                 # to the last checkpoint, bounded retry
                 logger.warning("step %d: %s", self.step, e)
-                self._trip(details={"mutation": np.asarray(
-                    e.cells, dtype=np.uint64)})
-                continue
+                code, details = 1, {"mutation": np.asarray(
+                    e.cells, dtype=np.uint64)}
             except NumericsError as e:
                 # the DCCRG_WATCHDOG hook inside run_steps tripped
                 # mid-step: same recovery as the runner's own check
                 # (it already names the offending fields and cells)
                 logger.warning("step %d: %s", self.step, e)
-                self._trip(details=e.details if e.details else None)
+                code, details = 2, (e.details if e.details else None)
+            except Exception as e:  # noqa: BLE001 - filtered just below
+                if not _is_resource_exhausted(e):
+                    # non-recoverable: tell the peers before dying —
+                    # they are (or soon will be) blocked in this
+                    # step's consensus reduce, which unlike
+                    # coord.barrier has no timeout of its own; a
+                    # FATAL code makes every rank raise in sync
+                    # instead of N-1 ranks hanging in a collective
+                    try:
+                        coord.trip_consensus(self.grid, _TRIP_FATAL)
+                    except Exception:  # noqa: BLE001 - dying anyway
+                        pass
+                    raise
+                # a device OOM that escaped the step (no guarded_step
+                # in the loop, or an injected one): recover like a
+                # trip — rollback frees the live buffers and the
+                # bounded retry surfaces a persistent OOM as
+                # ResilienceExhaustedError
+                logger.warning("step %d: %s", self.step, e)
+                code, details = 3, {"resource_exhausted":
+                                    np.empty(0, np.uint64)}
+            agreed = coord.trip_consensus(self.grid, code)
+            if agreed >= _TRIP_FATAL:
+                raise ResilienceExhaustedError(
+                    f"a peer rank failed fatally at step {self.step} "
+                    "(non-recoverable exception on another rank; see "
+                    "its log) — stopping in sync instead of hanging "
+                    "in its abandoned collectives")
+            if agreed:
+                if code == 0:
+                    # another rank tripped; this one rolls back with it
+                    details = {"remote_rank_trip": np.empty(0, np.uint64)}
+                self._trip(details=details)
                 continue
             self.step += 1
             faults.poison_step(self.grid, self.step)

@@ -1,0 +1,24 @@
+#!/bin/sh
+# Real multi-process CI leg: the 2-process jax.distributed CPU smoke
+# harness (tests/mp_harness.py) — save/restore through the two-phase
+# commit with REAL barriers and the REAL cross-rank CRC all-gather,
+# _replicated_pull psum consistency, barrier-timeout, rank-kill
+# recovery, and distributed trip consensus. Complements the faked
+# splits of tests/test_multiprocess.py (which run in tier-1) with
+# actual OS processes.
+#
+# Skips cleanly (exit 0, with a notice) where jax.distributed on CPU
+# is unavailable — the harness probes the environment first and exits
+# 77 in that case. Seeds are deterministic (fuzz.py style): pass
+# --seed N to replay a run byte-identically.
+#
+# Usage: tests/ci_mp_leg.sh [extra mp_harness args, e.g. --seed 3]
+set -e
+cd "$(dirname "$0")/.."
+rc=0
+python tests/mp_harness.py --procs 2 "$@" || rc=$?
+if [ "$rc" = "77" ]; then
+    echo "ci_mp_leg: SKIP (jax.distributed unavailable on CPU here)"
+    exit 0
+fi
+exit $rc

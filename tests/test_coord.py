@@ -1,0 +1,288 @@
+"""The distributed-coordination layer (dccrg_tpu/coord.py): timeout-
+guarded barriers, guarded jax.distributed bring-up, cross-rank trip
+consensus, and the cached host-collective programs they ride on.
+
+Everything here runs on the single-controller test mesh — the injected
+``barrier_hang`` exercises the REAL watchdog machinery (the sync is
+replaced by a sleep inside the watchdog thread, so the timeout path
+itself is what trips). The genuinely multi-process versions of these
+scenarios run in tests/mp_harness.py.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dccrg_tpu import comm, coord, faults
+from dccrg_tpu.grid import Grid
+
+pytestmark = pytest.mark.faultinject
+
+
+def _mk():
+    return (Grid(cell_data={"v": jnp.float32})
+            .set_initial_length((4, 4, 4))
+            .set_neighborhood_length(1)
+            .initialize(partition="block"))
+
+
+# -- barrier ----------------------------------------------------------
+
+def test_barrier_is_noop_on_single_controller():
+    t0 = time.monotonic()
+    coord.barrier("nothing-to-sync", timeout=0.05)
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_barrier_timeout_raises_typed_error_within_bound():
+    plan = faults.FaultPlan()
+    plan.barrier_hang()
+    t0 = time.monotonic()
+    with plan, pytest.raises(coord.BarrierTimeoutError) as ei:
+        coord.barrier("ckpt-commit", timeout=0.3)
+    assert time.monotonic() - t0 < 3.0
+    assert ei.value.tag == "ckpt-commit"
+    assert "ckpt-commit" in str(ei.value)
+    assert plan.fired("coord.barrier_hang") == 1
+
+
+def test_barrier_hang_matches_tag():
+    """A hang pinned to one tag must not fire on other barriers."""
+    plan = faults.FaultPlan()
+    plan.barrier_hang(tag="only-this-one")
+    with plan:
+        coord.barrier("some-other", timeout=0.2)  # unaffected
+        with pytest.raises(coord.BarrierTimeoutError):
+            coord.barrier("only-this-one", timeout=0.2)
+
+
+def test_barrier_survives_slow_but_alive_peer():
+    """A finite hang below the timeout models a slow peer: the barrier
+    completes instead of raising."""
+    plan = faults.FaultPlan()
+    plan.barrier_hang(hang_s=0.05)
+    with plan:
+        coord.barrier("slow-peer", timeout=5.0)
+
+
+def test_barrier_timeout_env_knob(monkeypatch):
+    monkeypatch.setenv("DCCRG_BARRIER_TIMEOUT", "0.2")
+    assert coord.barrier_timeout() == 0.2
+    plan = faults.FaultPlan()
+    plan.barrier_hang()
+    with plan, pytest.raises(coord.BarrierTimeoutError) as ei:
+        coord.barrier("env-bound")  # no explicit timeout: env applies
+    assert ei.value.timeout == 0.2
+    monkeypatch.setenv("DCCRG_BARRIER_TIMEOUT", "not-a-number")
+    assert coord.barrier_timeout() == coord.DEFAULT_BARRIER_TIMEOUT
+
+
+def test_injected_transient_barrier_error_propagates():
+    """Transient coordination errors (io kind at coord.barrier) are
+    raised to the caller — barriers are NOT silently retried (a rank
+    re-entering a barrier alone would desynchronize the sequence)."""
+    plan = faults.FaultPlan()
+    plan.io_error(site="coord.barrier")
+    with plan, pytest.raises(faults.InjectedIOError):
+        coord.barrier("flaky")
+
+
+# -- guarded distributed init -----------------------------------------
+
+def test_distributed_init_retries_transient_failures(monkeypatch):
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    plan = faults.FaultPlan()
+    plan.io_error(site="coord.init", times=2)
+    with plan:
+        coord.distributed_init("127.0.0.1:1234", 2, 0,
+                               retries=3, backoff=0.0)
+    assert len(calls) == 1  # two injected failures, then success
+    assert plan.fired("coord.init") == 2
+
+
+def test_distributed_init_exhausts_to_typed_error(monkeypatch):
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: (_ for _ in ()).throw(
+                            RuntimeError("coordinator unreachable")))
+    with pytest.raises(coord.DistributedInitError,
+                       match="coordinator unreachable"):
+        coord.distributed_init("127.0.0.1:1234", 2, 0,
+                               retries=1, backoff=0.0)
+
+
+# -- trip consensus ---------------------------------------------------
+
+def test_trip_consensus_single_controller_passthrough():
+    g = _mk()
+    assert not g._multiproc
+    assert coord.trip_consensus(g, 0) == 0
+    assert coord.trip_consensus(g, 2) == 2
+
+
+def test_trip_consensus_runs_the_collective_under_a_faked_split():
+    """On a multi-process grid the consensus is a real device
+    all-reduce (max) with this rank's code on its local device rows —
+    on a faked split the result is the local code (no second process
+    to disagree), but the compiled path is the one real meshes run."""
+    g = _mk()
+    g._proc_local_dev = np.array([d < g.n_dev // 2
+                                  for d in range(g.n_dev)], dtype=bool)
+    assert g._multiproc
+    assert coord.trip_consensus(g, 0) == 0
+    assert coord.trip_consensus(g, 3) == 3
+
+
+def test_host_collective_programs_are_cached():
+    """The satellite fix for comm._mesh_map: repeated host collectives
+    over the same mesh reuse ONE compiled callable (the consensus and
+    CRC-gather reductions run every step / every checkpoint)."""
+    g = _mk()
+    x = np.arange(g.n_dev, dtype=np.int32)
+    r1 = comm.host_all_reduce(g.mesh, x, "max")
+    n_after_first = len(comm._MESH_PROGRAMS)
+    r2 = comm.host_all_reduce(g.mesh, x + 1, "max")
+    assert len(comm._MESH_PROGRAMS) == n_after_first
+    assert int(r1) == g.n_dev - 1 and int(r2) == g.n_dev
+    # distinct ops get distinct programs; repeats of each are cached
+    comm.host_all_reduce(g.mesh, x, "sum")
+    n_after_sum = len(comm._MESH_PROGRAMS)
+    comm.host_all_reduce(g.mesh, x, "sum")
+    assert len(comm._MESH_PROGRAMS) == n_after_sum
+    g2 = _mk()  # same mesh object -> same cache entries
+    comm.host_all_reduce(g2.mesh, x, "sum")
+    assert len(comm._MESH_PROGRAMS) == n_after_sum
+
+
+def test_crc_gather_dtype_survives_x64_off():
+    """The two-phase commit ships CRC32s through host_all_gather as
+    uint32 ON PURPOSE: with jax_enable_x64 off (JAX's default — the
+    library never flips it; only the test harnesses do) 64-bit dtypes
+    are silently canonicalized to 32 bits inside the device put, which
+    would wrap any CRC >= 2^31 and make healthy ranks look dead at
+    commit time. Pin that uint32 rows — including values >= 2^31 —
+    round-trip exactly with x64 disabled."""
+    g = _mk()
+    rows = np.full((g.n_dev, 3), 0, dtype=np.uint32)
+    rows[:, 0] = np.uint32(0xFFFFFFFF)   # max CRC32
+    rows[:, 1] = np.uint32(0x90000000)   # the sign-bit wrap case
+    rows[:, 2] = np.arange(g.n_dev, dtype=np.uint32)
+    jax.config.update("jax_enable_x64", False)
+    try:
+        full = comm.host_all_gather(g.mesh, rows)[0]
+    finally:
+        jax.config.update("jax_enable_x64", True)
+    assert full.dtype == np.uint32
+    np.testing.assert_array_equal(full, rows)
+
+
+def test_host_some_reduce_still_correct_with_sharded_mask():
+    """The cache rewrite moved the peer mask from a baked-in closure to
+    a sharded argument; results must be unchanged."""
+    g = _mk()
+    n = g.n_dev
+    rng = np.random.default_rng(5)
+    x = rng.random((n, 3)).astype(np.float32)
+    mask = rng.random((n, n)) < 0.5
+    got = comm.host_some_reduce(g.mesh, x, mask)
+    want = np.stack([mask[q].astype(np.float32) @ x for q in range(n)])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_runner_fatal_peer_trip_raises_in_sync(tmp_path, monkeypatch):
+    """A FATAL consensus code (a peer hit a non-recoverable error)
+    makes this rank raise instead of rolling back — the alternative is
+    hanging forever in the dead peer's abandoned collectives."""
+    from dccrg_tpu import resilience
+    from dccrg_tpu.resilience import (ResilienceExhaustedError,
+                                      ResilientRunner)
+
+    g = _mk()
+    g.set("v", g.plan.cells, np.ones(len(g.plan.cells), np.float32))
+
+    def fake_consensus(grid, code):
+        return resilience._TRIP_FATAL if runner.step == 2 else int(code)
+
+    monkeypatch.setattr(coord, "trip_consensus", fake_consensus)
+    runner = ResilientRunner(
+        g, lambda grid, i: None, str(tmp_path / "f.dc"),
+        check_every=100, checkpoint_every=100, backoff=0.0,
+        diagnostics_dir=str(tmp_path))
+    with pytest.raises(ResilienceExhaustedError, match="peer rank"):
+        runner.run(5)
+    assert runner.step == 2  # stopped where the peer died
+
+
+def test_runner_broadcasts_fatal_before_reraising(tmp_path, monkeypatch):
+    """A non-recoverable local error still propagates unchanged, but
+    only AFTER a fatal trip code was offered to the peers (so they
+    unblock and raise too rather than hang in the consensus reduce)."""
+    from dccrg_tpu import resilience
+    from dccrg_tpu.resilience import ResilientRunner
+
+    g = _mk()
+    sent = []
+    monkeypatch.setattr(coord, "trip_consensus",
+                        lambda grid, code: sent.append(code) or int(code))
+
+    def step_fn(grid, i):
+        if i == 1:
+            raise ValueError("boom")
+
+    runner = ResilientRunner(
+        g, step_fn, str(tmp_path / "b.dc"),
+        check_every=100, checkpoint_every=100, backoff=0.0,
+        diagnostics_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="boom"):
+        runner.run(5)
+    assert resilience._TRIP_FATAL in sent
+
+
+def test_runner_rolls_back_on_remote_rank_trip(tmp_path, monkeypatch):
+    """Distributed trip consensus in ResilientRunner: a trip reported
+    by ANOTHER rank (consensus code > 0 while this rank saw nothing)
+    must roll this rank back too — that is what keeps all ranks on the
+    same checkpoint instead of deadlocked in a half-entered barrier."""
+    from dccrg_tpu.resilience import ResilientRunner
+
+    g = _mk()
+    cells = g.plan.cells
+    g.set("v", cells, (cells % np.uint64(7)).astype(np.float32))
+
+    def step_fn(grid, i):
+        grid.run_steps(lambda c, n, o, m: {"v": c["v"] * np.float32(1.5)},
+                       ["v"], ["v"], 1)
+
+    remote_trips = []
+
+    def fake_consensus(grid, code):
+        if runner.step == 3 and not remote_trips:
+            remote_trips.append(runner.step)
+            return 2  # a peer rank tripped; this rank saw code == 0
+        return int(code)
+
+    # ResilientRunner.run does `from . import coord` lazily, so
+    # patching the coord module itself intercepts its calls
+    monkeypatch.setattr(coord, "trip_consensus", fake_consensus)
+    runner = ResilientRunner(g, step_fn, str(tmp_path / "c.dc"),
+                             check_every=100, checkpoint_every=2,
+                             backoff=0.0, diagnostics_dir=str(tmp_path))
+    runner.run(5)
+    assert remote_trips == [3]
+    assert runner.rollbacks == 1
+    assert runner.step == 5
+    assert runner.trips[0]["fields"].get("remote_rank_trip") == []
+    # the rolled-back rank reconverges bitwise with an undisturbed run
+    g2 = _mk()
+    g2.set("v", cells, (cells % np.uint64(7)).astype(np.float32))
+    r2 = ResilientRunner(g2, step_fn, str(tmp_path / "c2.dc"),
+                         check_every=100, checkpoint_every=2,
+                         backoff=0.0, diagnostics_dir=str(tmp_path))
+    r2.run(5)
+    assert (np.asarray(g.get("v", cells)).tobytes()
+            == np.asarray(g2.get("v", cells)).tobytes())

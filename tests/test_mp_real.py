@@ -1,0 +1,56 @@
+"""Pytest wrapper over the REAL multi-process smoke harness
+(tests/mp_harness.py): each scenario spawns 2 actual OS processes
+under ``jax.distributed.initialize`` on the CPU backend.
+
+Marked ``mp`` (run via ``tests/ci_mp_leg.sh`` / ``pytest -m mp``) and
+``slow`` so the tier-1 run stays single-process; skips cleanly where
+``jax.distributed`` on CPU is unavailable (the harness probes first
+and exits 77)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.mp, pytest.mark.slow]
+
+HARNESS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "mp_harness.py")
+SCENARIOS = ("save_restore", "psum", "barrier_timeout", "rank_kill",
+             "consensus")
+
+
+def _run(scenario, seed=0, timeout=300):
+    out = subprocess.run(
+        [sys.executable, HARNESS, "--scenario", scenario,
+         "--seed", str(seed), "--timeout", str(timeout - 60)],
+        capture_output=True, text=True, timeout=timeout)
+    if out.returncode == 77:
+        pytest.skip("jax.distributed unavailable on CPU here")
+    assert out.returncode == 0, (
+        f"{scenario} failed (rc {out.returncode}):\n"
+        f"{out.stdout[-4000:]}\n{out.stderr[-2000:]}")
+    return out.stdout
+
+
+def _digests(stdout):
+    return sorted(line.split(" DIGEST ")[1]
+                  for line in stdout.splitlines() if " DIGEST " in line)
+
+
+@pytest.mark.parametrize("scenario", SCENARIOS)
+def test_real_two_process_scenario(scenario):
+    _run(scenario)
+
+
+def test_real_harness_is_seed_deterministic():
+    """Two runs with the same seed write the byte-identical checkpoint
+    (compared via the DIGEST lines the harness relays — the fuzz.py-
+    style determinism contract), and a different seed writes different
+    bytes (the digest is not a constant)."""
+    a = _digests(_run("save_restore", seed=7))
+    b = _digests(_run("save_restore", seed=7))
+    c = _digests(_run("save_restore", seed=8))
+    assert a and a == b
+    assert a != c

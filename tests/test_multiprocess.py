@@ -5,13 +5,19 @@ A multi-process mesh differs from a single-controller one only in
 which shards the host may touch: uploads go through put_sharded (each
 process serves its addressable shards), get/set become rank-local
 (the reference's operator[] semantics, dccrg.hpp:7738-7803), and
-checkpoint I/O writes per-process slices (the reference's collective
-MPI-IO with per-rank file views, dccrg.hpp:1594-1659). Faking
-``grid._proc_local_dev`` exercises exactly those code paths; the
-shards stay addressable underneath, so the restriction logic and the
+checkpoint I/O writes per-process slices through the TWO-PHASE COMMIT
+protocol (slices into ``<file>.mp-tmp`` with per-run CRC32s, commit
+barrier, verify + atomic rename by the committing rank — hardening
+the reference's collective MPI-IO write, dccrg.hpp:1594-1659, against
+rank death). Faking ``grid._proc_local_dev`` (+ a per-pass
+``_ckpt_rank``) exercises exactly those code paths; the shards stay
+addressable underneath, so the restriction logic and the
 slice-merging can be verified byte-for-byte against the
 single-controller result — two faked processes writing one file must
-reproduce the single-save file exactly.
+reproduce the single-save file exactly, and a rank killed at ANY save
+phase must leave the previous checkpoint bitwise intact. The REAL
+(multi-OS-process, jax.distributed) version of these scenarios runs
+in tests/mp_harness.py.
 """
 
 import os
@@ -22,6 +28,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from dccrg_tpu import coord, faults, resilience
+from dccrg_tpu import checkpoint as checkpoint_mod
 from dccrg_tpu.grid import Grid
 
 
@@ -37,13 +45,43 @@ def _mk(fields=None, n=(8, 8, 8)):
     return g
 
 
-def _fake_split(g, local_devs):
+def _fake_split(g, local_devs, rank=None):
     g._proc_local_dev = np.array(
         [d in set(local_devs) for d in range(g.n_dev)], dtype=bool)
+    g._ckpt_rank = rank
 
 
 def _unfake(g):
     g._proc_local_dev = np.ones(g.n_dev, dtype=bool)
+    g._ckpt_rank = None
+    for attr in ("_ckpt_writes_meta", "_ckpt_commits"):
+        if hasattr(g, attr):
+            delattr(g, attr)
+
+
+@pytest.fixture(autouse=True)
+def _clean_mp_state():
+    """Rank-death tests abort mid-protocol; never leak staged CRCs or
+    temp files into the next test."""
+    yield
+    checkpoint_mod._MP_CRC_STAGE.clear()
+
+
+def _rank_pass(g, rank, fn, **save_kwargs):
+    """One fake rank's pass of the two-phase save protocol: rank 0 is
+    the meta writer, the LAST rank (1 of 2 here) commits."""
+    half = g.n_dev // 2
+    _fake_split(g, range(half) if rank == 0 else range(half, g.n_dev),
+                rank=rank)
+    g._ckpt_writes_meta = rank == 0
+    g._ckpt_commits = rank == 1
+    g.save_grid_data(str(fn), **save_kwargs)
+
+
+def _two_pass_save(g, fn, **save_kwargs):
+    for rank in (0, 1):
+        _rank_pass(g, rank, fn, **save_kwargs)
+    _unfake(g)
 
 
 def test_get_set_are_rank_local():
@@ -97,9 +135,11 @@ def test_collective_paths_unchanged_under_split():
 
 def _single_vs_split_save(make_grid, tmp_path, **save_kwargs):
     """Save an identically-built grid once single-controller and once
-    as two faked processes filling one file; return both byte strings.
-    The protocol under test: proc 0 writes meta + its slice, proc 1
-    (_ckpt_writes_meta=False) fills its own payload runs."""
+    as two faked processes running the TWO-PHASE protocol into one
+    file; return both byte strings. The protocol under test: rank 0
+    writes meta + its slice runs into the .mp-tmp, rank 1
+    (_ckpt_writes_meta=False, _ckpt_commits=True) fills its own runs,
+    verifies every slice CRC and atomically publishes."""
     files = {}
     for mode in ("single", "split"):
         g = make_grid()
@@ -107,12 +147,7 @@ def _single_vs_split_save(make_grid, tmp_path, **save_kwargs):
         if mode == "single":
             g.save_grid_data(str(fn), **save_kwargs)
         else:
-            half = g.n_dev // 2
-            _fake_split(g, range(half))
-            g.save_grid_data(str(fn), **save_kwargs)
-            _fake_split(g, range(half, g.n_dev))
-            g._ckpt_writes_meta = False
-            g.save_grid_data(str(fn), **save_kwargs)
+            _two_pass_save(g, fn, **save_kwargs)
         files[mode] = fn.read_bytes()
     return files["single"], files["split"]
 
@@ -300,6 +335,264 @@ def test_ppermute_exchange_never_materializes_dense_pair_tables():
     assert sends and hood._send_rows is None  # compact-backed
     _ = hood.send_rows
     assert hood._send_rows is not None
+
+
+# -- two-phase-commit save: atomicity under rank death ----------------
+
+def _value_grid(val=None):
+    g = _mk()
+    cells = g.plan.cells
+    if val is None:
+        g.set("v", cells, (cells % np.uint64(11)).astype(np.float32))
+    else:
+        g.set("v", cells, np.full(len(cells), val, np.float32))
+    return g
+
+
+def test_two_phase_publishes_only_at_commit(tmp_path):
+    """Nothing appears under the final name until the committing rank
+    has verified every slice: after rank 0's pass only the .mp-tmp
+    exists; after rank 1's commit the final file exists, the temp is
+    gone, and the bytes equal the single-controller save."""
+    fn = tmp_path / "a.dc"
+    single = tmp_path / "s.dc"
+    _value_grid().save_grid_data(str(single))
+
+    g = _value_grid()
+    _rank_pass(g, 0, fn, sidecar=True)
+    assert not fn.exists()
+    assert os.path.exists(str(fn) + checkpoint_mod.MP_TMP_SUFFIX)
+    _rank_pass(g, 1, fn, sidecar=True)
+    assert fn.exists()
+    assert not os.path.exists(str(fn) + checkpoint_mod.MP_TMP_SUFFIX)
+    assert fn.read_bytes() == single.read_bytes()
+    # the committing rank wrote the sidecar, extended with the
+    # per-rank slice table, and it verifies clean
+    rec = resilience.read_sidecar(str(fn))
+    assert rec["slices"] and all(len(s) == 5 for s in rec["slices"])
+    assert {s[1] for s in rec["slices"]} == {0, 1}
+    assert resilience.verify_checkpoint(str(fn)) == []
+
+
+@pytest.mark.faultinject
+@pytest.mark.parametrize("rank,phase", [
+    (0, "meta"), (0, "slice"), (0, "written"),
+    (1, "slice"), (1, "written"), (1, "commit"),
+])
+def test_rank_death_at_every_phase_preserves_old_checkpoint(
+        tmp_path, rank, phase):
+    """Kill one fake rank at each instrumented save phase: the
+    surviving protocol must never publish a torn file — the previous
+    checkpoint stays bitwise intact, still verifies against its
+    sidecar, and still loads."""
+    fn = tmp_path / "ck.dc"
+    _two_pass_save(_value_grid(), fn, sidecar=True)
+    good = fn.read_bytes()
+    good_side = (tmp_path / "ck.dc.crc").read_bytes()
+
+    g = _value_grid(7.0)  # new state that must NOT reach the file
+    plan = faults.FaultPlan(seed=11)
+    plan.rank_death(phase=phase, rank=rank)
+    outcomes = []
+    with plan:
+        for r in (0, 1):
+            try:
+                _rank_pass(g, r, fn, sidecar=True)
+            except Exception as e:  # noqa: BLE001 - recorded + asserted
+                outcomes.append((r, type(e)))
+    assert (rank, faults.InjectedRankDeath) in outcomes
+    if rank == 0:
+        # the survivor is the committer: it must have ABORTED (missing
+        # or unverifiable slices), loudly, not published garbage
+        assert any(issubclass(t, (coord.CheckpointCommitError,
+                                  OSError))
+                   for r, t in outcomes if r == 1)
+    assert fn.read_bytes() == good
+    assert (tmp_path / "ck.dc.crc").read_bytes() == good_side
+    assert resilience.verify_checkpoint(str(fn)) == []
+    grid, _hdr, rep = resilience.load_checkpoint(str(fn),
+                                                 {"v": jnp.float32})
+    assert rep.clean
+    cells = grid.plan.cells
+    np.testing.assert_array_equal(
+        grid.get("v", cells), (cells % np.uint64(11)).astype(np.float32))
+
+
+@pytest.mark.faultinject
+def test_rank_death_after_publish_leaves_new_checkpoint(tmp_path):
+    """Death between the rename and the sidecar write: the NEW bytes
+    are published whole (the rename already happened) with no sidecar
+    — strict load refuses conservatively, salvage load returns the new
+    state. 'Either the old or the new checkpoint intact' — this is the
+    'new' arm."""
+    fn = tmp_path / "p.dc"
+    _two_pass_save(_value_grid(), fn, sidecar=True)
+
+    g = _value_grid(5.0)
+    plan = faults.FaultPlan()
+    plan.rank_death(phase="publish", rank=1)
+    with plan:
+        _rank_pass(g, 0, fn, sidecar=True)
+        with pytest.raises(faults.InjectedRankDeath):
+            _rank_pass(g, 1, fn, sidecar=True)
+    single = tmp_path / "s.dc"
+    _value_grid(5.0).save_grid_data(str(single))
+    assert fn.read_bytes() == single.read_bytes()  # new bytes, whole
+    with pytest.raises(resilience.CheckpointCorruptionError,
+                       match="no checksum sidecar"):
+        resilience.load_checkpoint(str(fn), {"v": jnp.float32})
+    grid, _hdr, rep = resilience.load_checkpoint(
+        str(fn), {"v": jnp.float32}, strict=False)
+    assert rep.sidecar_missing
+    np.testing.assert_array_equal(
+        grid.get("v", grid.plan.cells),
+        np.full(len(grid.plan.cells), 5.0, np.float32))
+
+
+@pytest.mark.faultinject
+def test_commit_verify_catches_torn_slice_and_metadata(tmp_path):
+    """Bytes torn in the temp file AFTER a rank wrote them (its death
+    mid-pwrite, a flaky disk): the committing rank's verification pass
+    catches both a torn payload slice (naming the writer rank) and a
+    torn metadata block, and never publishes."""
+    fn = tmp_path / "t.dc"
+    tmp = str(fn) + checkpoint_mod.MP_TMP_SUFFIX
+    # torn payload slice of rank 0
+    g = _value_grid()
+    _rank_pass(g, 0, fn, sidecar=True)
+    ps = resilience._sidecar_record(tmp)["payload_start"]
+    faults.flip_bit(tmp, ps + 3, 1)
+    with pytest.raises(coord.CheckpointCommitError) as ei:
+        _rank_pass(g, 1, fn, sidecar=True)
+    assert ei.value.ranks == [0]
+    assert not fn.exists()
+    checkpoint_mod._MP_CRC_STAGE.clear()
+    # torn metadata (offset table) — replicated bytes, verified
+    # without any CRC exchange
+    g = _value_grid()
+    _rank_pass(g, 0, fn, sidecar=True)
+    faults.flip_bit(tmp, 100, 1)
+    with pytest.raises(coord.CheckpointCommitError, match="metadata"):
+        _rank_pass(g, 1, fn, sidecar=True)
+    assert not fn.exists()
+
+
+@pytest.mark.faultinject
+def test_injected_io_fault_mid_slice_never_tears_final(tmp_path):
+    """A transient I/O error during one rank's slice stream aborts that
+    rank's pass; the final name is never touched."""
+    fn = tmp_path / "io.dc"
+    _two_pass_save(_value_grid(), fn, sidecar=True)
+    good = fn.read_bytes()
+    g = _value_grid(9.0)
+    plan = faults.FaultPlan()
+    plan.io_error(site="checkpoint.mp", phase="slice", rank=1)
+    with plan:
+        _rank_pass(g, 0, fn, sidecar=True)
+        with pytest.raises(faults.InjectedIOError):
+            _rank_pass(g, 1, fn, sidecar=True)
+    assert fn.read_bytes() == good
+    assert resilience.verify_checkpoint(str(fn)) == []
+
+
+@pytest.mark.faultinject
+def test_barrier_hang_during_save_times_out_not_hangs(tmp_path):
+    """A lost rank at the commit barrier surfaces as a typed
+    BarrierTimeoutError naming the tag, within the configured bound —
+    never an infinite hang — and nothing is published."""
+    import time
+
+    fn = tmp_path / "h.dc"
+    g = _value_grid()
+    plan = faults.FaultPlan()
+    plan.barrier_hang(tag="save_commit:h.dc")
+    t0 = time.monotonic()
+    with plan, pytest.raises(coord.BarrierTimeoutError,
+                             match="save_commit"):
+        g_ = g
+        half = g_.n_dev // 2
+        _fake_split(g_, range(half), rank=0)
+        g_._ckpt_writes_meta, g_._ckpt_commits = True, False
+        os.environ["DCCRG_BARRIER_TIMEOUT"] = "0.3"
+        try:
+            g_.save_grid_data(str(fn))
+        finally:
+            del os.environ["DCCRG_BARRIER_TIMEOUT"]
+    assert time.monotonic() - t0 < 5.0
+    assert not fn.exists()
+
+
+@pytest.mark.faultinject
+def test_save_barrier_tags_carry_attempt_epoch(tmp_path):
+    """Every save's barrier tags embed a per-grid attempt epoch
+    (`#<n>`), so a collective retry after an asymmetric mid-protocol
+    failure re-aligns the ranks' barrier ids by construction. Pinned
+    via the fault log: hangs pinned to the tag PREFIX fire on distinct
+    full tags across saves."""
+    fn = tmp_path / "e.dc"
+    g = _value_grid()
+    plan = faults.FaultPlan()
+    plan.barrier_hang(tag="save_prepare:e.dc", times=2, hang_s=0.01)
+    with plan:
+        _two_pass_save(g, fn)
+        _two_pass_save(g, fn)
+    tags = [d["tag"] for s, _k, d in plan.log
+            if s == "coord.barrier_hang"]
+    assert len(tags) == 2
+    assert all(t.startswith("save_prepare:e.dc#") for t in tags)
+    assert tags[0] != tags[1]
+
+
+@pytest.mark.faultinject
+def test_salvage_load_names_dead_ranks_cells(tmp_path):
+    """At-rest corruption inside one rank's slice: strict load names
+    the writer rank; salvage returns the intact cells and reports
+    dead_ranks + the zeroed cells (which belong to that rank)."""
+    fn = tmp_path / "sv.dc"
+    g = _value_grid()
+    _two_pass_save(g, fn, sidecar=True, sidecar_chunk_bytes=256)
+    rec = resilience.read_sidecar(str(fn))
+    sl = next(s for s in rec["slices"] if s[1] == 1)
+    faults.flip_bit(str(fn), sl[2] + 5, 2)
+
+    with pytest.raises(resilience.CheckpointCorruptionError,
+                       match=r"rank\(s\) \[1\]"):
+        resilience.load_checkpoint(str(fn), {"v": jnp.float32})
+    grid, _hdr, rep = resilience.load_checkpoint(
+        str(fn), {"v": jnp.float32}, strict=False)
+    assert rep.dead_ranks == [1]
+    assert len(rep.bad_slices) == 1
+    assert len(rep.corrupt_cells)
+    # every zeroed cell belongs to a device the dead rank wrote
+    pos = np.searchsorted(grid.plan.cells, rep.corrupt_cells)
+    rank1_devs = set(range(g.n_dev // 2, g.n_dev))
+    assert set(grid.plan.owner[pos].tolist()) <= rank1_devs
+    # the surviving rank's cells are intact
+    ok = ~np.isin(grid.plan.cells, rep.corrupt_cells)
+    cells = grid.plan.cells[ok]
+    np.testing.assert_array_equal(
+        grid.get("v", cells), (cells % np.uint64(11)).astype(np.float32))
+
+
+def test_save_checkpoint_routes_multiproc_through_two_phase(tmp_path):
+    """resilience.save_checkpoint on a multi-process grid delegates to
+    the two-phase save (the single-controller tmp.pid protocol cannot
+    work across ranks) and still produces a verifying sidecar."""
+    fn = tmp_path / "rc.dc"
+    g = _value_grid()
+    half = g.n_dev // 2
+    _fake_split(g, range(half), rank=0)
+    g._ckpt_writes_meta, g._ckpt_commits = True, False
+    resilience.save_checkpoint(g, str(fn))
+    assert not fn.exists()  # two-phase: nothing published yet
+    _fake_split(g, range(half, g.n_dev), rank=1)
+    g._ckpt_writes_meta, g._ckpt_commits = False, True
+    resilience.save_checkpoint(g, str(fn))
+    _unfake(g)
+    assert resilience.verify_checkpoint(str(fn)) == []
+    single = tmp_path / "s.dc"
+    _value_grid().save_grid_data(str(single))
+    assert fn.read_bytes() == single.read_bytes()
 
 
 def test_initialize_accepts_foreign_process_mesh_structurally():

@@ -367,6 +367,50 @@ def test_runner_survives_watchdog_hook_numerics_error(tmp_path, monkeypatch):
     assert resilience.check_finite(s.grid)
 
 
+def test_runner_recovers_from_transient_oom_trip(tmp_path):
+    """A RESOURCE_EXHAUSTED that escapes the step (no guarded_step in
+    the loop) is a trip like any other: rollback, bounded retry, and —
+    with the one-shot fault exhausted — the replay completes and
+    reconverges bitwise. On multi-process meshes this decision rides
+    the same trip consensus as mutation/numerics trips."""
+    _, ref = _run(tmp_path, "oomref")
+
+    s, base_step, _ = _advection()
+    fired = []
+
+    def step_fn(grid, i):
+        if i == 4 and not fired:
+            fired.append(i)
+            raise faults.SimulatedResourceExhausted("transient, step 4")
+        base_step(grid, i)
+
+    runner = ResilientRunner(
+        s.grid, step_fn, str(tmp_path / "oom.dc"), fields=("density",),
+        check_every=1, checkpoint_every=5, backoff=0.0,
+        diagnostics_dir=str(tmp_path))
+    runner.run(12)
+    assert runner.rollbacks == 1
+    assert runner.trips[0]["fields"].get("resource_exhausted") == []
+    got = np.asarray(s.grid.get("density", s.grid.plan.cells))
+    assert got.tobytes() == ref.tobytes()
+
+
+def test_runner_persistent_oom_exhausts_retries(tmp_path):
+    """An OOM that recurs on every replay exhausts the bounded retries
+    instead of looping forever."""
+    s, _, _ = _advection()
+
+    def step_fn(grid, i):
+        raise faults.SimulatedResourceExhausted("every time")
+
+    runner = ResilientRunner(
+        s.grid, step_fn, str(tmp_path / "oomx.dc"), fields=("density",),
+        check_every=1, checkpoint_every=5, backoff=0.0, max_retries=2,
+        diagnostics_dir=str(tmp_path))
+    with pytest.raises(ResilienceExhaustedError):
+        runner.run(3)
+
+
 # -- endurance (slow tier) --------------------------------------------
 
 @pytest.mark.slow
