@@ -9,11 +9,33 @@ out=bench/chip_results
 mkdir -p "$out"
 ts=$(date +%s)
 
+# A preempted session (the tunnel window closes with a SIGTERM) must
+# leave no stale lock/temp files: kill the in-flight measurement,
+# drop the running marker AND the poller's one-shot latch so the next
+# tunnel contact fires a fresh session, and record the preemption in
+# the log. Finished measurement outputs are kept — partial data from
+# a short window is the point of the priority ordering below.
+lock="$out/.chip_session_running_$ts"
+CHILD=""
+# the lock is an operator-visible "session in flight" marker; the
+# EXIT trap (which also fires after the TERM/INT one) removes it on
+# EVERY exit path — error, preemption or completion — so it can
+# never go stale
+trap 'rm -f "$lock"' EXIT
+trap 'echo "PREEMPTED (TERM/INT): session cut short" | tee -a "$out/log_$ts.txt"; [ -n "$CHILD" ] && kill "$CHILD" 2>/dev/null; rm -f /tmp/tpu_session_started; exit 143' TERM INT
+touch "$lock"
+
 run() { # name, timeout_s, cmd...
   local name=$1 t=$2; shift 2
   echo "=== $name ($(date +%T)) ===" | tee -a "$out/log_$ts.txt"
-  timeout -k 10 "$t" "$@" >"$out/${name}_$ts.out" 2>&1
-  echo "rc=$? $name" | tee -a "$out/log_$ts.txt"
+  # background + `wait` so the TERM trap fires mid-measurement too
+  # (bash defers traps while a foreground command runs)
+  timeout -k 10 "$t" "$@" >"$out/${name}_$ts.out" 2>&1 &
+  CHILD=$!
+  wait "$CHILD"
+  local rc=$?
+  CHILD=""
+  echo "rc=$rc $name" | tee -a "$out/log_$ts.txt"
   tail -3 "$out/${name}_$ts.out" | tee -a "$out/log_$ts.txt"
 }
 

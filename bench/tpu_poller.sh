@@ -7,9 +7,20 @@
 # survive SIGTERM) with `timeout -k 5` as an outer belt, so a wedged
 # tunnel can never wedge the poller.
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
+# A preempted poller (scheduler SIGTERM / operator ctrl-C) must leave
+# no stale one-shot latch behind: a restarted poller should re-fire
+# the measurement session on next contact instead of silently never
+# measuring again. /tmp/tpu_up is status (last-contact record), not a
+# lock — it stays.
+trap 'echo "$(date +%s) PREEMPTED (poller got TERM/INT)" >> /tmp/tpu_poll.log; rm -f /tmp/tpu_session_started "/tmp/tpu_probe.$$"; exit 143' TERM INT
 while true; do
   ts=$(date +%s)
-  out=$(cd "$REPO" && timeout -k 5 120 python -m dccrg_tpu.resilience --timeout 90 2>&1)
+  # probe in the background + `wait`: bash defers traps until the
+  # foreground command exits, so a TERM during a 2-minute probe (or
+  # the 5-minute sleep below) would otherwise go unanswered
+  (cd "$REPO" && timeout -k 5 120 python -m dccrg_tpu.resilience --timeout 90 2>&1) > /tmp/tpu_probe.$$ &
+  wait $! || true
+  out=$(cat /tmp/tpu_probe.$$ 2>/dev/null); rm -f /tmp/tpu_probe.$$
   if echo "$out" | grep -q '^OK'; then
     echo "$ts UP $out" >> /tmp/tpu_poll.log
     echo "$ts" > /tmp/tpu_up
@@ -23,5 +34,6 @@ while true; do
   else
     echo "$ts DOWN $(echo "$out" | tail -1 | head -c 200)" >> /tmp/tpu_poll.log
   fi
-  sleep 300
+  sleep 300 &
+  wait $! || true
 done

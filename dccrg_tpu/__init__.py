@@ -17,7 +17,11 @@ reference dccrg library (header-only C++/MPI/Zoltan; see SURVEY.md):
   hang-proof device probing) with deterministic fault injection,
 - a distributed-coordination layer (``coord``: timeout-guarded
   barriers, two-phase-commit multi-process checkpoints, cross-rank
-  trip consensus, guarded ``jax.distributed`` bring-up).
+  trip consensus, guarded ``jax.distributed`` bring-up),
+- preemption-aware run supervision (``supervise``: SIGTERM/SIGINT
+  emergency checkpoints with a resumable exit code, a step-hang
+  deadline watchdog, auto-resume from the newest verified checkpoint
+  and keep-last-K/keep-every-N retention GC).
 
 Reference: /root/reference (dccrg.hpp and friends). This package is a
 re-design for TPU, not a translation: structure (cell lists, neighbor
@@ -45,6 +49,9 @@ from .resilience import (CheckpointCorruptionError, DeviceProbeError,
                          NumericsError, ResilienceExhaustedError,
                          ResilientRunner, guarded_step, load_checkpoint,
                          save_checkpoint, safe_devices)
+from .supervise import (RESUMABLE_EXIT, CheckpointStore, PreemptedError,
+                        StepTimeoutError, SupervisedRunner,
+                        gc_checkpoints, resume_latest)
 
 __version__ = "0.1.0"
 
@@ -85,4 +92,11 @@ __all__ = [
     "load_checkpoint",
     "save_checkpoint",
     "safe_devices",
+    "RESUMABLE_EXIT",
+    "CheckpointStore",
+    "PreemptedError",
+    "StepTimeoutError",
+    "SupervisedRunner",
+    "gc_checkpoints",
+    "resume_latest",
 ]

@@ -351,6 +351,49 @@ def _replicated_pull(grid, field, cells):
 
 MP_TMP_SUFFIX = ".mp-tmp"
 
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(int(pid), 0)
+    except (ProcessLookupError, OverflowError, ValueError):
+        return False
+    except PermissionError:  # pragma: no cover - exists, not ours
+        return True
+    return True
+
+
+def stale_temp_files(dirpath: str) -> list:
+    """Orphaned save/salvage temp files in ``dirpath``, left behind by
+    a run that died or was preempted mid-save: ``<f>.mp-tmp`` (an
+    unfinished two-phase multi-process save — the atomic rename never
+    happened, so the bytes under the final name are still the previous
+    intact checkpoint), and ``<f>.tmp.<pid>`` / ``<f>.salvage.<pid>``
+    whose owning pid is no longer alive. Never matches a finished
+    checkpoint or its sidecar. Only call between runs (or from the
+    process that owns the saves): an ``.mp-tmp`` of a save in flight
+    in ANOTHER process is indistinguishable from a stale one."""
+    out = []
+    try:
+        names = sorted(os.listdir(dirpath))
+    except OSError:
+        return out
+    for name in names:
+        path = os.path.join(dirpath, name)
+        if not os.path.isfile(path):
+            continue
+        if name.endswith(MP_TMP_SUFFIX):
+            out.append(path)
+            continue
+        for marker in (".tmp.", ".salvage."):
+            idx = name.rfind(marker)
+            if idx < 0:
+                continue
+            pid = name[idx + len(marker):]
+            if pid.isdigit() and not _pid_alive(int(pid)):
+                out.append(path)
+            break
+    return out
+
 # Faked-split CRC staging: {tmp_path: {dev: (rank, [crc per run])}}.
 # REAL multi-process meshes never touch this — their CRCs cross ranks
 # through the device all-gather at the commit barrier; the table only

@@ -30,6 +30,8 @@ rank synchronization through:
   ``MutationAbortedError``, an OOM, a watchdog hook) are taken by
   EVERY rank together: all ranks roll back to the same checkpoint
   instead of deadlocking in a barrier half of them never reach.
+  :func:`broadcast_fatal` is its deadline-bounded best-effort variant
+  for a rank that is about to die and must not hang while saying so.
 - :class:`CheckpointCommitError` — the abort signal of the two-phase
   multi-process checkpoint commit (checkpoint._save_process_slice):
   raised by the committing rank when a slice is missing or fails its
@@ -112,6 +114,32 @@ def barrier_timeout(default: float = DEFAULT_BARRIER_TIMEOUT) -> float:
         return default
 
 
+def run_with_deadline(fn, timeout: float, name: str = "deadline"):
+    """Run ``fn()`` on a daemon worker thread bounded by ``timeout``
+    seconds — the shared watchdog primitive behind the barrier sync,
+    the fatal-trip broadcast and the supervision layer's step/save
+    deadlines. Returns ``(finished, result, error)``; on expiry the
+    worker is abandoned (``finished=False``) — a wedged callee cannot
+    be cancelled, only reported — and the caller decides whether that
+    is a typed error or a logged shrug."""
+    box, err = [], []
+    done = threading.Event()
+
+    def _work():
+        try:
+            box.append(fn())
+        except BaseException as e:  # noqa: BLE001 - caller's to re-raise
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_work, daemon=True, name=f"dccrg-{name}")
+    t.start()
+    if not done.wait(float(timeout)):
+        return False, None, None
+    return True, (box[0] if box else None), (err[0] if err else None)
+
+
 def _coordination_client():
     """The jax.distributed coordination-service client, or None (not
     initialized, or jax internals drifted)."""
@@ -165,32 +193,23 @@ def barrier(tag: str, timeout: float | None = None) -> None:
 
     # watchdog-thread path: sync_global_devices has no deadline of its
     # own, and the injected hang must exercise this same machinery
-    done = threading.Event()
-    err: list = []
-
     def _sync():
-        try:
-            if hang is not None:
-                # a simulated lost rank: the sync never happens; a
-                # finite hang_s below the timeout models a slow-but-
-                # alive peer the barrier should survive
-                time.sleep(min(hang, timeout + 30.0))
-            elif real:  # pragma: no cover - needs a real cluster
-                from jax.experimental import multihost_utils
+        if hang is not None:
+            # a simulated lost rank: the sync never happens; a
+            # finite hang_s below the timeout models a slow-but-
+            # alive peer the barrier should survive
+            time.sleep(min(hang, timeout + 30.0))
+        elif real:  # pragma: no cover - needs a real cluster
+            from jax.experimental import multihost_utils
 
-                multihost_utils.sync_global_devices(f"dccrg:{tag}:{seq}")
-        except Exception as e:  # surfaced on the caller thread
-            err.append(e)
-        finally:
-            done.set()
+            multihost_utils.sync_global_devices(f"dccrg:{tag}:{seq}")
 
-    t = threading.Thread(target=_sync, daemon=True,
-                         name=f"dccrg-barrier:{tag}")
-    t.start()
-    if not done.wait(timeout):
+    finished, _res, err = run_with_deadline(_sync, timeout,
+                                            f"barrier:{tag}")
+    if not finished:
         raise BarrierTimeoutError(tag, timeout)
-    if err:
-        raise err[0]
+    if err is not None:
+        raise err
 
 
 def distributed_init(coordinator_address=None, num_processes=None,
@@ -246,12 +265,15 @@ def trip_consensus(grid, code: int) -> int:
     watchdog hook inside ``run_steps``) are taken by EVERY rank: all
     ranks roll back to the same checkpoint together instead of the
     tripped rank abandoning a collective its peers are still waiting
-    in. Codes are small ints (0 = no trip; 1-3 recoverable — every
-    rank rolls back together; >= resilience._TRIP_FATAL marks a
-    non-recoverable failure — every rank raises in sync); the max
-    across ranks wins. Single-controller grids return ``code``
-    unchanged — the reduction (a cached compiled collective, see
-    comm._mesh_map) only runs on multi-process meshes."""
+    in. Codes are small ints ordered by priority (0 = no trip;
+    resilience._TRIP_INTERRUPT = a consensus-agreed step-boundary
+    interrupt, e.g. a preemption signal — outranked by any real trip;
+    recoverable trips — every rank rolls back together; >=
+    resilience._TRIP_FATAL marks a non-recoverable failure — every
+    rank raises in sync); the max across ranks wins.
+    Single-controller grids return ``code`` unchanged — the reduction
+    (a cached compiled collective, see comm._mesh_map) only runs on
+    multi-process meshes."""
     code = int(code)
     if not grid._multiproc:
         return code
@@ -260,3 +282,30 @@ def trip_consensus(grid, code: int) -> int:
     flags = np.zeros(grid.n_dev, dtype=np.int32)
     flags[grid._proc_local_dev] = np.int32(code)
     return int(comm.host_all_reduce(grid.mesh, flags, "max"))
+
+
+def broadcast_fatal(grid, code: int, timeout: float | None = None) -> None:
+    """Best-effort, deadline-bounded :func:`trip_consensus` broadcast
+    for a rank on its way out of a non-recoverable error. The mesh may
+    be the very thing that is broken (a wedged collective is exactly
+    what :class:`~dccrg_tpu.supervise.StepTimeoutError` reports), so
+    the courtesy broadcast runs on a daemon watchdog thread and is
+    abandoned after ``timeout`` seconds (default:
+    :func:`barrier_timeout`) — telling the peers must never keep the
+    dying rank alive. Exceptions are swallowed: the caller is about to
+    re-raise the error that actually matters."""
+    timeout = barrier_timeout() if timeout is None else float(timeout)
+
+    def _send():
+        try:
+            trip_consensus(grid, code)
+        except Exception:  # noqa: BLE001 - the original error outranks it
+            pass
+
+    finished, _res, _err = run_with_deadline(_send, timeout,
+                                             "fatal-broadcast")
+    if not finished:  # pragma: no cover - needs a wedged mesh
+        logger.warning(
+            "fatal trip code %d could not be broadcast within %.0fs "
+            "(the mesh itself is unreachable); peers must rely on "
+            "their own barrier timeouts", code, timeout)

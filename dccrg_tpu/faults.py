@@ -31,6 +31,13 @@ active plan through the module hooks:
 - :func:`take_barrier_hang` — non-raising query coord.barrier uses to
   turn a scheduled :meth:`~FaultPlan.barrier_hang` into a simulated
   lost-rank hang inside its watchdog thread.
+- :func:`take_preempt` / :func:`take_step_hang` — non-raising queries
+  the run-supervision layer (:mod:`dccrg_tpu.supervise`) uses to turn
+  a scheduled :meth:`~FaultPlan.preempt_signal` into a delivered
+  preemption flag at a step boundary, and a
+  :meth:`~FaultPlan.step_hang` into a wedged dispatch inside the step
+  watchdog's worker thread. ``supervise.dispatch`` fires transient
+  :class:`InjectedDispatchError` the supervisor must retry through.
 - :func:`corrupt_file` — mutate a file that was just written
   (truncation / torn tail, single bit flips), simulating post-write
   disk corruption the CRC sidecar must catch.
@@ -71,6 +78,20 @@ class InjectedIOError(OSError):
 
 class InjectedProbeHang(TimeoutError):
     """Injected device-probe timeout (a dead accelerator tunnel)."""
+
+
+class InjectedDispatchError(RuntimeError):
+    """Injected TRANSIENT step-dispatch failure — the ``UNAVAILABLE`` /
+    ``DEADLINE_EXCEEDED`` class of XLA runtime errors a flaky
+    host-to-accelerator link produces. The message carries the literal
+    ``UNAVAILABLE`` marker so handlers that match real XlaRuntimeError
+    text treat both identically; the supervision layer must retry it
+    with backoff instead of tripping a rollback."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(
+            f"UNAVAILABLE: injected transient dispatch error {detail}".rstrip()
+        )
 
 
 class InjectedMutationError(RuntimeError):
@@ -220,6 +241,37 @@ class FaultPlan:
         return self._add("coord.barrier_hang", "hang", times, tag=tag,
                          hang_s=hang_s)
 
+    def preempt_signal(self, step=None, times=1):
+        """A preemption signal (the scheduler's SIGTERM) 'arrives': the
+        supervision layer's step-boundary poll observes it right after
+        step ``step`` completes (None: the next boundary), exactly as
+        if a real signal handler had set the preempt flag mid-step.
+        Queried — not raised — through :func:`take_preempt`, so the
+        whole emergency-checkpoint/resumable-exit machinery of
+        :class:`dccrg_tpu.supervise.SupervisedRunner` is what gets
+        exercised (tier-1's stand-in for the REAL ``kill -TERM`` the
+        mp harness delivers)."""
+        return self._add("supervise.preempt", "preempt", times, step=step)
+
+    def step_hang(self, step=None, times=1, hang_s=None):
+        """The dispatched step wedges — a hung collective or a dead
+        accelerator tunnel mid-dispatch. Queried by the supervision
+        layer's deadline watchdog (:func:`take_step_hang`): the hang
+        replaces the dispatch inside the watchdog's worker thread, so
+        the timeout machinery itself is what gets exercised
+        (:class:`~dccrg_tpu.supervise.StepTimeoutError` within the
+        bound, never a block-forever). A finite ``hang_s`` below the
+        step deadline models a slow-but-alive step that completes."""
+        return self._add("supervise.hang", "hang", times, step=step,
+                         hang_s=hang_s)
+
+    def dispatch_error(self, times=1, step=None):
+        """Transient dispatch failure (:class:`InjectedDispatchError`,
+        the UNAVAILABLE class) at step dispatch. The supervision layer
+        must retry with bounded backoff and succeed WITHOUT tripping a
+        rollback."""
+        return self._add("supervise.dispatch", "dispatch", times, step=step)
+
     def rank_death(self, site="checkpoint.mp", phase=None, rank=None,
                    times=1):
         """This rank dies at an instrumented multi-process point
@@ -306,6 +358,8 @@ def fire(site: str, **ctx) -> None:
     if rule.kind == "rank_death":
         raise InjectedRankDeath(
             f"injected rank death at {site} {ctx}".rstrip())
+    if rule.kind == "dispatch":
+        raise InjectedDispatchError(f"at {site} {ctx}".rstrip())
     raise AssertionError(f"rule kind {rule.kind!r} cannot fire at {site}")
 
 
@@ -322,6 +376,40 @@ def take_barrier_hang(tag: str):
     if rule is None:
         return None
     plan.log.append(("coord.barrier_hang", "hang", {"tag": tag}))
+    hang = rule.params.get("hang_s")
+    return math.inf if hang is None else float(hang)
+
+
+def take_preempt(step: int) -> bool:
+    """Consume a scheduled :meth:`~FaultPlan.preempt_signal` for the
+    boundary after ``step``; True when one fired. Queried — not raised
+    — by the supervision layer's step-boundary poll: the fake sets the
+    SAME preempt flag a real signal handler would, so everything
+    downstream (trip consensus, emergency checkpoint, resumable exit)
+    is the production path."""
+    plan = _active
+    if plan is None:
+        return False
+    rule = plan._take("supervise.preempt", {"step": step})
+    if rule is None:
+        return False
+    plan.log.append(("supervise.preempt", "preempt", {"step": step}))
+    return True
+
+
+def take_step_hang(step: int):
+    """Consume a scheduled :meth:`~FaultPlan.step_hang` for ``step``;
+    returns the hang duration in seconds (math.inf for a wedged-forever
+    dispatch) or None. The hang replaces the dispatch inside the
+    supervision watchdog's worker thread — same discipline as
+    :func:`take_barrier_hang`."""
+    plan = _active
+    if plan is None:
+        return None
+    rule = plan._take("supervise.hang", {"step": step})
+    if rule is None:
+        return None
+    plan.log.append(("supervise.hang", "hang", {"step": step}))
     hang = rule.params.get("hang_s")
     return math.inf if hang is None else float(hang)
 

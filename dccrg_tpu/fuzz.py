@@ -77,20 +77,14 @@ def _step_kernel(cell, nbr, offs, mask, *extra):
 # table lives next to the fire() sites (faults.py)
 _FAULT_SITES = MUTATION_FAULT_SITES
 
-_probed_devices = None
-
-
 def _default_devices():
-    """Device list via the hang-proof subprocess probe (ROUND6 gotcha:
-    raw jax.devices() can block forever on a wedged accelerator
-    tunnel, surviving SIGTERM), memoized — one probe per process, not
-    one per fuzzer."""
-    global _probed_devices
-    if _probed_devices is None:
-        from .resilience import safe_devices
+    """Device list via the memoized hang-proof subprocess probe
+    (resilience.probed_devices — one probe per process, not one per
+    fuzzer; a raw jax.devices() into a wedged accelerator tunnel
+    blocks forever and survives SIGTERM)."""
+    from .resilience import probed_devices
 
-        _probed_devices = list(safe_devices(timeout=120, retries=1))
-    return _probed_devices
+    return probed_devices(timeout=120, retries=1)
 
 
 class GridFuzzer:

@@ -94,6 +94,21 @@ class DeviceProbeError(RuntimeError):
     """The device backend did not answer within the probe budget."""
 
 
+class RunInterrupted(RuntimeError):
+    """The step loop stopped cleanly at a step boundary because the
+    runner's ``interrupt_poll`` requested it — consensus-agreed across
+    ranks, so EVERY rank raises this at the same boundary with the
+    grid holding exactly ``step`` completed steps. Raised for the
+    supervision layer (:mod:`dccrg_tpu.supervise`), which turns it
+    into an emergency checkpoint plus a resumable exit."""
+
+    def __init__(self, step: int):
+        super().__init__(
+            f"run interrupted at the boundary after step {step} "
+            "(preemption requested; state is consistent on every rank)")
+        self.step = int(step)
+
+
 # ---------------------------------------------------------------------
 # checkpoint integrity: CRC sidecar + atomic save + verifying load
 # ---------------------------------------------------------------------
@@ -740,12 +755,20 @@ def guarded_step(grid, kernel, fields_in, fields_out, n_steps=1, *,
 # the resilient step loop: watchdog + checkpoint + rollback
 # ---------------------------------------------------------------------
 
-# trip codes the per-step consensus all-reduces (max wins): 1-3 are
-# recoverable (mutation / numerics / OOM -> every rank rolls back
-# together); >= _TRIP_FATAL means a rank hit a non-recoverable error
-# and every OTHER rank raises in sync instead of hanging in the dead
-# rank's abandoned collectives
-_TRIP_FATAL = 4
+# trip codes the per-step consensus all-reduces (max wins), ordered by
+# priority: _TRIP_INTERRUPT is a consensus-agreed step-boundary
+# interrupt (a preemption signal observed by dccrg_tpu.supervise) that
+# any REAL trip outranks — a rank that tripped rolls everyone back
+# first and the still-set preempt flag is re-polled at the next
+# boundary; _TRIP_ROLLBACK.._TRIP_OOM are recoverable (mutation /
+# numerics / OOM -> every rank rolls back together); >= _TRIP_FATAL
+# means a rank hit a non-recoverable error and every OTHER rank raises
+# in sync instead of hanging in the dead rank's abandoned collectives
+_TRIP_INTERRUPT = 1
+_TRIP_ROLLBACK = 2   # MutationAbortedError
+_TRIP_NUMERICS = 3
+_TRIP_OOM = 4
+_TRIP_FATAL = 5
 
 
 def watchdog_interval(default: int = 0) -> int:
@@ -781,9 +804,14 @@ class ResilientRunner:
     def __init__(self, grid, step_fn, checkpoint_path, *, fields=None,
                  check_every=None, checkpoint_every=10, max_retries=3,
                  backoff=0.05, header=b"", variable=None,
-                 diagnostics_dir=None):
+                 diagnostics_dir=None, interrupt_poll=None):
         self.grid = grid
         self.step_fn = step_fn
+        # optional step-boundary interrupt hook (the supervision
+        # layer's preemption poll): truthy -> the _TRIP_INTERRUPT code
+        # joins this step's trip consensus, and when it wins on every
+        # rank the loop raises RunInterrupted instead of stepping on
+        self.interrupt_poll = interrupt_poll
         self.checkpoint_path = checkpoint_path
         self.fields = fields
         self.check_every = (check_every if check_every is not None
@@ -907,14 +935,15 @@ class ResilientRunner:
                 # recover like a watchdog trip: diagnostics, rollback
                 # to the last checkpoint, bounded retry
                 logger.warning("step %d: %s", self.step, e)
-                code, details = 1, {"mutation": np.asarray(
+                code, details = _TRIP_ROLLBACK, {"mutation": np.asarray(
                     e.cells, dtype=np.uint64)}
             except NumericsError as e:
                 # the DCCRG_WATCHDOG hook inside run_steps tripped
                 # mid-step: same recovery as the runner's own check
                 # (it already names the offending fields and cells)
                 logger.warning("step %d: %s", self.step, e)
-                code, details = 2, (e.details if e.details else None)
+                code, details = _TRIP_NUMERICS, (e.details if e.details
+                                                 else None)
             except Exception as e:  # noqa: BLE001 - filtered just below
                 if not _is_resource_exhausted(e):
                     # non-recoverable: tell the peers before dying —
@@ -922,11 +951,12 @@ class ResilientRunner:
                     # step's consensus reduce, which unlike
                     # coord.barrier has no timeout of its own; a
                     # FATAL code makes every rank raise in sync
-                    # instead of N-1 ranks hanging in a collective
-                    try:
-                        coord.trip_consensus(self.grid, _TRIP_FATAL)
-                    except Exception:  # noqa: BLE001 - dying anyway
-                        pass
+                    # instead of N-1 ranks hanging in a collective.
+                    # Deadline-bounded: the mesh may be the very thing
+                    # that broke (a wedged collective is what
+                    # StepTimeoutError reports), and telling the peers
+                    # must never keep the dying rank alive.
+                    coord.broadcast_fatal(self.grid, _TRIP_FATAL)
                     raise
                 # a device OOM that escaped the step (no guarded_step
                 # in the loop, or an injected one): recover like a
@@ -934,8 +964,16 @@ class ResilientRunner:
                 # bounded retry surfaces a persistent OOM as
                 # ResilienceExhaustedError
                 logger.warning("step %d: %s", self.step, e)
-                code, details = 3, {"resource_exhausted":
-                                    np.empty(0, np.uint64)}
+                code, details = _TRIP_OOM, {"resource_exhausted":
+                                            np.empty(0, np.uint64)}
+            if (code == 0 and self.interrupt_poll is not None
+                    and self.interrupt_poll()):
+                # the step completed cleanly but an interrupt (a
+                # preemption signal) is pending on this rank; offer
+                # the LOWEST-priority code so a real trip elsewhere
+                # still wins (the flag stays set — the next boundary
+                # re-polls it after the collective rollback)
+                code = _TRIP_INTERRUPT
             agreed = coord.trip_consensus(self.grid, code)
             if agreed >= _TRIP_FATAL:
                 raise ResilienceExhaustedError(
@@ -943,12 +981,29 @@ class ResilientRunner:
                     "(non-recoverable exception on another rank; see "
                     "its log) — stopping in sync instead of hanging "
                     "in its abandoned collectives")
-            if agreed:
-                if code == 0:
+            if agreed >= _TRIP_ROLLBACK:
+                if code in (0, _TRIP_INTERRUPT):
                     # another rank tripped; this one rolls back with it
                     details = {"remote_rank_trip": np.empty(0, np.uint64)}
                 self._trip(details=details)
                 continue
+            if agreed == _TRIP_INTERRUPT:
+                # every rank completed this step cleanly and agreed to
+                # stop: the grid holds step+1 completed steps on all of
+                # them — exactly the state the supervision layer's
+                # emergency checkpoint captures
+                self.step += 1
+                if not check_finite(self.grid, self.fields):
+                    # the rollback-target invariant holds for the
+                    # emergency checkpoint too: NEVER hand poisoned
+                    # state to a save (CRCs cannot see NaNs). Recover
+                    # first — check_finite is a global collective, so
+                    # every rank takes this branch together — and the
+                    # still-pending interrupt stops the run at the
+                    # first clean boundary after the rollback.
+                    self._trip()
+                    continue
+                raise RunInterrupted(self.step)
             self.step += 1
             faults.poison_step(self.grid, self.step)
             ckpt_due = self.step % self.checkpoint_every == 0
@@ -1008,12 +1063,102 @@ def safe_devices(timeout: float = 90.0, retries: int = 2,
         f"device backend unreachable after {retries + 1} probe(s): {last}")
 
 
+_PROBED_DEVICES: dict = {}
+
+
+def probed_devices(timeout: float = 120.0, retries: int = 1,
+                   backoff: float = 2.0, platform=None) -> list:
+    """Memoized :func:`safe_devices`: ONE hang-proof subprocess probe
+    per process AND requested platform, however many grids/fuzzers/
+    benches ask (the ROUND6 gotcha: a raw ``jax.devices()`` into a
+    wedged accelerator tunnel blocks forever and survives SIGTERM —
+    and even a successful probe costs a subprocess spawn nobody wants
+    per construction). The cache is keyed by ``platform`` — it
+    changes what the result MEANS, unlike the budget parameters,
+    where the first caller's values win."""
+    if platform not in _PROBED_DEVICES:
+        _PROBED_DEVICES[platform] = list(safe_devices(
+            timeout=timeout, retries=retries, backoff=backoff,
+            platform=platform))
+    return _PROBED_DEVICES[platform]
+
+
+def _tool_main(argv) -> int:
+    """Checkpoint maintenance subcommands, callable without a live
+    accelerator: ``verify <file>`` re-checksums one checkpoint against
+    its sidecar; ``gc <dir> --keep-last K --keep-every N`` applies the
+    supervision layer's retention policy (DRY-RUN by default —
+    ``--apply`` actually prunes; the GC can never delete the only
+    checkpoint that passes verification)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="python -m dccrg_tpu.resilience",
+                                 description=_tool_main.__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    v = sub.add_parser("verify", help="verify a checkpoint's CRC sidecar")
+    v.add_argument("file")
+    g = sub.add_parser("gc", help="prune a checkpoint directory by the "
+                                  "keep-last-K / keep-every-N retention "
+                                  "policy (dry-run unless --apply)")
+    g.add_argument("dir")
+    g.add_argument("--keep-last", type=int, default=3)
+    g.add_argument("--keep-every", type=int, default=0)
+    g.add_argument("--stem", default=None,
+                   help="only checkpoints named <stem>_<step>.dc")
+    g.add_argument("--apply", action="store_true",
+                   help="actually delete (default: report only)")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "verify":
+        try:
+            bad = verify_checkpoint(args.file)
+        except CheckpointCorruptionError as e:
+            print(f"CORRUPT {args.file}: {e}")
+            return 1
+        if bad:
+            rec = read_sidecar(args.file)
+            ranges = _rec_ranges(rec)
+            names = ", ".join(_chunk_name(i, ranges) for i in bad)
+            print(f"CORRUPT {args.file}: checksum mismatch in {names}")
+            return 1
+        print(f"OK {args.file}")
+        return 0
+
+    from . import supervise  # lazy: resilience must import standalone
+
+    rep = supervise.gc_checkpoints(
+        args.dir, keep_last=args.keep_last, keep_every=args.keep_every,
+        stem=args.stem, apply=args.apply)
+    verb = "pruned" if args.apply else "would prune"
+    for step, path in rep.dropped:
+        print(f"{verb} step {step}: {path}")
+    for path in rep.stale_temps:
+        print(f"{verb} stale temp file: {path}")
+    if rep.rescued is not None:
+        print(f"kept step {rep.rescued} beyond policy: it is the only "
+              "checkpoint that passes verification")
+    if rep.refused:
+        print(f"REFUSED: {rep.refused}")
+    print(f"{'applied' if rep.applied else 'dry-run'}: "
+          f"{len(rep.kept)} kept, {len(rep.dropped)} "
+          f"{'pruned' if rep.applied else 'prunable'}, "
+          f"{len(rep.stale_temps)} stale temp file(s)"
+          + ("" if args.apply else " — pass --apply to prune"))
+    return 0
+
+
 def _main(argv=None) -> int:
     """CLI probe for shell scripts: ``python -m dccrg_tpu.resilience
     [--timeout S] [--retries N] [--platform P]`` exits 0 and prints the
-    devices when the backend answers, 1 otherwise — never hangs."""
+    devices when the backend answers, 1 otherwise — never hangs. The
+    checkpoint-maintenance subcommands ``verify <file>`` and ``gc
+    <dir> [--keep-last K] [--keep-every N] [--apply]`` run without
+    touching the accelerator at all (see :func:`_tool_main`)."""
     import argparse
 
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("verify", "gc"):
+        return _tool_main(argv)
     ap = argparse.ArgumentParser(description=_main.__doc__)
     ap.add_argument("--timeout", type=float, default=90.0)
     ap.add_argument("--retries", type=int, default=0)

@@ -1,0 +1,790 @@
+"""Preemption-aware run supervision: the layer that drives the
+resilience stack across a run's whole lifecycle.
+
+On preemptible accelerator fleets the dominant failure mode is not
+corruption (the CRC sidecars catch that), torn mutations (txn.py) or a
+rank dying mid-save (the two-phase commit) — it is **preemption**: the
+scheduler SIGTERMs the job with a short grace window, and a hung
+collective or a compiled step quietly eats that window. The reference
+dccrg survives week-long Vlasiator campaigns only through its MPI-IO
+checkpoint/restart; this module is that restart capability lifted to
+preemptible hardware, wrapped around
+:class:`~dccrg_tpu.resilience.ResilientRunner`:
+
+**Preemption handling** — :class:`SupervisedRunner` installs
+SIGTERM/SIGINT handlers that set a flag; the flag is polled at step
+boundaries and put through the per-step trip consensus
+(``resilience._TRIP_INTERRUPT``, outranked by any real trip), so on a
+multi-process mesh EVERY rank observes the preemption together even
+though only one received the signal. All ranks then take an
+**emergency checkpoint** — the ordinary atomic save, routed through
+the two-phase multi-process path when ``jax.process_count() > 1``,
+with the ``coord.barrier`` timeout shortened to a quarter of the
+grace window (``DCCRG_PREEMPT_GRACE``) so ONE dead peer cannot eat
+all of it — verify its CRC, and surface :class:`PreemptedError`
+carrying the distinct resumable exit code :data:`RESUMABLE_EXIT`
+(``EX_TEMPFAIL``, 75: 'reschedule me').
+
+**Step-hang watchdog** — with ``DCCRG_STEP_TIMEOUT`` (or
+``step_timeout=``) set, each dispatched step runs under a deadline
+thread (``jax.block_until_ready`` included, so async dispatch cannot
+hide a wedged collective) and raises a typed
+:class:`StepTimeoutError` naming the step instead of blocking
+forever. Transient dispatch errors (the ``UNAVAILABLE`` /
+``DEADLINE_EXCEEDED`` class, or injected
+:class:`~dccrg_tpu.faults.InjectedDispatchError`) retry with bounded
+exponential backoff WITHOUT tripping a rollback. Unset, the step path
+is byte-for-byte today's (no thread, no extra sync).
+
+**Auto-resume + retention GC** — periodic checkpoints land in a
+:class:`CheckpointStore` as one numbered file per step
+(``ckpt_00000042.dc``). :func:`resume_latest` scans such a directory
+and picks the newest checkpoint that passes the CRC sidecar
+verification, falling back to older ones and — last — to a salvage
+load of the newest salvageable file. :func:`gc_checkpoints` applies a
+keep-last-K (``DCCRG_KEEP_LAST``) / keep-every-N retention policy
+after each save; it can NEVER delete the only checkpoint that passes
+verification (and refuses to prune at all when nothing verifies), and
+it sweeps stale save/salvage temp files of dead runs
+(:func:`dccrg_tpu.checkpoint.stale_temp_files`).
+
+Every path is pinned deterministically by fault injection
+(:meth:`~dccrg_tpu.faults.FaultPlan.preempt_signal`,
+:meth:`~dccrg_tpu.faults.FaultPlan.step_hang`,
+:meth:`~dccrg_tpu.faults.FaultPlan.dispatch_error`;
+tests/test_supervise.py), and by a REAL ``kill -TERM`` of one rank in
+the multi-process harness (tests/mp_harness.py, scenario
+``preempt``). See also ``examples/preemptible_run.py`` and
+``python -m dccrg_tpu.resilience verify|gc``.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import re
+import signal
+import threading
+import time
+from contextlib import contextmanager, nullcontext
+from dataclasses import dataclass, field as dataclass_field
+
+from . import checkpoint as checkpoint_mod
+from . import coord, faults, resilience
+
+logger = logging.getLogger("dccrg_tpu.supervise")
+
+#: The distinct exit code of a preempted-but-resumable run —
+#: EX_TEMPFAIL (75), the sysexits convention schedulers read as
+#: "transient failure, reschedule me". A supervised job that exits
+#: with it left a CRC-verified checkpoint behind; restart it and call
+#: :func:`resume_latest`.
+RESUMABLE_EXIT = 75
+
+
+class StepTimeoutError(RuntimeError):
+    """A supervised deadline expired: the dispatched step (or the
+    emergency checkpoint — ``what`` says which) did not complete
+    within its bound. The signature of a wedged collective or a dead
+    accelerator tunnel mid-dispatch — the one failure that otherwise
+    blocks forever and silently eats a preemption grace window.
+    ``step`` names the step for step deadlines."""
+
+    def __init__(self, what, timeout, step=None):
+        super().__init__(
+            f"{what} did not complete within {timeout:g}s (wedged "
+            "collective, dead accelerator tunnel, or a stuck host "
+            "callback); the worker thread is abandoned — this state "
+            "is not recoverable in-process, only reportable")
+        self.what = str(what)
+        self.timeout = float(timeout)
+        self.step = step
+
+
+class PreemptedError(RuntimeError):
+    """The supervised run stopped at a step boundary because a
+    preemption signal arrived (or a faked
+    :meth:`~dccrg_tpu.faults.FaultPlan.preempt_signal` fired).
+    ``checkpoint`` is the CRC-verified emergency checkpoint — or,
+    when the emergency save could not finish inside the grace window
+    (``clean=False``), the last periodic one; either way the run is
+    resumable from it via :func:`resume_latest`. ``exit_code`` is
+    :data:`RESUMABLE_EXIT`."""
+
+    exit_code = RESUMABLE_EXIT
+
+    def __init__(self, step, checkpoint=None, clean=True):
+        super().__init__(
+            f"preempted at the boundary after step {step}; resumable "
+            f"from {checkpoint or '<no checkpoint>'} (exit code "
+            f"{RESUMABLE_EXIT})")
+        self.step = int(step)
+        self.checkpoint = checkpoint
+        self.clean = bool(clean)
+
+
+# ---------------------------------------------------------------------
+# env knobs
+# ---------------------------------------------------------------------
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def step_timeout_default(default: float = 0.0) -> float:
+    """The ``DCCRG_STEP_TIMEOUT`` env knob: seconds before a
+    dispatched step is declared wedged (0 = watchdog off; the step
+    path then has no thread and no extra device sync)."""
+    return _env_float("DCCRG_STEP_TIMEOUT", default)
+
+
+def preempt_grace(default: float = 30.0) -> float:
+    """The ``DCCRG_PREEMPT_GRACE`` env knob: seconds the emergency
+    checkpoint may spend after a preemption signal — set it below the
+    scheduler's kill grace. Barriers inside the save get a quarter of
+    it each, so one dead peer cannot eat the whole window."""
+    return _env_float("DCCRG_PREEMPT_GRACE", default)
+
+
+def keep_last_default(default: int = 3) -> int:
+    """The ``DCCRG_KEEP_LAST`` env knob: how many newest checkpoints
+    retention GC keeps (minimum 1)."""
+    try:
+        return max(1, int(os.environ.get("DCCRG_KEEP_LAST", "")
+                          or default))
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------
+# preemption flag + signal handlers
+# ---------------------------------------------------------------------
+
+_PREEMPT = threading.Event()
+_sigint_count = 0
+
+
+def preempt_requested() -> bool:
+    """True when a preemption signal (real or programmatic) is
+    pending; the supervised loop observes it at the next step
+    boundary."""
+    return _PREEMPT.is_set()
+
+
+def request_preempt() -> None:
+    """Set the preempt flag programmatically — exactly what the signal
+    handler (and a consumed :meth:`~dccrg_tpu.faults.FaultPlan
+    .preempt_signal`) does."""
+    _PREEMPT.set()
+
+
+def clear_preempt() -> None:
+    _PREEMPT.clear()
+
+
+def _signal_handler(signum, frame):  # noqa: ARG001 - signal API
+    global _sigint_count
+    if signum == getattr(signal, "SIGINT", None):
+        _sigint_count += 1
+        if _sigint_count > 1:
+            # a second ctrl-C means "now": the graceful path already
+            # had its chance
+            raise KeyboardInterrupt
+    _PREEMPT.set()
+    try:
+        name = signal.Signals(signum).name
+    except ValueError:  # pragma: no cover - exotic signal number
+        name = str(signum)
+    logger.warning(
+        "received %s: finishing the current step, then emergency "
+        "checkpoint and resumable exit (%d)", name, RESUMABLE_EXIT)
+
+
+@contextmanager
+def preemption_handlers(signals=(signal.SIGTERM, signal.SIGINT)):
+    """Install the preemption signal handlers for the duration of a
+    supervised run; previous handlers are restored on exit and the
+    preempt flag starts cleared (this context owns the run's
+    lifecycle). Only the main thread may install handlers — elsewhere
+    this degrades to a no-op and the flag can still be raised via
+    :func:`request_preempt`. A second SIGINT escalates to
+    ``KeyboardInterrupt`` (the graceful path already had its
+    chance)."""
+    global _sigint_count
+    _sigint_count = 0
+    clear_preempt()
+    prev = {}
+    for s in signals:
+        try:
+            prev[s] = signal.signal(s, _signal_handler)
+        except (ValueError, OSError):  # non-main thread / unsupported
+            pass
+    try:
+        yield
+    finally:
+        # the flag belongs to THIS run's lifecycle: a signal this run
+        # already answered (emergency checkpoint + resumable exit)
+        # must not leak into the next run in the same process
+        clear_preempt()
+        for s, h in prev.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+
+
+# ---------------------------------------------------------------------
+# deadline machinery
+# ---------------------------------------------------------------------
+
+def _under_deadline(fn, timeout, what, step=None):
+    """Run ``fn()`` under :func:`dccrg_tpu.coord.run_with_deadline`
+    (the shared watchdog-thread primitive). On expiry the worker is
+    abandoned — a wedged collective cannot be cancelled, only
+    reported — and :class:`StepTimeoutError` is raised; ``fn``'s own
+    exception re-raises on the caller thread."""
+    finished, result, err = coord.run_with_deadline(
+        fn, timeout, f"deadline:{what}")
+    if not finished:
+        raise StepTimeoutError(what, timeout, step=step)
+    if err is not None:
+        raise err
+    return result
+
+
+@contextmanager
+def _grace_env(grace: float):
+    """Shorten ``DCCRG_BARRIER_TIMEOUT`` for the emergency save: the
+    two-phase multi-process checkpoint crosses up to three barriers
+    (prepare/commit/done), so each gets a quarter of the grace window
+    — one dead peer can eat at most its barrier's share, never the
+    whole of it. Never lengthens an already-shorter configured
+    timeout; the caller's value is restored either way."""
+    cut = min(coord.barrier_timeout(), max(1.0, float(grace) / 4.0))
+    old = os.environ.get("DCCRG_BARRIER_TIMEOUT")
+    os.environ["DCCRG_BARRIER_TIMEOUT"] = str(cut)
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("DCCRG_BARRIER_TIMEOUT", None)
+        else:
+            os.environ["DCCRG_BARRIER_TIMEOUT"] = old
+
+
+# markers of the transient class of XLA runtime errors (a flaky
+# host-accelerator link) that a re-dispatch can cure; RESOURCE_EXHAUSTED
+# is excluded — the OOM fallback chain owns it
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED")
+
+
+def _is_transient_dispatch(e: BaseException) -> bool:
+    if isinstance(e, faults.InjectedDispatchError):
+        return True
+    if isinstance(e, (StepTimeoutError, resilience.NumericsError,
+                      faults.SimulatedResourceExhausted)):
+        return False
+    s = str(e)
+    if "RESOURCE_EXHAUSTED" in s:
+        return False
+    return any(m in s for m in _TRANSIENT_MARKERS)
+
+
+# ---------------------------------------------------------------------
+# the numbered checkpoint store + retention GC + auto-resume
+# ---------------------------------------------------------------------
+
+_CKPT_RE = re.compile(r"^(?P<stem>.+)_(?P<step>\d{1,12})\.dc$")
+
+
+def _scan_checkpoints(dirpath: str) -> list:
+    """``[(stem, step, path)]`` of every numbered checkpoint in
+    ``dirpath``, in name order."""
+    out = []
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return out
+    for name in sorted(names):
+        m = _CKPT_RE.match(name)
+        if m is not None:
+            out.append((m.group("stem"), int(m.group("step")),
+                        os.path.join(dirpath, name)))
+    return out
+
+
+def list_checkpoints(dirpath: str, stem: str | None = None) -> list:
+    """``[(step, path)]`` of the numbered checkpoints in ``dirpath``
+    (``<stem>_<step>.dc``), newest step first. ``stem=None`` matches
+    any stem."""
+    out = [(s, p) for st, s, p in _scan_checkpoints(dirpath)
+           if stem is None or st == stem]
+    out.sort(key=lambda e: (-e[0], e[1]))
+    return out
+
+
+def retention_plan(steps, keep_last: int = 3, keep_every: int = 0):
+    """The pure retention policy: which checkpoint steps to keep and
+    which to drop. Keeps the newest ``keep_last`` steps (clamped to at
+    least 1 — the policy alone can never empty a directory) plus, with
+    ``keep_every > 0``, every step divisible by it (the coarse
+    long-horizon trail, the reference's keep-every-Nth restart files).
+    Returns ``(keep, drop)``, both newest first. Verification safety
+    is :func:`gc_checkpoints`'s job, not this function's."""
+    steps = sorted({int(s) for s in steps}, reverse=True)
+    keep = set(steps[:max(1, int(keep_last))])
+    if int(keep_every) > 0:
+        keep.update(s for s in steps if s % int(keep_every) == 0)
+    return ([s for s in steps if s in keep],
+            [s for s in steps if s not in keep])
+
+
+@dataclass
+class GCReport:
+    """What a retention sweep kept, dropped and refused. ``rescued``
+    names a step kept beyond policy because it was the only one that
+    passes verification; ``refused`` is non-None when nothing in the
+    directory verifies and the GC declined to prune at all."""
+
+    kept: list = dataclass_field(default_factory=list)      # [(step, path)]
+    dropped: list = dataclass_field(default_factory=list)   # [(step, path)]
+    stale_temps: list = dataclass_field(default_factory=list)
+    rescued: int | None = None
+    refused: str | None = None
+    applied: bool = False
+
+
+def _unlink(path: str) -> None:
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+
+
+def gc_checkpoints(dirpath: str, keep_last: int = 3, keep_every: int = 0,
+                   stem: str | None = None, apply: bool = False,
+                   assume_ok: int | None = None) -> GCReport:
+    """Prune a checkpoint directory by the keep-last-K / keep-every-N
+    retention policy (:func:`retention_plan`) — DRY-RUN unless
+    ``apply``.
+
+    Two safety properties hold regardless of policy (pinned by the
+    fuzzed retention tests): the prune can NEVER remove the only
+    checkpoint that passes CRC verification (if no keeper verifies,
+    the newest verifying dropee is rescued into the keep set), and
+    when NOTHING verifies the GC refuses to prune at all — a salvage
+    load may still need any of those bytes. Checkpoint files are
+    removed before their sidecars, so a crash mid-prune can only
+    leave a harmless orphan sidecar, never an unverifiable-but-named
+    checkpoint. Stale save/salvage temp files of dead runs are swept
+    too (:func:`dccrg_tpu.checkpoint.stale_temp_files`).
+
+    ``assume_ok`` lets the process that JUST saved (and sidecar-
+    verified) a step vouch for it, skipping a redundant re-read of a
+    potentially multi-GB file on the per-save GC path.
+
+    With ``stem=None`` each stem in the directory is an INDEPENDENT
+    checkpoint sequence: the retention policy and the only-verifiable
+    guard run per stem, so one run's files can never shadow or doom
+    another's."""
+    groups: dict = {}
+    for stem_name, step, path in _scan_checkpoints(dirpath):
+        if stem is not None and stem_name != stem:
+            continue
+        groups.setdefault(stem_name, {})[step] = path
+    kept, dropped = [], []
+    rescued = refused = None
+    for stem_name in sorted(groups):
+        by_step = groups[stem_name]
+        keep_steps, drop_steps = retention_plan(
+            by_step, keep_last, keep_every)
+        if drop_steps:
+            def _ok(step):
+                if assume_ok is not None and step == int(assume_ok):
+                    return True
+                try:
+                    return not resilience.verify_checkpoint(
+                        by_step[step])
+                except resilience.CheckpointCorruptionError:
+                    return False
+
+            if not any(_ok(s) for s in keep_steps):
+                for s in drop_steps:  # newest first
+                    if _ok(s):
+                        rescued = s
+                        drop_steps = [d for d in drop_steps if d != s]
+                        keep_steps = sorted(keep_steps + [s],
+                                            reverse=True)
+                        break
+                else:
+                    refused = (
+                        f"no {stem_name!r} checkpoint passes "
+                        "verification; refusing to prune that "
+                        "sequence — a salvage load may still need "
+                        "any of them")
+                    keep_steps = sorted(keep_steps + drop_steps,
+                                        reverse=True)
+                    drop_steps = []
+        kept.extend((s, by_step[s]) for s in keep_steps)
+        dropped.extend((s, by_step[s]) for s in drop_steps)
+    stale = checkpoint_mod.stale_temp_files(dirpath)
+    if apply:
+        for _s, path in dropped:
+            _unlink(path)  # the .dc first: a crash here leaves only
+            _unlink(resilience.sidecar_path(path))  # an orphan sidecar
+        for path in stale:
+            _unlink(path)
+    return GCReport(kept=kept, dropped=dropped, stale_temps=stale,
+                    rescued=rescued, refused=refused,
+                    applied=bool(apply))
+
+
+class CheckpointStore:
+    """A directory of numbered checkpoints, one file per checkpointed
+    step (``<stem>_<step:08d>.dc`` + CRC sidecar): the disk layout
+    retention GC and :func:`resume_latest` operate on."""
+
+    def __init__(self, dirpath, stem: str = "ckpt"):
+        self.dir = str(dirpath)
+        self.stem = str(stem)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def path_for(self, step: int) -> str:
+        return os.path.join(self.dir, f"{self.stem}_{int(step):08d}.dc")
+
+    def list(self) -> list:
+        """``[(step, path)]``, newest first."""
+        return list_checkpoints(self.dir, self.stem)
+
+    def gc(self, keep_last: int = 3, keep_every: int = 0,
+           apply: bool = True, assume_ok: int | None = None) -> GCReport:
+        return gc_checkpoints(self.dir, keep_last=keep_last,
+                              keep_every=keep_every, stem=self.stem,
+                              apply=apply, assume_ok=assume_ok)
+
+
+@dataclass
+class ResumeInfo:
+    """What :func:`resume_latest` restored: the reconstructed grid,
+    the user header, the completed-step count the checkpoint
+    captured, and how trustworthy it is (``salvaged=True``: corrupt
+    ranges were zeroed / no sidecar existed — ``report`` lists the
+    damage)."""
+
+    grid: object
+    header: bytes
+    step: int
+    path: str
+    report: "resilience.SalvageReport"
+    salvaged: bool = False
+
+
+def resume_latest(dirpath, cell_data, *, stem: str | None = None,
+                  mesh=None, header_size: int = 0, variable=None,
+                  salvage: bool = True, load_balancing_method=None):
+    """Resume from the best checkpoint in ``dirpath``: the newest one
+    that passes CRC verification, falling back to older verified ones,
+    and — with ``salvage`` (default) — last to a salvage load
+    (``strict=False``) of the newest salvageable file. Returns a
+    :class:`ResumeInfo` (grid reconstructed from nothing but the
+    file, via :func:`dccrg_tpu.resilience.load_checkpoint` /
+    ``load_grid``) or None when the directory holds no usable
+    checkpoint. Resume ordering is pinned by
+    tests/test_supervise.py's planted-corruption fixtures."""
+    entries = list_checkpoints(dirpath, stem)
+    skipped = []
+    for step, path in entries:  # newest first: strict, CRC-verified
+        try:
+            grid, header, report = resilience.load_checkpoint(
+                path, cell_data, mesh=mesh, header_size=header_size,
+                variable=variable, strict=True,
+                load_balancing_method=load_balancing_method)
+        except resilience.CheckpointCorruptionError as e:
+            skipped.append((path, str(e)))
+            continue
+        except Exception as e:  # noqa: BLE001 - fall back to older
+            skipped.append((path, f"failed to load: {e}"))
+            continue
+        if skipped:
+            logger.warning(
+                "resume_latest: skipped %d newer checkpoint(s) that "
+                "failed verification: %s", len(skipped),
+                [p for p, _ in skipped])
+        return ResumeInfo(grid, header, step, path, report)
+    if salvage:
+        for step, path in entries:  # newest first: salvage what loads
+            try:
+                grid, header, report = resilience.load_checkpoint(
+                    path, cell_data, mesh=mesh, header_size=header_size,
+                    variable=variable, strict=False,
+                    load_balancing_method=load_balancing_method)
+            except Exception as e:  # noqa: BLE001 - keep walking back
+                skipped.append((path, f"salvage failed: {e}"))
+                continue
+            logger.warning(
+                "resume_latest: NO checkpoint verifies; salvaged %s "
+                "(%d corrupt cell(s) restored with defaults)", path,
+                len(report.corrupt_cells))
+            return ResumeInfo(grid, header, step, path, report,
+                              salvaged=True)
+    if entries:
+        logger.error("resume_latest: no usable checkpoint in %s (%s)",
+                     dirpath, skipped)
+    return None
+
+
+# ---------------------------------------------------------------------
+# the supervised runner
+# ---------------------------------------------------------------------
+
+class _StoreRunner(resilience.ResilientRunner):
+    """A :class:`~dccrg_tpu.resilience.ResilientRunner` whose periodic
+    checkpoints land in the supervisor's :class:`CheckpointStore` as
+    numbered per-step files (rollback always targets the newest), with
+    retention GC after each save."""
+
+    def __init__(self, sup, grid, step_fn, **kw):
+        self._sup = sup
+        super().__init__(grid, step_fn, sup.store.path_for(0), **kw)
+
+    def _save(self):
+        self.checkpoint_path = self._sup.store.path_for(self.step)
+        super()._save()
+        self._sup._after_save(self.step)
+
+
+class SupervisedRunner:
+    """Run a step loop that survives preemption, wedged steps and
+    transient dispatch faults — :class:`~dccrg_tpu.resilience
+    .ResilientRunner` (watchdog, rollback, trip consensus) wrapped
+    with the run-lifecycle machinery the module docstring describes.
+
+    ``step_fn(grid, step_index)`` is the user's step, exactly as for
+    ``ResilientRunner``; periodic checkpoints land in
+    ``checkpoint_dir`` as numbered files. On preemption (SIGTERM /
+    SIGINT / :func:`request_preempt` / a faked
+    ``FaultPlan.preempt_signal``) the run stops at the next step
+    boundary — consensus-agreed on multi-process meshes, so all ranks
+    stop together — takes a CRC-verified emergency checkpoint inside
+    the ``grace`` window and raises :class:`PreemptedError` (exit
+    code :data:`RESUMABLE_EXIT`). Restart the job and pick the run
+    back up with :func:`resume_latest` + ``start_step=info.step``; a
+    resumed run reconverges bitwise with an uninterrupted one (pinned
+    by tests/test_supervise.py and the mp harness).
+
+    Keyword knobs (None = the env default): ``step_timeout``
+    (``DCCRG_STEP_TIMEOUT``; 0 disables the per-step deadline thread
+    entirely), ``grace`` (``DCCRG_PREEMPT_GRACE``), ``keep_last``
+    (``DCCRG_KEEP_LAST``) / ``keep_every`` (retention),
+    ``dispatch_retries`` / ``dispatch_backoff`` (transient-error
+    retry). Remaining keyword arguments (``fields``, ``check_every``,
+    ``checkpoint_every``, ``max_retries``, ``backoff``, ``header``,
+    ``variable``, ``diagnostics_dir``) pass through to
+    ``ResilientRunner``."""
+
+    def __init__(self, grid, step_fn, checkpoint_dir, *, stem="ckpt",
+                 step_timeout=None, dispatch_retries=2,
+                 dispatch_backoff=0.05, keep_last=None, keep_every=0,
+                 grace=None, signals=None, install_signal_handlers=True,
+                 start_step=0, **runner_kw):
+        self.grid = grid
+        self.step_fn = step_fn
+        self.store = CheckpointStore(checkpoint_dir, stem=stem)
+        self.step_timeout = (step_timeout_default() if step_timeout is None
+                             else float(step_timeout))
+        self.dispatch_retries = int(dispatch_retries)
+        self.dispatch_backoff = float(dispatch_backoff)
+        self.keep_last = (keep_last_default() if keep_last is None
+                          else max(1, int(keep_last)))
+        self.keep_every = int(keep_every)
+        self.grace = preempt_grace() if grace is None else float(grace)
+        self.signals = (tuple(signals) if signals is not None
+                        else (signal.SIGTERM, signal.SIGINT))
+        self._install = bool(install_signal_handlers)
+        runner_kw.setdefault("diagnostics_dir", self.store.dir)
+        self._runner = _StoreRunner(self, grid, self._dispatch,
+                                    interrupt_poll=self._poll,
+                                    **runner_kw)
+        self._runner.step = int(start_step)
+        self.preempted = False
+        self.emergency_checkpoint = None
+        self.dispatch_retried = 0  # transient errors retried through
+
+    # -- mirrors of the inner runner's story --------------------------
+
+    @property
+    def runner(self):
+        return self._runner
+
+    @property
+    def step(self):
+        return self._runner.step
+
+    @property
+    def trips(self):
+        return self._runner.trips
+
+    @property
+    def rollbacks(self):
+        return self._runner.rollbacks
+
+    @property
+    def checkpoints(self):
+        return self._runner.checkpoints
+
+    # -- the lifecycle ------------------------------------------------
+
+    def run(self, n_steps: int) -> "SupervisedRunner":
+        """Advance to ``n_steps`` total steps under supervision.
+        Raises :class:`PreemptedError` after the emergency checkpoint
+        when preempted; :class:`StepTimeoutError` when a step wedges
+        past the deadline; whatever ``ResilientRunner`` raises
+        otherwise."""
+        ctx = (preemption_handlers(self.signals) if self._install
+               else nullcontext())
+        with ctx:
+            try:
+                self._runner.run(n_steps)
+            except resilience.RunInterrupted as e:
+                path, clean = self._emergency_checkpoint(e.step)
+                # the preemption has been honored (checkpoint taken):
+                # consume the flag HERE, not only in the handler
+                # context — with install_signal_handlers=False a stale
+                # flag would otherwise re-preempt every later run in
+                # this process at its first boundary
+                clear_preempt()
+                self.preempted = True
+                self.emergency_checkpoint = path
+                raise PreemptedError(e.step, checkpoint=path,
+                                     clean=clean) from e
+        return self
+
+    # -- step dispatch: deadline + transient retry --------------------
+
+    def _poll(self) -> bool:
+        if faults.take_preempt(self._runner.step):
+            request_preempt()
+        return _PREEMPT.is_set()
+
+    def _dispatch(self, grid, i):
+        # a real transient error (async dispatch) typically surfaces
+        # at the block_until_ready AFTER step_fn reassigned grid.data,
+        # so a blind re-dispatch would double-apply the step. The
+        # arrays are immutable, so a dict-of-refs snapshot is enough
+        # to rewind the data state before retrying. (Structural
+        # mutations inside step_fn are transactional and never
+        # classify as transient.)
+        before = dict(grid.data)
+        for attempt in range(self.dispatch_retries + 1):
+            try:
+                faults.fire("supervise.dispatch", step=i, attempt=attempt)
+                self._timed_step(grid, i)
+                return
+            except Exception as e:  # noqa: BLE001 - filtered just below
+                if (not _is_transient_dispatch(e)
+                        or attempt >= self.dispatch_retries):
+                    raise
+                grid.data = dict(before)
+                self.dispatch_retried += 1
+                delay = self.dispatch_backoff * (2 ** attempt)
+                logger.warning(
+                    "transient dispatch error at step %d (%s); retry "
+                    "%d/%d in %.2fs", i, e, attempt + 1,
+                    self.dispatch_retries, delay)
+                time.sleep(delay)
+
+    def _timed_step(self, grid, i):
+        timeout = self.step_timeout
+        hang = faults.take_step_hang(i)
+        if timeout <= 0:
+            if hang is not None and math.isinf(hang):
+                raise RuntimeError(
+                    "FaultPlan.step_hang fired but no step deadline is "
+                    "configured (DCCRG_STEP_TIMEOUT / step_timeout): "
+                    "the injected wedge would block forever")
+            if hang:
+                time.sleep(hang)
+            self.step_fn(grid, i)  # zero-overhead path: no thread
+            return
+
+        def _one():
+            if hang is not None:
+                # the injected wedge replaces the dispatch inside the
+                # worker thread (same discipline as barrier_hang), so
+                # the deadline machinery itself is what gets
+                # exercised; a finite hang below the deadline models
+                # a slow-but-alive step that still completes
+                time.sleep(min(hang, timeout + 30.0))
+                if math.isinf(hang):
+                    return
+            self.step_fn(grid, i)
+            # async dispatch hides a wedged collective until somebody
+            # blocks; make the deadline cover the actual compute
+            import jax
+
+            jax.block_until_ready(list(grid.data.values()))
+
+        _under_deadline(_one, timeout, f"step {i}", step=i)
+
+    # -- preemption: the emergency checkpoint -------------------------
+
+    def _emergency_checkpoint(self, step: int):
+        """The whole emergency save — the ordinary atomic (two-phase
+        on multi-process meshes) checkpoint plus its CRC verification
+        — runs under the ``grace`` deadline with shortened barrier
+        timeouts. If it cannot finish (a dead peer, a wedged device
+        pull), the LAST PERIODIC checkpoint is the resume point: the
+        grace window belongs to the exit, not to the save."""
+        r = self._runner
+        path = self.store.path_for(step)
+
+        def _save():
+            resilience.save_checkpoint(self.grid, path, header=r.header,
+                                       variable=r.variable)
+            bad = resilience.verify_checkpoint(path)
+            if bad:
+                raise resilience.CheckpointCorruptionError(
+                    f"emergency checkpoint {path} failed its own "
+                    f"verification (chunks {bad})", bad_chunks=bad)
+
+        try:
+            with _grace_env(self.grace):
+                _under_deadline(_save, self.grace,
+                                f"emergency checkpoint at step {step}",
+                                step=step)
+        except Exception as e:  # noqa: BLE001 - resumability outranks it
+            logger.error(
+                "emergency checkpoint failed (%s); the last periodic "
+                "checkpoint %s (step %s) is the resume point", e,
+                r.checkpoint_path, r._ckpt_step)
+            return r.checkpoint_path, False
+        logger.warning(
+            "preempted: emergency checkpoint %s (step %d) verified; "
+            "exiting resumable (%d)", path, step, RESUMABLE_EXIT)
+        return path, True
+
+    # -- retention ----------------------------------------------------
+
+    def _after_save(self, step: int) -> None:
+        """Retention GC after every periodic save. Filesystem-only (no
+        barriers), so only one rank prunes; ``keep_last >= 1`` plus
+        the only-verifiable guard means the newest checkpoint — the
+        one a peer may be rolling back to — is never touched."""
+        if self.grid._multiproc and coord.process_rank(self.grid) != 0:
+            return
+        try:
+            rep = self.store.gc(keep_last=self.keep_last,
+                                keep_every=self.keep_every, apply=True,
+                                assume_ok=step)
+        except OSError as e:  # GC must never kill the run
+            logger.warning("retention GC failed (%s); continuing", e)
+            return
+        if rep.dropped or rep.stale_temps:
+            logger.info(
+                "retention GC: pruned %d checkpoint(s) and %d stale "
+                "temp file(s); %d kept", len(rep.dropped),
+                len(rep.stale_temps), len(rep.kept))

@@ -26,6 +26,14 @@ drives the scenarios the faked splits cannot truthfully exercise:
 - ``consensus``     — ResilientRunner's distributed trip consensus: a
   MutationAbortedError raised on ONE rank makes every rank roll back
   to the same checkpoint and the final states agree bit-for-bit.
+- ``preempt``       — the SIGTERM round trip, in three phases: (ref)
+  an uninterrupted supervised run records its final-state digest;
+  (kill) the parent delivers a REAL ``kill -TERM`` to rank 1 mid-run
+  — the trip consensus makes EVERY rank observe the preemption, take
+  the collective two-phase emergency checkpoint (shortened barrier
+  timeouts) and exit with the resumable code 75; (resume)
+  ``supervise.resume_latest`` picks the emergency checkpoint up, the
+  run completes, and its digest must equal ref's bit-for-bit.
 
 Runs are DETERMINISTIC: ``--seed`` drives the field values and fault
 placement the same way fuzz.py's seeds do — two runs with the same
@@ -60,8 +68,12 @@ if REPO_ROOT not in sys.path:
 
 SKIP_RC = 77
 DEATH_RC = 17
+RESUMABLE_RC = 75  # supervise.RESUMABLE_EXIT (EX_TEMPFAIL)
 SCENARIOS = ("save_restore", "psum", "barrier_timeout", "rank_kill",
-             "consensus")
+             "consensus", "preempt")
+# child-side phase names of the parent-orchestrated preempt scenario
+PREEMPT_PHASES = ("preempt_ref", "preempt_kill", "preempt_resume")
+PREEMPT_STEPS = 8
 
 
 # =====================================================================
@@ -361,6 +373,94 @@ def scenario_consensus(args):
     assert len(set(hs)) == 1, hs
 
 
+def _sup_kernel(c, nbr, offs, mask):
+    import jax.numpy as jnp
+
+    return {"v": 0.5 * c["v"] + 0.125 * jnp.sum(
+        jnp.where(mask, nbr["v"], 0.0), axis=1)}
+
+
+def _make_supervised(args, store, sleep_s=0.0, grid=None, start_step=0):
+    """A SupervisedRunner over the harness grid whose step_fn reports
+    progress to ``<store>/progress.rank<r>`` (the parent's cue for
+    WHEN to deliver the real SIGTERM)."""
+    from dccrg_tpu import supervise
+
+    g = grid if grid is not None else _mk_grid(args.seed)
+    prog = os.path.join(store, f"progress.rank{args.rank}")
+
+    def step_fn(grid_, i):
+        grid_.run_steps(_sup_kernel, ["v"], ["v"], 1)
+        if sleep_s:
+            time.sleep(sleep_s)
+        with open(prog, "w") as f:
+            f.write(str(i))
+
+    sup = supervise.SupervisedRunner(
+        g, step_fn, store, check_every=100, checkpoint_every=3,
+        backoff=0.0, keep_last=16, grace=20.0, start_step=start_step,
+        diagnostics_dir=store)
+    return g, sup
+
+
+def _write_digest(args, g, phase):
+    import zlib
+
+    from dccrg_tpu import checkpoint as checkpoint_mod
+
+    cells = g.plan.cells
+    h = f"{zlib.crc32(checkpoint_mod._replicated_pull(g, 'v', cells).tobytes()):08x}"
+    hashes = _kv_allgather(f"preempt_{phase}", h, args.rank, args.procs)
+    assert len(set(hashes)) == 1, hashes
+    with open(os.path.join(args.store,
+                           f"digest.{phase}.rank{args.rank}"), "w") as f:
+        f.write(h)
+    print(f"[rank {args.rank}] DIGEST preempt_{phase} {h}", flush=True)
+
+
+def scenario_preempt_ref(args):
+    """Phase 1: the uninterrupted supervised reference run."""
+    g, sup = _make_supervised(args, args.store)
+    sup.run(PREEMPT_STEPS)
+    _write_digest(args, g, "ref")
+
+
+def scenario_preempt_kill(args):
+    """Phase 2: a REAL ``kill -TERM`` from the parent lands on rank 1
+    mid-run. The per-step trip consensus makes EVERY rank observe the
+    preemption at the same boundary, take the collective two-phase
+    emergency checkpoint (shortened barrier timeouts) and raise
+    PreemptedError — child_main maps it to the resumable exit code
+    after re-verifying the checkpoint's CRC."""
+    _g, sup = _make_supervised(args, args.store, sleep_s=0.4)
+    sup.run(PREEMPT_STEPS)
+    raise AssertionError(
+        "run finished before the parent's SIGTERM landed; raise sleep_s")
+
+
+def scenario_preempt_resume(args):
+    """Phase 3: resume_latest picks the emergency checkpoint, the run
+    completes to the reference step count, and every rank's final
+    state must agree (the parent compares the digest with phase 1's
+    bitwise)."""
+    import jax.numpy as jnp
+
+    from dccrg_tpu import supervise
+
+    info = supervise.resume_latest(args.store, {"v": jnp.float32},
+                                   load_balancing_method="block")
+    assert info is not None, "no usable checkpoint to resume from"
+    assert not info.salvaged and info.report.clean
+    assert 0 < info.step < PREEMPT_STEPS, info.step
+    g = info.grid
+    g.update_copies_of_remote_neighbors()
+    g, sup = _make_supervised(args, args.store, grid=g,
+                              start_step=info.step)
+    sup.run(PREEMPT_STEPS)
+    assert sup.step == PREEMPT_STEPS
+    _write_digest(args, g, "resume")
+
+
 CHILD_SCENARIOS = {
     "probe": scenario_probe,
     "save_restore": scenario_save_restore,
@@ -368,6 +468,9 @@ CHILD_SCENARIOS = {
     "barrier_timeout": scenario_barrier_timeout,
     "rank_kill": scenario_rank_kill,
     "consensus": scenario_consensus,
+    "preempt_ref": scenario_preempt_ref,
+    "preempt_kill": scenario_preempt_kill,
+    "preempt_resume": scenario_preempt_resume,
 }
 
 
@@ -376,7 +479,7 @@ def _marker(args) -> str:
 
 
 def child_main(args) -> int:
-    from dccrg_tpu import faults
+    from dccrg_tpu import faults, supervise
 
     try:
         _child_setup(args)
@@ -390,6 +493,17 @@ def child_main(args) -> int:
         # a REAL rank death: leave no trace, exit the OS process hard
         print(f"[rank {args.rank}] {e}", flush=True)
         os._exit(DEATH_RC)
+    except supervise.PreemptedError as e:
+        # preempted-but-resumable: the contract is a CRC-verified
+        # emergency checkpoint plus the distinct exit code — every
+        # rank must take this path, signaled or not (the consensus)
+        from dccrg_tpu import resilience
+
+        assert e.checkpoint, "preempted without a checkpoint"
+        assert resilience.verify_checkpoint(e.checkpoint) == []
+        print(f"[rank {args.rank}] PREEMPTED step={e.step} "
+              f"ckpt={e.checkpoint} clean={e.clean}", flush=True)
+        return e.exit_code
     # success marker BEFORE teardown: once a peer has died (rank_kill),
     # jax's coordination service hard-kills the survivors during exit —
     # the marker records that every assertion had already passed
@@ -411,7 +525,7 @@ def _free_port() -> int:
     return port
 
 
-def _spawn(scenario: str, args) -> list:
+def _spawn(scenario: str, args, extra=()) -> list:
     port = _free_port()
     tmp = os.path.join(args.tmp, scenario)
     os.makedirs(tmp, exist_ok=True)
@@ -425,18 +539,18 @@ def _spawn(scenario: str, args) -> list:
             [sys.executable, os.path.abspath(__file__), "--child",
              "--rank", str(rank), "--procs", str(args.procs),
              "--port", str(port), "--scenario", scenario,
-             "--seed", str(args.seed), "--tmp", tmp],
+             "--seed", str(args.seed), "--tmp", tmp] + list(extra),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             cwd=REPO_ROOT))
     return procs
 
 
-def _run_scenario(scenario: str, args, expect_rcs=None) -> str:
+def _run_scenario(scenario: str, args, expect_rcs=None, extra=()) -> str:
     """Run one scenario across args.procs children; returns 'ok',
     'skip' or 'fail' and prints the children's transcripts on
     failure. NOTHING here can hang: every wait has a deadline and
     stragglers are killed."""
-    procs = _spawn(scenario, args)
+    procs = _spawn(scenario, args, extra=extra)
     deadline = time.monotonic() + args.timeout
     outs, rcs = [], []
     for p in procs:
@@ -470,6 +584,82 @@ def _run_scenario(scenario: str, args, expect_rcs=None) -> str:
     return "ok" if ok else "fail"
 
 
+def _run_preempt_kill(args, store) -> str:
+    """Phase 2 of the preempt scenario: spawn the children, wait until
+    rank 1 reports real step progress, deliver an ACTUAL SIGTERM to
+    it, and require EVERY rank (signaled or not — the consensus must
+    spread the preemption) to exit with the resumable code 75."""
+    import signal as signal_mod
+
+    procs = _spawn("preempt_kill", args, extra=("--store", store))
+    prog = os.path.join(store, "progress.rank1")
+    deadline = time.monotonic() + args.timeout
+    sent = False
+    while not sent and time.monotonic() < deadline:
+        if all(p.poll() is not None for p in procs):
+            break  # children already gone: transcripts tell the story
+        try:
+            with open(prog) as f:
+                if int(f.read().strip() or "-1") >= 1:
+                    procs[1].send_signal(signal_mod.SIGTERM)
+                    sent = True
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    outs, rcs = [], []
+    for p in procs:
+        left = max(1.0, deadline - time.monotonic())
+        try:
+            out, _ = p.communicate(timeout=left)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            out += "\n<killed: scenario deadline>"
+        outs.append(out)
+        rcs.append(p.returncode)
+    if any(rc == SKIP_RC for rc in rcs):
+        return "skip"
+    ok = sent and all(rc == RESUMABLE_RC for rc in rcs)
+    if not ok:
+        print(f"--- preempt_kill: rcs {rcs} (wanted all {RESUMABLE_RC}; "
+              f"SIGTERM sent: {sent}) " + "-" * 12)
+        for r, out in enumerate(outs):
+            print(f"--- rank {r} " + "-" * 40)
+            print(out[-4000:])
+    return "ok" if ok else "fail"
+
+
+def _run_preempt(args) -> str:
+    """The SIGTERM round trip (see module docstring): ref run, real
+    mid-run kill of rank 1, resume — and the resumed digest must be
+    bitwise identical to the uninterrupted reference's."""
+    ref_store = os.path.join(args.tmp, "preempt_ref_store")
+    store = os.path.join(args.tmp, "preempt_store")
+    for d in (ref_store, store):
+        os.makedirs(d, exist_ok=True)
+    v = _run_scenario("preempt_ref", args, extra=("--store", ref_store))
+    if v != "ok":
+        return v
+    v = _run_preempt_kill(args, store)
+    if v != "ok":
+        return v
+    v = _run_scenario("preempt_resume", args, extra=("--store", store))
+    if v != "ok":
+        return v
+    try:
+        with open(os.path.join(ref_store, "digest.ref.rank0")) as f:
+            ref = f.read()
+        with open(os.path.join(store, "digest.resume.rank0")) as f:
+            got = f.read()
+    except OSError as e:
+        print(f"preempt: digest files missing ({e})")
+        return "fail"
+    if ref != got:
+        print(f"preempt: resumed digest {got} != uninterrupted {ref}")
+        return "fail"
+    return "ok"
+
+
 def parent_main(args) -> int:
     scenarios = ([args.scenario] if args.scenario else list(SCENARIOS))
     args.tmp = os.path.join(args.tmp, f"run{os.getpid()}")  # no stale state
@@ -486,14 +676,18 @@ def parent_main(args) -> int:
     failed = []
     for sc in scenarios:
         expect = None
+        run = _run_scenario
         if sc == "rank_kill":
             expect = [0] + [DEATH_RC] * (args.procs - 1)
-        verdict = _run_scenario(sc, args, expect_rcs=expect)
+        if sc == "preempt":  # parent-orchestrated three-phase round trip
+            def run(_sc, args_, expect_rcs=None):  # noqa: ARG001
+                return _run_preempt(args_)
+        verdict = run(sc, args, expect_rcs=expect)
         print(f"  {sc:<16} {verdict}")
         if verdict == "fail":
             failed.append(sc)
         elif verdict == "skip":  # init raced AFTER a good probe: retry
-            verdict = _run_scenario(sc, args, expect_rcs=expect)
+            verdict = run(sc, args, expect_rcs=expect)
             print(f"  {sc:<16} {verdict} (retry)")
             if verdict != "ok":
                 failed.append(sc)
@@ -511,7 +705,10 @@ def main(argv=None) -> int:
     ap.add_argument("--procs", type=int, default=2)
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--scenario", default=None,
-                    choices=(None, "probe") + SCENARIOS)
+                    choices=(None, "probe") + SCENARIOS + PREEMPT_PHASES)
+    ap.add_argument("--store", default="",
+                    help="shared checkpoint-store dir of the preempt "
+                         "phases (parent-provided)")
     ap.add_argument("--seed", type=int, default=0,
                     help="deterministic data/fault seed (fuzz.py style)")
     ap.add_argument("--tmp", default=os.path.join(

@@ -1,0 +1,569 @@
+"""Run supervision: emergency checkpoints on preemption, the
+step-hang deadline watchdog, transient-dispatch retry, auto-resume
+ordering and retention GC — every path driven deterministically by
+fault injection (dccrg_tpu.faults), plus a REAL in-process SIGTERM.
+
+The acceptance pins: a preemption signal (faked or real) produces a
+CRC-verified checkpoint and a resumable exit, and `resume_latest`
+reconverges bitwise with an uninterrupted same-seed run; an injected
+step hang raises StepTimeoutError within the configured deadline
+(never blocks); retention GC can never delete the only checkpoint
+that passes verification."""
+
+import os
+import shutil
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dccrg_tpu import Grid, faults, resilience, supervise
+from dccrg_tpu.supervise import (
+    RESUMABLE_EXIT, CheckpointStore, PreemptedError, StepTimeoutError,
+    SupervisedRunner, gc_checkpoints, list_checkpoints, resume_latest,
+    retention_plan)
+
+pytestmark = pytest.mark.supervise
+
+CELL_DATA = {"v": jnp.float32}
+
+
+def _mk(seed=0):
+    g = (Grid(cell_data=CELL_DATA)
+         .set_initial_length((8, 8, 4))
+         .set_periodic(True, True, False)
+         .set_maximum_refinement_level(0)
+         .set_neighborhood_length(1)
+         # the METHOD: resume_latest repartitions with it, so
+         # ownership stays stable across restore
+         .set_load_balancing_method("block")
+         .initialize())
+    cells = g.plan.cells
+    g.set("v", cells, ((cells.astype(np.float64) * (seed + 7) % 31) / 31)
+          .astype(np.float32))
+    g.update_copies_of_remote_neighbors()
+    return g
+
+
+def _kernel(c, nbr, offs, mask):
+    return {"v": jnp.float32(0.5) * c["v"] + jnp.float32(0.125) * jnp.sum(
+        jnp.where(mask, nbr["v"], jnp.float32(0)), axis=1)}
+
+
+def _step_fn(grid, _i):
+    grid.run_steps(_kernel, ["v"], ["v"], 1)
+
+
+def _sup(tmp_path, name, grid=None, step_fn=_step_fn, **kw):
+    kw.setdefault("check_every", 100)
+    kw.setdefault("checkpoint_every", 3)
+    kw.setdefault("backoff", 0.0)
+    kw.setdefault("keep_last", 99)
+    return SupervisedRunner(grid if grid is not None else _mk(), step_fn,
+                            str(tmp_path / name), **kw)
+
+
+def _state(sup):
+    g = sup.grid
+    return np.asarray(g.get("v", g.plan.cells)).tobytes()
+
+
+# -- preemption -------------------------------------------------------
+
+def test_fake_preempt_emergency_checkpoint_and_resumable_exit(tmp_path):
+    """FaultPlan.preempt_signal at the boundary after step 4: the run
+    stops there, the emergency checkpoint is written AND CRC-verifies,
+    and the error carries the distinct resumable exit code."""
+    sup = _sup(tmp_path, "pre")
+    plan = faults.FaultPlan(seed=1)
+    plan.preempt_signal(step=4)
+    with plan, pytest.raises(PreemptedError) as ei:
+        sup.run(10)
+    e = ei.value
+    assert plan.fired("supervise.preempt") == 1
+    assert e.exit_code == RESUMABLE_EXIT == 75
+    assert e.step == 5 and e.clean
+    assert sup.preempted and sup.step == 5
+    assert e.checkpoint == sup.store.path_for(5)
+    assert resilience.verify_checkpoint(e.checkpoint) == []
+
+
+def test_preempt_resume_reconverges_bitwise(tmp_path):
+    """THE acceptance pin: preempt mid-run, resume_latest from the
+    emergency checkpoint, run to the end — final state bitwise equals
+    an uninterrupted run's."""
+    ref = _sup(tmp_path, "ref")
+    ref.run(12)
+    want = _state(ref)
+
+    sup = _sup(tmp_path, "pre")
+    plan = faults.FaultPlan(seed=2)
+    plan.preempt_signal(step=5)
+    with plan, pytest.raises(PreemptedError):
+        sup.run(12)
+
+    info = resume_latest(str(tmp_path / "pre"), CELL_DATA,
+                         load_balancing_method="block")
+    assert info is not None and not info.salvaged
+    assert info.step == 6 and info.report.clean
+    info.grid.update_copies_of_remote_neighbors()
+    sup2 = _sup(tmp_path, "pre", grid=info.grid, start_step=info.step)
+    sup2.run(12)
+    assert sup2.step == 12
+    assert _state(sup2) == want
+
+
+def test_real_sigterm_mid_step_preempts_at_boundary(tmp_path):
+    """An actual SIGTERM delivered to this process mid-step (the
+    handler is installed by the supervisor) sets the flag; the run
+    stops at the NEXT boundary with the emergency checkpoint."""
+    def step_fn(grid, i):
+        _step_fn(grid, i)
+        if i == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    sup = _sup(tmp_path, "sig", step_fn=step_fn)
+    with pytest.raises(PreemptedError) as ei:
+        sup.run(10)
+    assert ei.value.step == 4
+    assert resilience.verify_checkpoint(ei.value.checkpoint) == []
+    assert not supervise.preempt_requested()  # next run starts clean
+
+
+def test_second_sigint_escalates_to_keyboard_interrupt(tmp_path):
+    """The first ctrl-C is a graceful preemption; a second one means
+    'now' and must not be swallowed by the supervision machinery."""
+    def step_fn(grid, i):
+        _step_fn(grid, i)
+        if i == 1:
+            os.kill(os.getpid(), signal.SIGINT)
+            os.kill(os.getpid(), signal.SIGINT)
+
+    sup = _sup(tmp_path, "int", step_fn=step_fn)
+    with pytest.raises(KeyboardInterrupt):
+        sup.run(10)
+    supervise.clear_preempt()
+
+
+def test_preempt_loses_consensus_to_a_real_trip(tmp_path, monkeypatch):
+    """A recoverable trip elsewhere on the mesh outranks the interrupt
+    code: this rank rolls back with the peers FIRST, and the still-set
+    preempt flag stops the run at the next boundary."""
+    from dccrg_tpu import coord
+
+    remote = []
+
+    def fake_consensus(grid, code):
+        if code == resilience._TRIP_INTERRUPT and not remote:
+            remote.append(code)
+            return resilience._TRIP_NUMERICS  # a peer tripped too
+        return int(code)
+
+    monkeypatch.setattr(coord, "trip_consensus", fake_consensus)
+    sup = _sup(tmp_path, "race")
+    plan = faults.FaultPlan(seed=3)
+    plan.preempt_signal(step=4)
+    with plan, pytest.raises(PreemptedError) as ei:
+        sup.run(10)
+    assert remote == [resilience._TRIP_INTERRUPT]
+    assert sup.rollbacks == 1  # rolled back with the peers first
+    # then preempted at the first boundary after the rollback
+    assert ei.value.step == 4
+    assert resilience.verify_checkpoint(ei.value.checkpoint) == []
+
+
+def test_preempt_never_checkpoints_poisoned_state(tmp_path):
+    """The rollback-target invariant extends to the emergency save: a
+    NaN produced by the very step the preemption lands on trips a
+    recovery FIRST (the boundary check runs before RunInterrupted),
+    and the still-pending preemption stops the run at the first clean
+    boundary — the emergency checkpoint is always finite."""
+    poisoned = []
+
+    def step_fn(grid, i):
+        _step_fn(grid, i)
+        if i == 4 and not poisoned:
+            poisoned.append(i)
+            cells = grid.plan.cells
+            grid.set("v", cells[:1], np.array([np.nan], np.float32))
+
+    sup = _sup(tmp_path, "poison", step_fn=step_fn,
+               fields=("v",), checkpoint_every=3)
+    plan = faults.FaultPlan(seed=11)
+    plan.preempt_signal(step=4)
+    with plan, pytest.raises(PreemptedError) as ei:
+        sup.run(10)
+    assert sup.rollbacks == 1  # recovered before honoring the preempt
+    assert resilience.verify_checkpoint(ei.value.checkpoint) == []
+    info = resume_latest(str(tmp_path / "poison"), CELL_DATA,
+                         load_balancing_method="block")
+    assert info.step == ei.value.step
+    assert resilience.check_finite(info.grid)  # never NaN on disk
+
+
+def test_transient_error_after_state_mutation_does_not_double_apply(
+        tmp_path):
+    """A real transient error surfaces AFTER step_fn already advanced
+    grid.data (async dispatch): the retry must rewind to the pre-step
+    arrays, not re-apply the step on top of the new ones — pinned by
+    bitwise agreement with an undisturbed run."""
+    ref = _sup(tmp_path, "mref")
+    ref.run(6)
+
+    failed = []
+
+    def step_fn(grid, i):
+        _step_fn(grid, i)  # the mutation lands first...
+        if i == 3 and not failed:
+            failed.append(i)  # ...then the transient error surfaces
+            raise faults.InjectedDispatchError("post-mutation")
+
+    sup = _sup(tmp_path, "mut", step_fn=step_fn, dispatch_backoff=0.0)
+    sup.run(6)
+    assert sup.dispatch_retried == 1 and sup.rollbacks == 0
+    assert _state(sup) == _state(ref)
+
+
+def test_emergency_save_shortens_the_barrier_timeout(tmp_path,
+                                                     monkeypatch):
+    """During the emergency save the coord.barrier timeout is cut to a
+    quarter of the grace window (so ONE dead peer cannot eat it all),
+    and restored afterwards."""
+    from dccrg_tpu import coord
+
+    seen = []
+    real_save = resilience.save_checkpoint
+
+    def spy_save(grid, path, **kw):
+        seen.append(coord.barrier_timeout())
+        return real_save(grid, path, **kw)
+
+    monkeypatch.setattr(resilience, "save_checkpoint", spy_save)
+    monkeypatch.setenv("DCCRG_BARRIER_TIMEOUT", "120")
+    sup = _sup(tmp_path, "grace", grace=8.0)
+    plan = faults.FaultPlan(seed=4)
+    plan.preempt_signal(step=2)
+    with plan, pytest.raises(PreemptedError):
+        sup.run(10)
+    # periodic saves (full timeout) + the emergency one (grace / 4)
+    assert seen[-1] == 2.0
+    assert all(t == 120.0 for t in seen[:-1])
+    assert coord.barrier_timeout() == 120.0  # restored
+
+
+def test_emergency_save_failure_falls_back_to_periodic(tmp_path,
+                                                       monkeypatch):
+    """When the emergency save itself dies (I/O fault), the run is
+    still resumable: the error points at the last periodic
+    checkpoint, clean=False tells the story."""
+    real_save = resilience.save_checkpoint
+    calls = []
+
+    def flaky_save(grid, path, **kw):
+        calls.append(path)
+        if "00000005" in path:
+            raise OSError("disk gone")
+        return real_save(grid, path, **kw)
+
+    monkeypatch.setattr(resilience, "save_checkpoint", flaky_save)
+    sup = _sup(tmp_path, "fb")
+    plan = faults.FaultPlan(seed=5)
+    plan.preempt_signal(step=4)
+    with plan, pytest.raises(PreemptedError) as ei:
+        sup.run(10)
+    assert not ei.value.clean
+    assert ei.value.checkpoint == sup.store.path_for(3)  # periodic
+    assert resilience.verify_checkpoint(ei.value.checkpoint) == []
+
+
+# -- step-hang watchdog + transient dispatch retry --------------------
+
+def test_step_hang_raises_typed_timeout_within_deadline(tmp_path):
+    """An injected wedged dispatch raises StepTimeoutError NAMING the
+    step within the configured deadline — never a block-forever."""
+    g = _mk()
+    _step_fn(g, 0)  # warm the compiled step: the deadline is tight
+    sup = _sup(tmp_path, "hang", grid=g, step_timeout=0.5)
+    plan = faults.FaultPlan(seed=6)
+    plan.step_hang(step=2)
+    t0 = time.monotonic()
+    with plan, pytest.raises(StepTimeoutError) as ei:
+        sup.run(10)
+    assert time.monotonic() - t0 < 10.0
+    assert ei.value.step == 2
+    assert "step 2" in str(ei.value)
+    assert plan.fired("supervise.hang") == 1
+
+
+def test_slow_but_alive_step_completes_under_deadline(tmp_path):
+    """A finite hang below the deadline models a slow step: the run
+    completes, nothing trips."""
+    sup = _sup(tmp_path, "slow", step_timeout=30.0)
+    plan = faults.FaultPlan(seed=7)
+    plan.step_hang(step=1, hang_s=0.05)
+    with plan:
+        sup.run(4)
+    assert sup.step == 4 and sup.rollbacks == 0
+
+
+def test_step_timeout_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("DCCRG_STEP_TIMEOUT", "0.4")
+    g = _mk()
+    _step_fn(g, 0)  # warm the compiled step: the deadline is tight
+    sup = _sup(tmp_path, "env", grid=g)
+    assert sup.step_timeout == 0.4
+    plan = faults.FaultPlan(seed=8)
+    plan.step_hang(step=1)
+    with plan, pytest.raises(StepTimeoutError):
+        sup.run(4)
+
+
+def test_transient_dispatch_errors_retry_without_rollback(tmp_path):
+    """Two injected UNAVAILABLE dispatch errors: the step retries with
+    backoff and succeeds — no trip, no rollback, and the final state
+    bitwise equals an undisturbed run's."""
+    ref = _sup(tmp_path, "dref")
+    ref.run(6)
+
+    sup = _sup(tmp_path, "disp", dispatch_backoff=0.0)
+    plan = faults.FaultPlan(seed=9)
+    plan.dispatch_error(times=2, step=3)
+    with plan:
+        sup.run(6)
+    assert plan.fired("supervise.dispatch") == 2
+    assert sup.dispatch_retried == 2
+    assert sup.rollbacks == 0 and not sup.trips
+    assert _state(sup) == _state(ref)
+
+
+def test_persistent_dispatch_errors_exhaust_and_surface(tmp_path):
+    """A dispatch error that never clears surfaces after the bounded
+    retries instead of looping forever."""
+    sup = _sup(tmp_path, "dead", dispatch_retries=2, dispatch_backoff=0.0)
+    plan = faults.FaultPlan(seed=10)
+    plan.dispatch_error(times=faults.EVERY)
+    with plan, pytest.raises(faults.InjectedDispatchError):
+        sup.run(6)
+    assert sup.dispatch_retried == 2
+
+
+# -- checkpoint store, resume ordering, retention GC ------------------
+
+def test_store_paths_and_listing(tmp_path):
+    store = CheckpointStore(tmp_path / "s", stem="run")
+    assert store.path_for(7).endswith("run_00000007.dc")
+    for s in (3, 11, 7):
+        with open(store.path_for(s), "wb") as f:
+            f.write(b"x")
+    assert [s for s, _ in store.list()] == [11, 7, 3]
+    # foreign stems are invisible to a stem-scoped store
+    with open(os.path.join(store.dir, "other_00000099.dc"), "wb") as f:
+        f.write(b"x")
+    assert [s for s, _ in store.list()] == [11, 7, 3]
+    assert [s for s, _ in list_checkpoints(store.dir)] == [99, 11, 7, 3]
+
+
+def test_retention_plan_policy():
+    keep, drop = retention_plan(range(1, 11), keep_last=2, keep_every=4)
+    assert keep == [10, 9, 8, 4]
+    assert drop == [7, 6, 5, 3, 2, 1]
+    # keep_last clamps to 1: the pure policy can never empty a dir
+    keep, drop = retention_plan([5], keep_last=0)
+    assert keep == [5] and drop == []
+    assert retention_plan([], 3, 0) == ([], [])
+
+
+def _plant_store(tmp_path, steps, seed=0):
+    """A store of REAL checkpoints: one saved grid, copied (file +
+    sidecar) to every step — byte-identical, individually
+    corruptible."""
+    store = CheckpointStore(tmp_path / f"plant{seed}")
+    g = _mk(seed)
+    proto = os.path.join(store.dir, "proto.bin")
+    resilience.save_checkpoint(g, proto)
+    for s in steps:
+        shutil.copy(proto, store.path_for(s))
+        shutil.copy(resilience.sidecar_path(proto),
+                    resilience.sidecar_path(store.path_for(s)))
+    os.unlink(proto)
+    os.unlink(resilience.sidecar_path(proto))
+    return store
+
+
+def _corrupt_payload(path):
+    rec = resilience.read_sidecar(path)
+    faults.flip_bit(path, int(rec["payload_start"]) + 5, 1)
+
+
+def test_resume_ordering_prefers_newest_verified(tmp_path):
+    """A directory mixing valid, corrupt and unverifiable checkpoints
+    resolves to the NEWEST one that passes verification."""
+    store = _plant_store(tmp_path, (2, 4, 6, 8))
+    _corrupt_payload(store.path_for(8))                    # fails CRC
+    os.unlink(resilience.sidecar_path(store.path_for(6)))  # unverifiable
+    info = resume_latest(store.dir, CELL_DATA, stem=store.stem,
+                         load_balancing_method="block")
+    assert info is not None and not info.salvaged
+    assert info.step == 4
+    want = np.asarray(_mk(0).get("v", _mk(0).plan.cells))
+    got = np.asarray(info.grid.get("v", info.grid.plan.cells))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_resume_salvages_newest_when_nothing_verifies(tmp_path):
+    store = _plant_store(tmp_path, (2, 4))
+    _corrupt_payload(store.path_for(2))
+    _corrupt_payload(store.path_for(4))
+    info = resume_latest(store.dir, CELL_DATA, stem=store.stem,
+                         load_balancing_method="block")
+    assert info is not None and info.salvaged
+    assert info.step == 4
+    assert len(info.report.corrupt_cells)
+    assert resume_latest(store.dir, CELL_DATA, stem=store.stem,
+                         salvage=False) is None
+    assert resume_latest(str(tmp_path / "empty"), CELL_DATA) is None
+
+
+def test_gc_applies_policy_and_removes_sidecars(tmp_path):
+    store = _plant_store(tmp_path, (1, 2, 3, 4, 5, 6))
+    rep = store.gc(keep_last=2, keep_every=3, apply=False)
+    assert [s for s, _ in rep.kept] == [6, 5, 3]
+    assert os.path.exists(store.path_for(1))  # dry run touches nothing
+    rep = store.gc(keep_last=2, keep_every=3, apply=True)
+    assert rep.applied
+    assert [s for s, _ in store.list()] == [6, 5, 3]
+    for s, path in rep.dropped:
+        assert not os.path.exists(path)
+        assert not os.path.exists(resilience.sidecar_path(path))
+
+
+def test_gc_never_deletes_the_only_verified_checkpoint(tmp_path):
+    """Planted corruption: every keeper fails its CRC; the newest
+    VERIFYING dropee must be rescued instead of pruned."""
+    store = _plant_store(tmp_path, (1, 2, 3, 4, 5))
+    for s in (4, 5):  # the keep_last=2 keepers
+        _corrupt_payload(store.path_for(s))
+    rep = store.gc(keep_last=2, apply=True)
+    assert rep.rescued == 3
+    assert [s for s, _ in store.list()] == [5, 4, 3]
+    assert resilience.verify_checkpoint(store.path_for(3)) == []
+
+
+def test_gc_refuses_when_nothing_verifies(tmp_path):
+    store = _plant_store(tmp_path, (1, 2, 3))
+    for s in (1, 2, 3):
+        _corrupt_payload(store.path_for(s))
+    rep = store.gc(keep_last=1, apply=True)
+    assert rep.refused and not rep.dropped
+    assert [s for s, _ in store.list()] == [3, 2, 1]  # all survive
+
+
+def test_gc_verification_property_under_fuzzed_directories(tmp_path):
+    """The acceptance property, fuzzed: whatever the step set, policy
+    and corruption pattern, a prune never removes the last checkpoint
+    that passes verification."""
+    rng = np.random.default_rng(42)
+    for trial in range(8):
+        steps = sorted(rng.choice(np.arange(1, 30), replace=False,
+                                  size=int(rng.integers(1, 8))).tolist())
+        store = _plant_store(tmp_path / f"t{trial}", steps, seed=trial)
+        corrupt = [s for s in steps if rng.random() < 0.5]
+        for s in corrupt:
+            _corrupt_payload(store.path_for(s))
+        any_ok_before = len(corrupt) < len(steps)
+        store.gc(keep_last=int(rng.integers(1, 4)),
+                 keep_every=int(rng.integers(0, 6)), apply=True)
+        left_ok = [s for s, p in store.list()
+                   if not resilience.verify_checkpoint(p)]
+        if any_ok_before:
+            assert left_ok, (trial, steps, corrupt)
+        else:
+            assert [s for s, _ in store.list()] \
+                == sorted(steps, reverse=True), (trial, steps)
+
+
+def test_preempt_flag_consumed_without_signal_handlers(tmp_path):
+    """install_signal_handlers=False (the non-main-thread mode): a
+    honored preemption must consume the flag, or every later run in
+    the process would re-preempt at its first boundary."""
+    sup = _sup(tmp_path, "nohandler", install_signal_handlers=False)
+    supervise.request_preempt()
+    with pytest.raises(PreemptedError) as ei:
+        sup.run(10)
+    assert ei.value.step == 1  # honored at the first boundary
+    assert not supervise.preempt_requested()
+    info = resume_latest(str(tmp_path / "nohandler"), CELL_DATA,
+                         load_balancing_method="block")
+    info.grid.update_copies_of_remote_neighbors()
+    sup2 = _sup(tmp_path, "nohandler", grid=info.grid,
+                start_step=info.step, install_signal_handlers=False)
+    sup2.run(10)  # makes real progress; no stale re-preempt
+    assert sup2.step == 10 and not sup2.preempted
+
+
+def test_gc_treats_each_stem_as_its_own_sequence(tmp_path):
+    """stem=None (the CLI default) on a directory holding TWO runs'
+    checkpoints: retention and the only-verifiable guard apply per
+    stem — one run's corrupt files can never doom (or shadow) the
+    other's."""
+    a = _plant_store(tmp_path, (1, 2, 3))          # stem "ckpt"
+    b = CheckpointStore(a.dir, stem="other")
+    g = _mk(1)
+    for s in (2, 3, 4):
+        resilience.save_checkpoint(g, b.path_for(s))
+    for s in (3, 4):  # ALL of stem "other"'s keepers corrupt
+        _corrupt_payload(b.path_for(s))
+    rep = gc_checkpoints(a.dir, keep_last=2, apply=True)
+    # "ckpt" pruned by plain policy; "other" rescued its only
+    # verifying file (step 2) despite sharing step numbers with "ckpt"
+    assert [s for s, _ in a.list()] == [3, 2]
+    assert [s for s, _ in b.list()] == [4, 3, 2]
+    assert rep.rescued == 2
+    assert resilience.verify_checkpoint(b.path_for(2)) == []
+
+
+def test_gc_sweeps_stale_temp_files(tmp_path):
+    store = _plant_store(tmp_path, (1, 2))
+    mp_tmp = store.path_for(1) + ".mp-tmp"
+    dead = os.path.join(store.dir, "x.dc.tmp.999999999")
+    alive = os.path.join(store.dir, f"y.dc.salvage.{os.getpid()}")
+    for p in (mp_tmp, dead, alive):
+        with open(p, "wb") as f:
+            f.write(b"t")
+    rep = store.gc(keep_last=5, apply=True)
+    assert sorted(rep.stale_temps) == sorted([mp_tmp, dead])
+    assert not os.path.exists(mp_tmp) and not os.path.exists(dead)
+    assert os.path.exists(alive)  # its owner (us) is still running
+
+
+def test_runner_prunes_as_it_goes(tmp_path):
+    """The supervised loop GCs after every periodic save: only the
+    newest keep_last checkpoints remain at the end."""
+    sup = _sup(tmp_path, "gc", keep_last=2, checkpoint_every=2)
+    sup.run(10)
+    assert [s for s, _ in sup.store.list()] == [10, 8]
+
+
+# -- the maintenance CLI ----------------------------------------------
+
+def test_cli_verify_and_gc(tmp_path, capsys):
+    store = _plant_store(tmp_path, (1, 2, 3))
+    good = store.path_for(3)
+    assert resilience._main(["verify", good]) == 0
+    assert "OK" in capsys.readouterr().out
+    _corrupt_payload(store.path_for(2))
+    assert resilience._main(["verify", store.path_for(2)]) == 1
+    assert "CORRUPT" in capsys.readouterr().out
+
+    assert resilience._main(["gc", store.dir, "--keep-last", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "dry-run" in out and "--apply" in out
+    assert [s for s, _ in store.list()] == [3, 2, 1]  # untouched
+    assert resilience._main(["gc", store.dir, "--keep-last", "1",
+                             "--apply"]) == 0
+    assert "applied" in capsys.readouterr().out
+    # step 2 is corrupt; 3 verifies and is kept, so policy prunes 1+2
+    assert [s for s, _ in store.list()] == [3]
