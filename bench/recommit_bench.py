@@ -1,0 +1,149 @@
+"""AMR plan re-commit cost, per phase (the ROADMAP "Hybrid re-commit
+cost at 192^3" item's measuring stick).
+
+Each size refines a z-slab (1/64 of the level-0 cells) and commits,
+then refines a second slab and commits again — the *reuse* epoch the
+epoch-to-epoch stream cache and the plan arena accelerate — and
+finally runs two more alternating unrefine/refine commits so the
+steady-state adapt loop (warm arena, stable sticky-cap shapes) is on
+record too.  ``--no-reuse`` clears the stream cache before every
+re-commit, isolating the reuse machinery's contribution.  Per-phase
+timings come from hybrid.py's phase marks via ``_PHASE_SINK`` (no
+stdout parsing).
+
+Run:  timeout -k 10 1800 python bench/recommit_bench.py [--max 128]
+      (192^3 takes minutes on a 1-core host; opt in with --max 192)
+
+JSON rows go to stdout like the other bench emitters.
+"""
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import dccrg_tpu as dt  # noqa: E402
+from dccrg_tpu import hybrid  # noqa: E402
+
+
+def _phase_groups(records):
+    """Collapse the raw (label, seconds) marks into the four coarse
+    recommit phases."""
+    groups = {"classify": 0.0, "hard_streams": 0.0, "easy_far_tables": 0.0,
+              "hard_tables": 0.0, "layout_other": 0.0}
+    for label, secs in records:
+        if label.startswith("classify"):
+            groups["classify"] += secs
+        elif label.startswith("hard streams"):
+            groups["hard_streams"] += secs
+        elif "far" in label or "easy" in label:
+            groups["easy_far_tables"] += secs
+        elif "hard" in label:
+            groups["hard_tables"] += secs
+        else:
+            groups["layout_other"] += secs
+    return {k: round(v, 3) for k, v in groups.items()}
+
+
+def _commit(g, reuse):
+    if not reuse:
+        # fingerprint mismatch -> full rebuild (streams recomputed);
+        # the arena still serves warm buffers, isolating stream reuse
+        g._hybrid_reuse = {}
+    sink = []
+    hybrid._PHASE_SINK = sink
+    try:
+        t0 = time.perf_counter()
+        g.stop_refining()
+        total = time.perf_counter() - t0
+    finally:
+        hybrid._PHASE_SINK = None
+    return total, _phase_groups(sink)
+
+
+def run_size(n, reuse=True):
+    g = (dt.Grid(cell_data={"density": jnp.float32})
+         .set_initial_length((n, n, n))
+         .set_maximum_refinement_level(1)
+         .set_neighborhood_length(1)
+         .initialize())
+    n0 = np.uint64(n) ** 3
+    nref = int(n0) // 64
+    rows = []
+
+    def emit(epoch, total, phases):
+        row = {
+            "size": f"{n}^3", "epoch": epoch, "reuse": reuse,
+            "cells": len(g.plan.cells), "total_s": round(total, 2),
+            "phases": phases,
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    cells = g.plan.cells
+    for c in cells[:nref]:
+        g.refine_completely(c)
+    emit("first", *_commit(g, reuse))
+
+    cells = g.plan.cells
+    lvl0 = cells[cells <= n0]
+    for c in lvl0[-nref:]:
+        g.refine_completely(c)
+    emit("recommit", *_commit(g, reuse))
+
+    # steady-state adapt loop: alternate a smaller unrefine/refine so
+    # the sticky-cap shapes (and with them the arena buffers) settle
+    for it in range(2):
+        cells = g.plan.cells
+        lvl1 = cells[cells > n0]
+        for c in lvl1[:nref // 2:8]:
+            g.unrefine_completely(int(c))
+        emit(f"steady{it}a", *_commit(g, reuse))
+        cells = g.plan.cells
+        lvl0 = cells[cells <= n0]
+        for c in lvl0[:nref // 16]:
+            g.refine_completely(int(c))
+        emit(f"steady{it}b", *_commit(g, reuse))
+    arena = getattr(g, "_plan_arena", None)
+    if arena is not None:
+        print(json.dumps({"size": f"{n}^3", "arena": arena.stats()}),
+              flush=True)
+    del g
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max", type=int, default=128,
+                    help="largest edge length (64/128/192)")
+    ap.add_argument("--no-reuse", action="store_true",
+                    help="clear the stream-reuse cache before every "
+                         "commit (isolates the reuse win)")
+    args = ap.parse_args()
+
+    # hang-proof backend probe before any jax work (like the other
+    # benches: a wedged accelerator tunnel survives SIGTERM)
+    from dccrg_tpu.resilience import safe_devices
+
+    safe_devices(timeout=120, retries=1, platform="cpu")
+
+    results = []
+    for n in (64, 128, 192):
+        if n > args.max:
+            continue
+        results.extend(run_size(n, reuse=not args.no_reuse))
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,21 @@ class AmrResult:
     refined_parents: np.ndarray  # cells that were replaced by children
     unrefined_parents: np.ndarray  # cells created by unrefinement
 
+    @property
+    def changed_cells(self) -> np.ndarray:
+        """Every id in exactly one of the pre/post cell lists — the
+        commit's exact dirty seed. stop_refining hands this to the
+        hybrid plan rebuild, which dilates it by the search radius on
+        the level-0 lattice instead of recomputing the symmetric
+        difference of two full cell lists (hybrid.build_hybrid_plan's
+        reuse branch)."""
+        return np.concatenate([
+            np.asarray(self.new_cells, dtype=np.uint64),
+            np.asarray(self.removed_cells, dtype=np.uint64),
+            np.asarray(self.refined_parents, dtype=np.uint64),
+            np.asarray(self.unrefined_parents, dtype=np.uint64),
+        ])
+
 
 # bins above which the vectorized-lattice unrefine check falls back to
 # the per-parent loop (deeply refined grids have huge fine lattices)

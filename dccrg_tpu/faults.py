@@ -149,6 +149,7 @@ MUTATION_FAULT_SITES = {
         ("adapt.commit", "preserved"), ("adapt.resolve", "pins"),
         ("grid.restructure", "planned"), ("grid.restructure", "moved"),
         ("hybrid.recommit", "classified"), ("hybrid.recommit", "cached"),
+        ("hybrid.recommit", "tables"),
     ),
     "balance": (
         ("partition.compute", None), ("balance.commit", "partition"),
@@ -158,6 +159,7 @@ MUTATION_FAULT_SITES = {
         # a balance on a REFINED grid rebuilds through the hybrid
         # builder too — its fault points are reachable from both paths
         ("hybrid.recommit", "classified"), ("hybrid.recommit", "cached"),
+        ("hybrid.recommit", "tables"),
     ),
 }
 

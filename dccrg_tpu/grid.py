@@ -882,22 +882,28 @@ class Grid:
 
     # -- structure plan building --------------------------------------
 
-    def _build_plan(self, cells: np.ndarray, owner: np.ndarray):
+    def _build_plan(self, cells: np.ndarray, owner: np.ndarray,
+                    changed_hint=None):
         """Rebuild all derived structure: the equivalent of the
         reference's initialize_neighbors + update_remote_neighbor_info +
         recalculate_neighbor_update_send_receive_lists +
-        update_cell_pointers pipeline (dccrg.hpp:8371-8420)."""
+        update_cell_pointers pipeline (dccrg.hpp:8371-8420).
+        ``changed_hint`` is ``(prev_cells, changed_ids)`` from a
+        structure mutation that knows its own dirty set (see
+        hybrid.build_hybrid_plan); only the hybrid path consumes it."""
         # any rebuild invalidates a gather mode forced by the OOM
         # fallback (resilience._apply_mode re-pins and re-marks it)
         self._plan_gather_mode = None
-        self._build_plan_impl(cells, owner)
+        self._build_plan_impl(cells, owner, changed_hint)
         # the builder's large temporaries are dead only once the impl
         # frame is gone; trim here so malloc_trim can actually return
-        # the build's peak to the OS
+        # the build's peak to the OS (the arena-held tables stay
+        # resident — that is the point)
         if len(cells) > 1 << 20:
             _trim_allocator()
 
-    def _build_plan_impl(self, cells: np.ndarray, owner: np.ndarray):
+    def _build_plan_impl(self, cells: np.ndarray, owner: np.ndarray,
+                         changed_hint=None):
         _tune_allocator()
         n_dev = self.n_dev
         if len(cells) > 1 and not np.all(cells[:-1] < cells[1:]):
@@ -921,7 +927,7 @@ class Grid:
         # tables away from refinement, generic engine for the hard
         # subset near it — O(refinement surface), not O(grid)
         if n0 < 2**31 - 2 and os.environ.get("DCCRG_FORCE_GENERIC") != "1":
-            self._build_plan_hybrid(cells, owner)
+            self._build_plan_hybrid(cells, owner, changed_hint)
             return
 
         # per-hood neighbor lists (host), with neighbor positions in the
@@ -1059,7 +1065,8 @@ class Grid:
             plan.hoods[hid] = hood
         self._finish_plan(plan)
 
-    def _build_plan_hybrid(self, cells: np.ndarray, owner: np.ndarray):
+    def _build_plan_hybrid(self, cells: np.ndarray, owner: np.ndarray,
+                           changed_hint=None):
         """Plan construction for refined grids (hybrid.py): closed-form
         lattice tables for level-0 cells away from refinement, generic
         engine only for the hard subset near it. Same layout and
@@ -1070,9 +1077,21 @@ class Grid:
             # epoch-to-epoch cache of the hard-shell neighbor streams
             # (see hybrid.py): only the dirty region reruns the engine
             self._hybrid_reuse = {}
+        if getattr(self, "_plan_arena", None) is None:
+            # pooled backing stores of the big plan tables, reused
+            # across structure epochs so a recommit never faults in
+            # multi-GB fresh pages (see hybrid.PlanArena)
+            self._plan_arena = hybrid_mod.PlanArena()
+        arena = self._plan_arena
+        # the live plan and the active transaction's rollback snapshot
+        # keep their buffers; everything older is recycled — an aborted
+        # build can never have scribbled on a plan a rollback restores
+        arena.begin(protect=(getattr(self, "plan", None),
+                             getattr(self, "_txn_plan", None)))
         layout, hood_data = hybrid_mod.build_hybrid_plan(
             self.mapping, self.topology, self.neighborhoods, cells, owner,
             self.n_dev, cap=self._sticky_cap, reuse=self._hybrid_reuse,
+            arena=arena, changed_hint=changed_hint,
         )
         plan = _Plan(
             cells=cells,
@@ -1085,6 +1104,7 @@ class Grid:
             row_of_pos=layout["row_of_pos"],
             ghost_ids=layout["ghost_ids"],
         )
+        arena.bind(plan)
         mapping, topology = self.mapping, self.topology
         for hid, offs in self.neighborhoods.items():
             hd = hood_data[hid]
@@ -3493,6 +3513,9 @@ class Grid:
             self._new_cells = res.new_cells
             self._unrefined_parents = res.unrefined_parents
 
+            # dirty-set propagation into the hybrid recommit: the ids
+            # that appear in exactly one of the pre/post cell lists
+            self._pending_changed_cells = res.changed_cells
             self._restructure(res.cells, res.owner)
             return res.new_cells.copy()
 
@@ -3507,8 +3530,9 @@ class Grid:
         pulling every field to host and re-uploading."""
         old_plan = self.plan
         old_R = old_plan.R
-        if (len(new_cells) != len(old_plan.cells)
-                or not np.array_equal(new_cells, old_plan.cells)):
+        same_cells = (len(new_cells) == len(old_plan.cells)
+                      and np.array_equal(new_cells, old_plan.cells))
+        if not same_cells:
             # cell-set epoch: caches keyed on the cell SET (not the
             # partition) — e.g. the cut partitioner's edge arrays —
             # invalidate here and nowhere else
@@ -3517,7 +3541,21 @@ class Grid:
         old_dev, old_rows = self._host_rows(surviving)
         old_flat = old_dev.astype(np.int64) * old_R + old_rows
 
-        self._build_plan(new_cells, new_owner)
+        # dirty-set hint for the hybrid recommit: stop_refining knows
+        # exactly which ids changed; an owner-only restructure (a
+        # repartition) changes none. The hint is keyed on the previous
+        # plan's cell array OBJECT so a stale hint can never alias a
+        # different epoch (hybrid.build_hybrid_plan verifies identity).
+        pending = getattr(self, "_pending_changed_cells", None)
+        self._pending_changed_cells = None
+        if same_cells:
+            changed_hint = (old_plan.cells, np.empty(0, dtype=np.uint64))
+        elif pending is not None:
+            changed_hint = (old_plan.cells, pending)
+        else:
+            changed_hint = None
+
+        self._build_plan(new_cells, new_owner, changed_hint)
         faults.fire("grid.restructure", phase="planned")
         new_dev, new_rows = self._host_rows(surviving)
         new_flat = new_dev.astype(np.int64) * self.plan.R + new_rows

@@ -13,7 +13,8 @@ classified per level:
 
 - level-0 cells away from any refined slot (box-dilated refined-root
   lattice) are *far*: tables come from the uniform lattice builder
-  (native dn_uniform_tables / np.roll maps);
+  (native dn_far_tables writing the final layout in place / np.roll
+  maps);
 - level-l (l >= 1) cells whose neighbors at every symmetrized offset
   exist as level-l leaves are *easy*: neighbor positions come from
   level-l index arithmetic + one binary search per offset;
@@ -40,24 +41,155 @@ from __future__ import annotations
 
 import os
 import time
+import weakref
 
 import numpy as np
 
 from . import faults
 
+#: Optional phase-record sink: a list that every build appends
+#: ``(label, seconds)`` tuples to (bench/recommit_bench.py installs
+#: one to capture per-phase timings without parsing stdout).
+_PHASE_SINK = None
+
 
 def _phase_timer():
-    """Phase-boundary logger, enabled with DCCRG_TIMING=1."""
-    if os.environ.get("DCCRG_TIMING") != "1":
+    """Phase-boundary logger: prints with DCCRG_TIMING=1, records into
+    :data:`_PHASE_SINK` when one is installed."""
+    sink = _PHASE_SINK
+    echo = os.environ.get("DCCRG_TIMING") == "1"
+    if sink is None and not echo:
         return lambda label: None
     state = {"t": time.perf_counter()}
 
     def mark(label):
         now = time.perf_counter()
-        print(f"[hybrid] {label}: {now - state['t']:.3f}s", flush=True)
+        dt = now - state["t"]
+        if echo:
+            print(f"[hybrid] {label}: {dt:.3f}s", flush=True)
+        if sink is not None:
+            sink.append((label, dt))
         state["t"] = now
 
     return mark
+
+
+def _fill_chunked(view, value, chunk_bytes=64 << 20):
+    """Fill a (possibly huge) array chunk-wise: same result as a full
+    ``arr[:] = value``, but each slice stays within one hot TLB/cache
+    window instead of streaming the whole multi-GB extent at once."""
+    flat = view.reshape(-1)
+    step = max(1, chunk_bytes // max(1, flat.itemsize))
+    for i in range(0, flat.size, step):
+        flat[i:i + step] = value
+
+
+class PlanArena:
+    """Per-grid pool of the large plan-table buffers, reused across
+    structure epochs.
+
+    The recommit cost at scale is dominated by memory-system pressure,
+    not arithmetic: every epoch used to allocate multi-GB fresh
+    ``np.full`` tables, fault in every page, and (after the post-build
+    ``malloc_trim``) hand the pages back — so the next epoch paid the
+    faults again. The arena keeps the table backing stores alive as
+    plain numpy buffers (grown geometrically, so steady-state epochs
+    allocate nothing) and rotates them between plan generations:
+
+    - :meth:`begin` opens a build and reclaims the buffers of every
+      plan generation that is no longer *protected* (the live plan and
+      the active transaction's rollback snapshot stay protected — an
+      aborted build can never have scribbled on a plan a rollback may
+      restore, pinned by tests/test_recommit.py);
+    - :meth:`take` hands out a reclaimed-or-fresh buffer view, filled
+      chunk-wise when a fill value is given;
+    - :meth:`bind` transfers ownership of everything taken to the
+      newly built plan. Lazy table thunks append to the same ownership
+      list after the fact, so late-materialized to-tables are pooled
+      too. A build that dies before ``bind`` leaves its takes in the
+      pending list, which the next ``begin`` reclaims.
+    """
+
+    def __init__(self):
+        self._free = {}      # dtype str -> [1-D raw buffers]
+        self._owned = []     # [(weakref(plan), [buffers])]
+        self._pending = []   # buffers taken by the in-flight build
+        self.hits = 0        # takes served from the pool
+        self.misses = 0      # takes that allocated fresh pages
+
+    def begin(self, protect=()):
+        """Open a build: reclaim every unprotected generation."""
+        protected = {id(p) for p in protect if p is not None}
+        survivors = []
+        for ref, bufs in self._owned:
+            plan = ref()
+            if plan is not None and id(plan) in protected:
+                survivors.append((ref, bufs))
+            else:
+                for b in bufs:
+                    self._free.setdefault(b.dtype.str, []).append(b)
+        self._owned = survivors
+        for b in self._pending:
+            self._free.setdefault(b.dtype.str, []).append(b)
+        pending = []
+        self._pending = pending
+        return pending
+
+    def take(self, shape, dtype, fill=None, owner=None):
+        """A ``shape``/``dtype`` array backed by a pooled buffer (the
+        smallest free one that fits; fresh rounded-up allocation
+        otherwise). ``owner`` is the pending list to register the
+        backing buffer on (defaults to the current build's)."""
+        dtype = np.dtype(dtype)
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        pool = self._free.get(dtype.str, ())
+        best = None
+        for i, b in enumerate(pool):
+            if b.size >= n and (best is None or b.size < pool[best].size):
+                best = i
+        if best is not None:
+            buf = pool.pop(best)
+            self.hits += 1
+        else:
+            # geometric growth: the next power-of-two element count, so
+            # a drifting refined region re-allocates O(log) times ever
+            cap = max(1 << max(0, int(n - 1).bit_length()), 1)
+            buf = self._alloc(cap, dtype)
+            self.misses += 1
+        (self._pending if owner is None else owner).append(buf)
+        view = buf[:n].reshape(shape)
+        if fill is not None:
+            _fill_chunked(view, fill)
+        return view
+
+    @staticmethod
+    def _alloc(count, dtype):
+        """Fresh backing store. Plain np.empty: the pages are faulted
+        in by the first fill, exactly once. (An anonymous MAP_POPULATE
+        mmap was measured here and lost — it touches every page during
+        populate AND again on the fill, and this host's first-touch
+        throughput at high RSS is the whole bottleneck.)"""
+        return np.empty(count, dtype=dtype)
+
+    def current_owner(self):
+        """The in-flight build's ownership list: lazy thunks register
+        their takes on it so post-``bind`` materialization stays owned
+        by the plan the thunk belongs to."""
+        return self._pending
+
+    def bind(self, plan):
+        """Transfer the in-flight build's buffers to ``plan``; returns
+        the ownership list so lazy thunks can keep appending to it."""
+        owned = self._pending
+        self._owned.append((weakref.ref(plan), owned))
+        self._pending = []
+        return owned
+
+    def stats(self) -> dict:
+        pooled = sum(b.nbytes for bufs in self._free.values() for b in bufs)
+        owned = sum(b.nbytes for _r, bufs in self._owned for b in bufs)
+        return {"hits": self.hits, "misses": self.misses,
+                "free_bytes": int(pooled), "owned_bytes": int(owned)}
 
 
 def _per_dim_radius(neighborhoods) -> np.ndarray:
@@ -90,7 +222,14 @@ class _LevelBlock:
     cell-unit offset, whether that neighbor slot is inside the grid,
     and whether it exists as a level-l leaf."""
 
-    def __init__(self, mapping, periodic, cells, level, a, b):
+    # level lattices above this are looked up by binary search instead
+    # of a position lattice (numpy path; the native batch switches
+    # strategy at the larger _PLAT_MAX_NATIVE — its lattice lives in
+    # the arena, so the fill cost is paid on warm pages)
+    _PLAT_MAX = 1 << 25
+    _PLAT_MAX_NATIVE = 1 << 27
+
+    def __init__(self, mapping, periodic, cells, level, a, b, arena=None):
         self.a, self.b = a, b
         self.level = level
         self.cells = cells
@@ -99,22 +238,72 @@ class _LevelBlock:
         self.first = np.int64(mapping._level_first[level])
         self.size = 1 << (mapping.max_refinement_level - level)
         self.periodic = periodic
+        self._arena = arena
         lin = (cells[a:b] - np.uint64(self.first)).astype(np.int64)
+        self.lin = lin
         nxl, nyl, nzl = self.dims
         self.x = lin % nxl
         self.y = (lin // nxl) % nyl
         self.z = lin // (nxl * nyl)
         self._cache = {}
+        self._batch = None  # (pos_all, valid_all, off key -> batch row)
         # all level-l cells are contiguous in the sorted cell array, so
         # a direct lin -> position lattice replaces the per-offset
         # binary search over the whole grid (the hot part of easy-block
         # classification) when the level lattice fits in memory
         n_lat = nxl * nyl * nzl
-        if n_lat <= (1 << 25):
+        from . import native
+        if native.lib is None and n_lat <= self._PLAT_MAX:
             self._plat = np.full(n_lat, -1, dtype=np.int32)
             self._plat[lin] = np.arange(a, b, dtype=np.int32)
         else:
             self._plat = None
+
+    def precompute(self, offs_batch):
+        """Batched native lookup of the whole offset set in one call
+        (one lattice build amortized over every offset, positions as
+        int32); no-op without the native lib — ``lookup`` then runs
+        the per-offset numpy path with identical plan-level results."""
+        from . import native
+
+        if native.lib is None or self.b > 2**31 - 2:
+            return
+        offs_batch = np.ascontiguousarray(offs_batch,
+                                          dtype=np.int64).reshape(-1, 3)
+        kb, m = len(offs_batch), self.b - self.a
+        take = (self._arena.take if self._arena is not None
+                else lambda shape, dtype: np.empty(shape, dtype))
+        pos = take((kb, m), np.int32)
+        valid = take((kb, m), bool)
+        exist = take((kb, m), bool)
+        n_lat = int(np.prod(np.asarray(self.dims, dtype=np.int64)))
+        plat = (take((n_lat,), np.int32)
+                if n_lat <= self._PLAT_MAX_NATIVE else None)
+        native.level_lookup(
+            self.dims, self.periodic, self.lin, self.a, self.cells, self.b,
+            self.first, offs_batch, plat, pos, valid, exist,
+        )
+        rows = {}
+        for j, off in enumerate(offs_batch):
+            key = (int(off[0]), int(off[1]), int(off[2]))
+            self._cache[key] = (pos[j], valid[j], exist[j])
+            rows[key] = j
+        self._batch = (pos, valid, rows)
+
+    def batch_rows(self, offs):
+        """(pos_all, valid_all, sel) of the precomputed batch covering
+        every offset in ``offs`` — the zero-copy form dn_easy_tables
+        consumes — or None when no batch covers them."""
+        if self._batch is None:
+            return None
+        pos, valid, rows = self._batch
+        sel = np.empty(len(offs), dtype=np.int64)
+        for j, o in enumerate(offs):
+            row = rows.get((int(o[0]), int(o[1]), int(o[2])))
+            if row is None:
+                return None
+            sel[j] = row
+        return pos, valid, sel
 
     def lookup(self, off):
         key = (int(off[0]), int(off[1]), int(off[2]))
@@ -150,7 +339,7 @@ class _LevelBlock:
 
 
 def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
-                      cap=None, reuse=None):
+                      cap=None, reuse=None, arena=None, changed_hint=None):
     """All plan pieces for a refined grid.
 
     Returns ``(layout, hood_data)`` like uniform.build_uniform_plan:
@@ -158,6 +347,14 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     row_of_pos / scale_rows; hood_data maps hood id -> dict with the
     split gather tables, a lazy neighbors_to thunk, and the
     send/receive lists.
+
+    ``arena`` is the grid's :class:`PlanArena` (the caller must have
+    opened it with ``begin``); the big tables are taken from it so
+    recommits run on warm pages. ``changed_hint`` is ``(prev_cells,
+    changed_ids)``: when ``prev_cells`` is identical (the object) to
+    the reuse cache's cell list, ``changed_ids`` replaces the
+    O(n log n) set difference between the epochs' cell lists — the
+    dirty-set propagation from ``stop_refining``.
     """
     from .grid import DEFAULT_NEIGHBORHOOD_ID
     from .neighbors import find_neighbors_of
@@ -166,6 +363,10 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     from . import native
 
     mark = _phase_timer()
+    if arena is None:
+        arena = PlanArena()
+        arena.begin()
+    owned = arena.current_owner()
 
     dims = tuple(int(v) for v in mapping.length.get())
     nx, ny, nz = dims
@@ -177,14 +378,16 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     owner = np.asarray(owner, dtype=np.int32)
     cells = np.asarray(cells, dtype=np.uint64)
     n = len(cells)
+    # the in-place table writers emit int32 position sentinels
+    use_native = native.lib is not None and n < 2**31 - 2
 
     # level-major ids: the level-0 subset is exactly the sorted prefix
     # of ids <= n0 (dccrg_mapping.hpp:154-209)
     n_lvl0 = int(np.searchsorted(cells, np.uint64(n0), side="right"))
     lvl0_gidx = cells[:n_lvl0].astype(np.int64) - 1
-    present = np.zeros(n0, dtype=bool)
+    present = arena.take((n0,), bool, fill=False)
     present[lvl0_gidx] = True
-    pos0 = np.full(n0, -1, dtype=np.int64)  # slot -> position in `cells`
+    pos0 = arena.take((n0,), np.int64, fill=-1)  # slot -> position in `cells`
     pos0[lvl0_gidx] = np.arange(n_lvl0)
 
     # --- level-0 classification: refined slots box-dilated ------------
@@ -201,7 +404,7 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
 
     # owner per level-0 slot (refined slots hold garbage, only ever
     # indexed through far sources whose windows are always present)
-    owner0 = np.zeros(n0, dtype=np.int32)
+    owner0 = arena.take((n0,), np.int32, fill=0)
     owner0[lvl0_gidx] = owner[:n_lvl0]
 
     maps = _NeighborMaps(dims, periodic)
@@ -219,7 +422,11 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
         b = int(np.searchsorted(cells, last))
         if a == b:
             continue
-        blk = _LevelBlock(mapping, periodic, cells, l, a, b)
+        blk = _LevelBlock(mapping, periodic, cells, l, a, b, arena=arena)
+        # one native batch resolves every symmetrized offset for the
+        # whole block (classification, easy tables, boundary edges and
+        # the lazy to-tables all draw on this cache)
+        blk.precompute(check_offs)
         easy = np.ones(b - a, dtype=bool)
         for off in check_offs:
             _pos, valid, exist = blk.lookup(off)
@@ -254,10 +461,18 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     reusable = None
     if reuse and reuse.get("fp") == (dims, hood_fp):
         prev_cells = reuse["cells"]
-        changed = np.concatenate([
-            np.setdiff1d(cells, prev_cells, assume_unique=True),
-            np.setdiff1d(prev_cells, cells, assume_unique=True),
-        ])
+        if changed_hint is not None and changed_hint[0] is prev_cells:
+            # dirty-set propagation from stop_refining: the commit
+            # already knows exactly which ids appeared/disappeared, so
+            # the O(n log n) set difference over the full 8M-cell
+            # lists is skipped (an owner-only rebuild passes an empty
+            # set: repartitions reuse every stream)
+            changed = np.asarray(changed_hint[1], dtype=np.uint64)
+        else:
+            changed = np.concatenate([
+                np.setdiff1d(cells, prev_cells, assume_unique=True),
+                np.setdiff1d(prev_cells, cells, assume_unique=True),
+            ])
         if len(changed):
             lat_ch = np.zeros(n0, dtype=bool)
             lat_ch[lvl0_gidx_of(changed)] = True
@@ -287,9 +502,14 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
         # their boxes are untouched), plus a reusable-source mask over
         # old positions; per-hood selection is then pure gathers
         prev_cells = reuse["cells"]
-        old2new = np.searchsorted(cells, prev_cells)
+        old2new = native.sorted_positions(cells, prev_cells)
+        if old2new is None:
+            old2new = np.searchsorted(cells, prev_cells)
         reus_old = np.zeros(len(prev_cells), dtype=bool)
-        reus_old[np.searchsorted(prev_cells, reusable)] = True
+        rpos = native.sorted_positions(prev_cells, reusable)
+        if rpos is None:
+            rpos = np.searchsorted(prev_cells, reusable)
+        reus_old[rpos] = True
     for hid, offs in neighborhoods.items():
         src, nbr, off, item = find_neighbors_of(
             mapping, topology, cells, fresh_hard, offs
@@ -298,29 +518,36 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
         spos = fresh_pos[src]
         npos = np.searchsorted(cells, nbr)
         if reusable is not None:
-            ps_pos, pn_pos, po, pi = reuse["streams"][hid]
-            keep = reus_old[ps_pos]
-            spos_b = old2new[ps_pos[keep]]
-            npos_b = old2new[pn_pos[keep]]
-            off_b, item_b = po[keep], pi[keep]
-            # both pieces are sorted by source position and share no
-            # source (a cell is wholly fresh or wholly reused), so a
-            # linear merge replaces the N log N sort; within-source
-            # (item, sibling-rank) order is preserved piecewise
-            na, nb = len(spos), len(spos_b)
-            at = np.searchsorted(spos_b, spos) + np.arange(na)
-            bt = np.searchsorted(spos, spos_b) + np.arange(nb)
-            m_spos = np.empty(na + nb, dtype=spos.dtype)
-            m_npos = np.empty(na + nb, dtype=npos.dtype)
-            m_off = np.empty((na + nb,) + off.shape[1:], dtype=off.dtype)
-            m_item = np.empty(na + nb, dtype=item.dtype)
-            for dst_arr, a_arr, b_arr in ((m_spos, spos, spos_b),
-                                          (m_npos, npos, npos_b),
-                                          (m_off, off, off_b),
-                                          (m_item, item, item_b)):
-                dst_arr[at] = a_arr
-                dst_arr[bt] = b_arr
-            spos, npos, off, item = m_spos, m_npos, m_off, m_item
+            merged = native.stream_remap_merge(
+                old2new, reus_old, reuse["streams"][hid],
+                (spos, npos, off, item))
+            if merged is not None:
+                spos, npos, off, item = merged
+            else:
+                ps_pos, pn_pos, po, pi = reuse["streams"][hid]
+                keep = reus_old[ps_pos]
+                spos_b = old2new[ps_pos[keep]]
+                npos_b = old2new[pn_pos[keep]]
+                off_b, item_b = po[keep], pi[keep]
+                # both pieces are sorted by source position and share
+                # no source (a cell is wholly fresh or wholly reused),
+                # so a linear merge replaces the N log N sort; within-
+                # source (item, sibling-rank) order is preserved
+                # piecewise
+                na, nb = len(spos), len(spos_b)
+                at = np.searchsorted(spos_b, spos) + np.arange(na)
+                bt = np.searchsorted(spos, spos_b) + np.arange(nb)
+                m_spos = np.empty(na + nb, dtype=spos.dtype)
+                m_npos = np.empty(na + nb, dtype=npos.dtype)
+                m_off = np.empty((na + nb,) + off.shape[1:], dtype=off.dtype)
+                m_item = np.empty(na + nb, dtype=item.dtype)
+                for dst_arr, a_arr, b_arr in ((m_spos, spos, spos_b),
+                                              (m_npos, npos, npos_b),
+                                              (m_off, off, off_b),
+                                              (m_item, item, item_b)):
+                    dst_arr[at] = a_arr
+                    dst_arr[bt] = b_arr
+                spos, npos, off, item = m_spos, m_npos, m_off, m_item
         new_cache["streams"][hid] = (spos, npos, off, item)
         streams[hid] = (spos, npos, off, item)
     if reuse is not None:
@@ -399,7 +626,9 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     G = cap("G", G) if G else 0
     R = L + G + 1  # final row = permanent zero pad
 
-    row_of_pos = np.full(n, -1, dtype=np.int32)
+    # every cell is local to exactly one device, so the scatter below
+    # writes every entry — no -1 pre-fill pass needed on the arena view
+    row_of_pos = arena.take((n,), np.int32)
     for d in range(n_dev):
         lpos = np.searchsorted(cells, local_ids[d])
         row_of_pos[lpos] = np.arange(len(local_ids[d]), dtype=np.int32)
@@ -429,12 +658,12 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     far_dev = owner[far_pos].astype(np.int64)
     far_rowidx = far_dev * L + row_of_pos[far_pos]
 
-    row_of_pos0 = np.zeros(n0, dtype=np.int32)
+    row_of_pos0 = arena.take((n0,), np.int32, fill=0)
     row_of_pos0[lvl0_gidx] = row_of_pos[:n_lvl0]
 
     # per-row cell size in index units (far/easy rows; hard rows get
     # explicit offsets, pad rows never pass a mask)
-    scale_rows = np.zeros(n_dev * L, dtype=np.int32)
+    scale_rows = arena.take((n_dev * L,), np.int32, fill=0)
     scale_rows[far_rowidx] = size0
     easy_rowidx = {}
     for blk, easy in blocks:
@@ -449,7 +678,7 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
     # rows covered by the far/easy full-width writes below: the pad
     # fill only needs the complement (hard + pad rows, ~the surface),
     # saving a full GB-scale memory pass per hood table at large grids
-    covered = np.zeros(n_dev * L, dtype=bool)
+    covered = arena.take((n_dev * L,), bool, fill=False)
     covered[far_rowidx] = True
     for _blk_c, _easy_c in blocks:
         covered[easy_rowidx[_blk_c.level][1]] = True
@@ -462,27 +691,31 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
         s_p, s_n, s_off, s_item = streams[hid]
         nE = len(s_p)
 
-        rows_t = np.empty((n_dev * L, k), dtype=np.int32)
-        rows_t[uncovered_rows] = R - 1  # far/easy rows overwritten below
-        mask_t = np.zeros((n_dev * L, k), dtype=bool)
+        # arena-held tables: far + easy + uncovered partition the rows,
+        # so every entry is written below — no full-table pre-fill pass
+        rows_t = arena.take((n_dev * L, k), np.int32)
+        mask_t = arena.take((n_dev * L, k), bool)
+        rows_t[uncovered_rows] = R - 1  # far/easy rows written in full
+        mask_t[uncovered_rows] = False
 
-        # far rows: closed-form lattice tables (native one-pass builder
-        # when available)
-        nat = native.uniform_tables(
-            dims, periodic, offs, row_of_pos0,
-            owner0 if n_dev > 1 else None, R - 1,
-        )
-        mark(f"tables[{hid}]: native uniform")
-        if nat is not None:
-            grows, gmask = nat  # [n0, k] grid order
-            fr = grows[far_slots]
-            fm = gmask[far_slots]
-            ci, cj = np.nonzero(fr < -1)
-            if len(ci):
-                nslot = (-2 - fr[ci, cj]).astype(np.int64)
-                fr[ci, cj] = resolve_rows(pos0[nslot], far_dev[ci])
-            del grows, gmask
-            mark(f"tables[{hid}]: far gather+fixup")
+        # far rows: closed-form lattice rows written straight into the
+        # table at far_rowidx (native one-pass builder when available —
+        # no [n0, k] intermediate, no gather + scatter passes); only
+        # the cross-device fixups (the partition surface) come back to
+        # the host
+        fix = None
+        if use_native:
+            fix = native.far_tables(
+                dims, periodic, offs, far_slots, far_rowidx, row_of_pos0,
+                owner0 if n_dev > 1 else None, R - 1, rows_t, mask_t,
+            )
+        if fix is not None:
+            if len(fix):
+                ci, cj = fix // k, fix % k
+                nslot = (-2 - rows_t[far_rowidx[ci], cj]).astype(np.int64)
+                rows_t[far_rowidx[ci], cj] = resolve_rows(
+                    pos0[nslot], far_dev[ci])
+            mark(f"tables[{hid}]: far direct ({len(fix)} fixups)")
         else:
             fr = np.empty((len(far_slots), k), dtype=np.int32)
             fm = np.empty((len(far_slots), k), dtype=bool)
@@ -496,16 +729,34 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
                 )
                 fr[:, j] = rows
                 fm[:, j] = vf
-        rows_t[far_rowidx] = fr
-        mask_t[far_rowidx] = fm
-        del fr, fm
-        mark(f"tables[{hid}]: far scatter")
+            rows_t[far_rowidx] = fr
+            mask_t[far_rowidx] = fm
+            del fr, fm
+            mark(f"tables[{hid}]: far scatter")
 
         # easy rows: level-l index arithmetic, all offsets batched
         for blk, easy in blocks:
             ei, ridx = easy_rowidx[blk.level]
             E = len(ei)
             if E == 0:
+                continue
+            batch = blk.batch_rows(offs) if use_native else None
+            if batch is not None:
+                pos_all, valid_all, sel = batch
+                edev32 = (np.ascontiguousarray(owner[blk.a + ei])
+                          if n_dev > 1 else None)
+                fix = native.easy_tables(
+                    ei, ridx, sel, pos_all, valid_all, blk.b - blk.a,
+                    row_of_pos, owner if n_dev > 1 else None, edev32,
+                    R - 1, rows_t, mask_t,
+                )
+                if len(fix):
+                    ce, cj = fix // k, fix % k
+                    p = (-2 - rows_t[ridx[ce], cj]).astype(np.int64)
+                    rows_t[ridx[ce], cj] = resolve_rows(
+                        p, owner[blk.a + ei[ce]].astype(np.int64))
+                mark(f"tables[{hid}]: easy block l{blk.level} "
+                     f"({len(fix)} fixups)")
                 continue
             edev = owner[blk.a + ei].astype(np.int64)
             posm = np.empty((E, k), dtype=np.int64)
@@ -526,7 +777,33 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
 
         # hard rows: compact per-device tables from the stream
         hard_rows_dev = hard_nbr_dev = hard_offs_dev = hard_mask_dev = None
-        if nE:
+        if nE and use_native:
+            # fused native writer: shape probe, then grouping + entry
+            # scatter + pad fill in one sequential pass — every table
+            # byte written exactly once (the numpy path below pays a
+            # GB-scale pad fill plus a fancy-indexed scatter)
+            nG, s_need, counts = native.hard_counts(
+                s_p, owner if n_dev > 1 else None, n_dev)
+            S_hard = cap(("S_hard", hid), max(1, int(s_need)))
+            Hmax = cap(("Hmax", hid), max(1, int(counts.max())))
+            mark(f"tables[{hid}]: hard grouping (H {int(counts.max())}"
+                 f"/{Hmax}, S {int(s_need)}/{S_hard})")
+            hard_rows_dev = arena.take((n_dev, Hmax), np.int32)
+            hard_nbr_dev = arena.take((n_dev, Hmax, S_hard), np.int32)
+            hard_offs_dev = arena.take((n_dev, Hmax, S_hard, 3), np.int32)
+            hard_mask_dev = arena.take((n_dev, Hmax, S_hard), bool)
+            fix = native.hard_fill(
+                s_p, s_n, s_off, owner if n_dev > 1 else None, row_of_pos,
+                n_dev, Hmax, S_hard, L, R - 1,
+                hard_rows_dev, hard_nbr_dev, hard_offs_dev, hard_mask_dev,
+            )
+            if len(fix):
+                flat = hard_nbr_dev.reshape(-1)
+                rdev = fix // (Hmax * S_hard)  # reader device of the entry
+                p = (-2 - flat[fix]).astype(np.int64)
+                flat[fix] = resolve_rows(p, rdev)
+            mark(f"tables[{hid}]: hard assembly ({len(fix)} fixups)")
+        elif nE:
             # slot = rank within the (contiguous, source-sorted) group
             changed = np.empty(nE, dtype=bool)
             changed[0] = True
@@ -551,10 +828,14 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
                 np.arange(len(gsel)) - dev_first[g_dev[gorder]]
             )
             Hmax = cap(("Hmax", hid), max(1, int(counts.max())))
-            hard_rows_dev = np.full((n_dev, Hmax), L, dtype=np.int32)  # pad=L: dropped
-            hard_nbr_dev = np.full((n_dev, Hmax, S_hard), R - 1, dtype=np.int32)
-            hard_offs_dev = np.zeros((n_dev, Hmax, S_hard, 3), dtype=np.int32)
-            hard_mask_dev = np.zeros((n_dev, Hmax, S_hard), dtype=bool)
+            hard_rows_dev = arena.take((n_dev, Hmax), np.int32,
+                                       fill=L)  # pad=L: dropped
+            hard_nbr_dev = arena.take((n_dev, Hmax, S_hard), np.int32,
+                                      fill=R - 1)
+            hard_offs_dev = arena.take((n_dev, Hmax, S_hard, 3), np.int32,
+                                       fill=0)
+            hard_mask_dev = arena.take((n_dev, Hmax, S_hard), bool,
+                                       fill=False)
             hard_rows_dev[g_dev, dense_idx] = g_row.astype(np.int32)
             e_dev = g_dev[grp]
             e_pos = dense_idx[grp]
@@ -567,8 +848,10 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
 
         def offs_thunk(mask_t=mask_t, offs_const=offs_const, k=k):
             # far/easy per-slot offsets (hard rows carry theirs in the
-            # compact hard tables; host queries use the engine)
-            out = (mask_t[:, :, None] * offs_const[None, :, :]).astype(np.int32)
+            # compact hard tables; host queries use the engine); runs
+            # after bind, so the take lands on the plan's owned list
+            out = arena.take((n_dev * L, k, 3), np.int32, owner=owned)
+            np.multiply(mask_t[:, :, None], offs_const[None, :, :], out=out)
             out *= scale_rows[:, None, None]
             return out.reshape(n_dev, L, k, 3)
 
@@ -583,6 +866,10 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
             "hard_mask": hard_mask_dev,
         }
         mark(f"tables hood {hid}")
+
+    # arena tables are all written at this point: a fault here pins
+    # that a rolled-back plan's (protected) buffers were never touched
+    faults.fire("hybrid.recommit", phase="tables")
 
     # --- send / receive lists -----------------------------------------
     from .uniform import build_pair_tables
@@ -683,9 +970,14 @@ def build_hybrid_plan(mapping, topology, neighborhoods, cells, owner, n_dev,
                 tslot = np.empty(0, dtype=np.int64)
                 T_hard = 0
             T = max(k, T_hard, 1)
-            to_rows = np.full((n_dev * L, T), R - 1, dtype=np.int32)
-            to_offs = np.zeros((n_dev * L, T, 3), dtype=np.int32)
-            to_mask = np.zeros((n_dev * L, T), dtype=bool)
+            # lazy materialization: these takes run after bind and land
+            # on the owning plan's arena list
+            to_rows = arena.take((n_dev * L, T), np.int32, fill=R - 1,
+                                 owner=owned)
+            to_offs = arena.take((n_dev * L, T, 3), np.int32, fill=0,
+                                 owner=owned)
+            to_mask = arena.take((n_dev * L, T), bool, fill=False,
+                                 owner=owned)
             # far rows: to-neighbor at slot j is the level-0 cell at -o
             for j, o in enumerate(offs):
                 ng, valid = maps.shift((-int(o[0]), -int(o[1]), -int(o[2])))

@@ -73,7 +73,7 @@ def _load():
         dll = ctypes.CDLL(str(so))
     except OSError:
         return None
-    if dll.dn_abi_version() != 1:
+    if dll.dn_abi_version() != 2:
         return None
 
     u64p = ctypes.POINTER(ctypes.c_uint64)
@@ -137,11 +137,85 @@ def _load():
         ctypes.c_int32,                                   # pad_row
         i32p, u8p,                                        # rows_out, mask_out
     ]
+    dll.dn_sorted_positions.restype = None
+    dll.dn_sorted_positions.argtypes = [u64p, ctypes.c_int64,
+                                        u64p, ctypes.c_int64, i64p]
+    dll.dn_level_lookup.restype = None
+    dll.dn_level_lookup.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,   # nxl, nyl, nzl
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,   # periodic
+        i64p, ctypes.c_int64, ctypes.c_int64,             # lin, m, a
+        u64p, ctypes.c_int64, ctypes.c_uint64,            # cells, b, first
+        i64p, ctypes.c_int64,                             # offs, kb
+        i32p, ctypes.c_int64,                             # plat, n_lat
+        i32p, u8p, u8p,                                   # pos, valid, exist
+    ]
+    dll.dn_far_tables.restype = ctypes.c_int64
+    dll.dn_far_tables.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,   # nx, ny, nz
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,   # periodic
+        i64p, ctypes.c_int64,                             # offs, k
+        i64p, ctypes.c_int64, i64p,                       # far_slots, nf, rowidx
+        i32p, i32p,                                       # row_of_pos0, owner0
+        ctypes.c_int32,                                   # pad_row
+        i32p, u8p,                                        # rows_t, mask_t
+        i64p, ctypes.c_int64,                             # fix_out, fix_cap
+    ]
+    dll.dn_easy_tables.restype = ctypes.c_int64
+    dll.dn_easy_tables.argtypes = [
+        i64p, ctypes.c_int64, i64p,                       # ei, E, ridx
+        i64p, ctypes.c_int64,                             # sel, k
+        i32p, u8p, ctypes.c_int64,                        # pos_all, valid_all, m
+        i32p, i32p, i32p,                                 # row_of_pos, owner, edev
+        ctypes.c_int32,                                   # pad_row
+        i32p, u8p,                                        # rows_t, mask_t
+        i64p, ctypes.c_int64,                             # fix_out, fix_cap
+    ]
+    dll.dn_hard_counts.restype = None
+    dll.dn_hard_counts.argtypes = [i64p, ctypes.c_int64, i32p,
+                                   ctypes.c_int64, i64p]
+    dll.dn_hard_fill.restype = ctypes.c_int64
+    dll.dn_hard_fill.argtypes = [
+        i64p, i64p, i64p, ctypes.c_int64,                 # s_p, s_n, s_off, nE
+        i32p, i32p,                                       # owner, row_of_pos
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,   # n_dev, Hmax, S
+        ctypes.c_int32, ctypes.c_int32,                   # row_pad, nbr_pad
+        i32p, i32p, i32p, u8p,                            # rows/nbr/offs/mask
+        i64p, ctypes.c_int64,                             # fix_out, fix_cap
+    ]
+    dll.dn_stream_remap_merge.restype = ctypes.c_int64
+    dll.dn_stream_remap_merge.argtypes = [
+        i64p, u8p,                                        # old2new, reus_old
+        i64p, i64p, i64p, i64p, ctypes.c_int64,           # prev s/n/off/item
+        i64p, i64p, i64p, i64p, ctypes.c_int64,           # fresh s/n/off/item
+        i64p, i64p, i64p, i64p, ctypes.c_int64,           # merged + capacity
+    ]
     return dll
 
 
 def _ptr(arr, ctype):
     return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _i32_ptr_or_null(arr):
+    """int32 pointer, or a typed NULL when ``arr`` is None (optional
+    owner/lattice parameters of the recommit kernels)."""
+    if arr is None:
+        return ctypes.cast(None, ctypes.POINTER(ctypes.c_int32))
+    return _ptr(arr, ctypes.c_int32)
+
+
+def _with_fixups(call, cap):
+    """Run a table-writer kernel that appends cross-device fixup
+    records into a caller-allocated buffer: retry with a bigger buffer
+    until the count fits (the table writes themselves are idempotent).
+    ``call(fix, cap)`` returns the total fixup count."""
+    while True:
+        fix = np.empty(cap, dtype=np.int64)
+        n_fix = call(fix, cap)
+        if n_fix <= cap:
+            return fix[:n_fix]
+        cap = int(n_fix)
 
 
 def find_neighbors_of(mapping, topology, all_cells_sorted, query_cells,
@@ -309,8 +383,7 @@ def uniform_tables(dims, periodic, offs, row_of_pos, owner, pad_row):
     mask = np.empty((n0, k), dtype=bool)
     own_arr = (np.ascontiguousarray(owner, dtype=np.int32)
                if owner is not None else None)
-    own_ptr = (_ptr(own_arr, ctypes.c_int32) if own_arr is not None
-               else ctypes.cast(None, ctypes.POINTER(ctypes.c_int32)))
+    own_ptr = _i32_ptr_or_null(own_arr)
     lib.dn_uniform_tables(
         nx, ny, nz,
         int(bool(periodic[0])), int(bool(periodic[1])), int(bool(periodic[2])),
@@ -368,6 +441,174 @@ def cell_lengths(mapping, length_table, cells) -> np.ndarray:
         _ptr(cells, ctypes.c_uint64), len(cells), _ptr(out, ctypes.c_double),
     )
     return out
+
+
+def sorted_positions(haystack, needles):
+    """``np.searchsorted(haystack, needles)`` for SORTED needles as one
+    linear native sweep. Returns None when the native lib is absent."""
+    if lib is None:
+        return None
+    hay = np.ascontiguousarray(haystack, dtype=np.uint64)
+    nee = np.ascontiguousarray(needles, dtype=np.uint64)
+    out = np.empty(len(nee), dtype=np.int64)
+    lib.dn_sorted_positions(
+        _ptr(hay, ctypes.c_uint64), len(hay),
+        _ptr(nee, ctypes.c_uint64), len(nee), _ptr(out, ctypes.c_int64),
+    )
+    return out
+
+
+def level_lookup(dims_l, periodic, lin, a, cells, b, first, offs,
+                 plat, pos_out, valid_out, exist_out):
+    """Batched level-block lookup (hybrid._LevelBlock): fill the
+    caller's [kb, m] pos/valid/exist arrays for every offset at once.
+    ``plat`` is the arena-held position-lattice scratch (int32,
+    ``n_lat``) or None for the binary-search strategy. Returns False
+    when the native lib is absent (caller falls back to numpy)."""
+    if lib is None:
+        return False
+    nxl, nyl, nzl = (int(v) for v in dims_l)
+    lin = np.ascontiguousarray(lin, dtype=np.int64)
+    offs = np.ascontiguousarray(offs, dtype=np.int64).reshape(-1, 3)
+    lib.dn_level_lookup(
+        nxl, nyl, nzl,
+        int(bool(periodic[0])), int(bool(periodic[1])), int(bool(periodic[2])),
+        _ptr(lin, ctypes.c_int64), len(lin), int(a),
+        _ptr(cells, ctypes.c_uint64), int(b), ctypes.c_uint64(int(first)),
+        _ptr(offs, ctypes.c_int64), len(offs),
+        _i32_ptr_or_null(plat), 0 if plat is None else len(plat),
+        _ptr(pos_out, ctypes.c_int32), _ptr(valid_out, ctypes.c_uint8),
+        _ptr(exist_out, ctypes.c_uint8),
+    )
+    return True
+
+
+def far_tables(dims, periodic, offs, far_slots, far_rowidx, row_of_pos0,
+               owner0, pad_row, rows_t, mask_t):
+    """Far-row gather tables written straight into the caller's
+    [n_rows, k] tables at ``far_rowidx`` (no [n0, k] intermediate).
+    Returns the packed ``i * k + j`` cross-device fixup indices, or
+    None when the native lib is absent."""
+    if lib is None:
+        return None
+    nx, ny, nz = (int(v) for v in dims)
+    offs = np.ascontiguousarray(offs, dtype=np.int64).reshape(-1, 3)
+    far_slots = np.ascontiguousarray(far_slots, dtype=np.int64)
+    far_rowidx = np.ascontiguousarray(far_rowidx, dtype=np.int64)
+    return _with_fixups(
+        lambda fix, cap: lib.dn_far_tables(
+            nx, ny, nz,
+            int(bool(periodic[0])), int(bool(periodic[1])),
+            int(bool(periodic[2])),
+            _ptr(offs, ctypes.c_int64), len(offs),
+            _ptr(far_slots, ctypes.c_int64), len(far_slots),
+            _ptr(far_rowidx, ctypes.c_int64),
+            _ptr(row_of_pos0, ctypes.c_int32), _i32_ptr_or_null(owner0),
+            np.int32(pad_row),
+            _ptr(rows_t, ctypes.c_int32), _ptr(mask_t, ctypes.c_uint8),
+            _ptr(fix, ctypes.c_int64), cap,
+        ),
+        1024 if owner0 is None else max(1024, len(far_slots) // 8))
+
+
+def easy_tables(ei, ridx, sel, pos_all, valid_all, m, row_of_pos, owner,
+                edev, pad_row, rows_t, mask_t):
+    """Easy-row gather tables written straight into the caller's
+    [n_rows, k] tables from the batched level-block lookup results.
+    Returns the packed ``e * k + j`` cross-device fixup indices, or
+    None when the native lib is absent."""
+    if lib is None:
+        return None
+    ei = np.ascontiguousarray(ei, dtype=np.int64)
+    ridx = np.ascontiguousarray(ridx, dtype=np.int64)
+    sel = np.ascontiguousarray(sel, dtype=np.int64)
+    return _with_fixups(
+        lambda fix, cap: lib.dn_easy_tables(
+            _ptr(ei, ctypes.c_int64), len(ei), _ptr(ridx, ctypes.c_int64),
+            _ptr(sel, ctypes.c_int64), len(sel),
+            _ptr(pos_all, ctypes.c_int32), _ptr(valid_all, ctypes.c_uint8),
+            int(m),
+            _ptr(row_of_pos, ctypes.c_int32), _i32_ptr_or_null(owner),
+            _i32_ptr_or_null(edev),
+            np.int32(pad_row),
+            _ptr(rows_t, ctypes.c_int32), _ptr(mask_t, ctypes.c_uint8),
+            _ptr(fix, ctypes.c_int64), cap,
+        ),
+        1024 if owner is None else max(1024, len(ei) // 4))
+
+
+def hard_counts(s_p, owner, n_dev):
+    """(n_groups, widest_group, per-device group counts) of the
+    source-sorted hard entry stream, or None without the native lib."""
+    if lib is None:
+        return None
+    s_p = np.ascontiguousarray(s_p, dtype=np.int64)
+    out = np.zeros(2 + n_dev, dtype=np.int64)
+    lib.dn_hard_counts(_ptr(s_p, ctypes.c_int64), len(s_p),
+                       _i32_ptr_or_null(owner), int(n_dev),
+                       _ptr(out, ctypes.c_int64))
+    return int(out[0]), int(out[1]), out[2:]
+
+
+def hard_fill(s_p, s_n, s_off, owner, row_of_pos, n_dev, Hmax, S, row_pad,
+              nbr_pad, rows_dev, nbr_dev, offs_dev, mask_dev):
+    """Fused hard-table writer (grouping + scatter + pad in one pass).
+    Returns the packed flat-nbr-table fixup indices, or None without
+    the native lib."""
+    if lib is None:
+        return None
+    s_p = np.ascontiguousarray(s_p, dtype=np.int64)
+    s_n = np.ascontiguousarray(s_n, dtype=np.int64)
+    s_off = np.ascontiguousarray(s_off, dtype=np.int64)
+    return _with_fixups(
+        lambda fix, cap: lib.dn_hard_fill(
+            _ptr(s_p, ctypes.c_int64), _ptr(s_n, ctypes.c_int64),
+            _ptr(s_off, ctypes.c_int64), len(s_p),
+            _i32_ptr_or_null(owner), _ptr(row_of_pos, ctypes.c_int32),
+            int(n_dev), int(Hmax), int(S),
+            np.int32(row_pad), np.int32(nbr_pad),
+            _ptr(rows_dev, ctypes.c_int32), _ptr(nbr_dev, ctypes.c_int32),
+            _ptr(offs_dev, ctypes.c_int32), _ptr(mask_dev, ctypes.c_uint8),
+            _ptr(fix, ctypes.c_int64), cap,
+        ),
+        1024 if owner is None else max(1024, len(s_p) // 8))
+
+
+def stream_remap_merge(old2new, reus_old, prev_stream, fresh_stream):
+    """Reuse-branch stream merge: remap the kept previous-epoch
+    entries through ``old2new`` and merge with the fresh entries in
+    one linear pass. Returns (spos, npos, off, item) or None when the
+    native lib is absent."""
+    if lib is None:
+        return None
+    ps, pn, po, pi = prev_stream
+    fs, fn_, fo, fi = fresh_stream
+    old2new = np.ascontiguousarray(old2new, dtype=np.int64)
+    reus_old = np.ascontiguousarray(reus_old.view(np.uint8))
+    ps = np.ascontiguousarray(ps, dtype=np.int64)
+    pn = np.ascontiguousarray(pn, dtype=np.int64)
+    po = np.ascontiguousarray(po, dtype=np.int64)
+    pi = np.ascontiguousarray(pi, dtype=np.int64)
+    fs = np.ascontiguousarray(fs, dtype=np.int64)
+    fn_ = np.ascontiguousarray(fn_, dtype=np.int64)
+    fo = np.ascontiguousarray(fo, dtype=np.int64)
+    fi = np.ascontiguousarray(fi, dtype=np.int64)
+    cap = len(fs) + len(ps)
+    ms = np.empty(cap, dtype=np.int64)
+    mn = np.empty(cap, dtype=np.int64)
+    mo = np.empty((cap, 3), dtype=np.int64)
+    mi = np.empty(cap, dtype=np.int64)
+    total = lib.dn_stream_remap_merge(
+        _ptr(old2new, ctypes.c_int64), _ptr(reus_old, ctypes.c_uint8),
+        _ptr(ps, ctypes.c_int64), _ptr(pn, ctypes.c_int64),
+        _ptr(po, ctypes.c_int64), _ptr(pi, ctypes.c_int64), len(ps),
+        _ptr(fs, ctypes.c_int64), _ptr(fn_, ctypes.c_int64),
+        _ptr(fo, ctypes.c_int64), _ptr(fi, ctypes.c_int64), len(fs),
+        _ptr(ms, ctypes.c_int64), _ptr(mn, ctypes.c_int64),
+        _ptr(mo, ctypes.c_int64), _ptr(mi, ctypes.c_int64), cap,
+    )
+    assert total <= cap  # nb <= len(ps) by construction
+    return ms[:total], mn[:total], mo[:total], mi[:total]
 
 
 def sfc_keys(indices, bits, kind):

@@ -630,7 +630,403 @@ void dn_uniform_tables(int64_t nx, int64_t ny, int64_t nz, int32_t px,
   }
 }
 
-int32_t dn_abi_version(void) { return 1; }
+// ---------------------------------------------------------------------------
+// Recommit fast-path kernels (../hybrid.py): the AMR plan re-commit's
+// hot loops, moved out of numpy so a 192^3 rebuild stops paying
+// multi-GB temporary materialization + page faults (ROADMAP "Hybrid
+// re-commit cost at 192^3").  All functions are bitwise-equivalent to
+// the numpy fallbacks at the level the plan consumes (gather tables,
+// masks, merged streams) — pinned by tests/test_recommit.py.
+
+// positions of sorted needles in a sorted haystack — np.searchsorted
+// (side='left') lowered to one linear sweep, O(n + m) instead of
+// O(m log n), since both inputs are sorted cell-id arrays.
+void dn_sorted_positions(const uint64_t *hay, int64_t n,
+                         const uint64_t *needles, int64_t m, int64_t *out) {
+  int64_t i = 0;
+  for (int64_t j = 0; j < m; ++j) {
+    const uint64_t v = needles[j];
+    while (i < n && hay[i] < v) ++i;
+    out[j] = i;
+  }
+}
+
+// Batched level-block neighbor-position lookup: for the contiguous
+// block of level-l cells at positions [a, b) in the sorted cell list,
+// resolve every (cell, offset) pair of the whole symmetrized offset
+// set in one call (hybrid._LevelBlock.lookup's per-offset
+// lattice/searchsorted loop).  `plat` is caller-provided scratch of
+// n_lat int32 (the level-l position lattice, arena-reused across
+// epochs); pass NULL to use per-item binary search instead (huge
+// lattices).  Outputs are [kb, m]: position in the cell list (0 when
+// the neighbor does not exist), in-grid validity, and existence as a
+// level-l leaf.
+void dn_level_lookup(int64_t nxl, int64_t nyl, int64_t nzl, int32_t px,
+                     int32_t py, int32_t pz, const int64_t *lin, int64_t m,
+                     int64_t a, const uint64_t *cells, int64_t b,
+                     uint64_t first, const int64_t *offs, int64_t kb,
+                     int32_t *plat, int64_t n_lat, int32_t *pos_out,
+                     uint8_t *valid_out, uint8_t *exist_out) {
+  std::vector<int32_t> xs((size_t)m), ys((size_t)m), zs((size_t)m);
+  const int64_t nxy = nxl * nyl;
+  for (int64_t i = 0; i < m; ++i) {
+    const int64_t l = lin[i];
+    xs[(size_t)i] = (int32_t)(l % nxl);
+    ys[(size_t)i] = (int32_t)((l / nxl) % nyl);
+    zs[(size_t)i] = (int32_t)(l / nxy);
+  }
+  if (plat != nullptr) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t i = 0; i < n_lat; ++i)
+      plat[i] = -1;
+    for (int64_t i = 0; i < m; ++i)
+      plat[lin[i]] = (int32_t)(a + i);
+  }
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t j = 0; j < kb; ++j) {
+    const int64_t ox = offs[3 * j], oy = offs[3 * j + 1], oz = offs[3 * j + 2];
+    int32_t *po = pos_out + j * m;
+    uint8_t *vo = valid_out + j * m;
+    uint8_t *eo = exist_out + j * m;
+    for (int64_t i = 0; i < m; ++i) {
+      int64_t x = xs[(size_t)i] + ox, y = ys[(size_t)i] + oy,
+              z = zs[(size_t)i] + oz;
+      bool valid = true;
+      if (x < 0 || x >= nxl) {
+        if (px)
+          x = ((x % nxl) + nxl) % nxl;
+        else
+          valid = false;
+      }
+      if (y < 0 || y >= nyl) {
+        if (py)
+          y = ((y % nyl) + nyl) % nyl;
+        else
+          valid = false;
+      }
+      if (z < 0 || z >= nzl) {
+        if (pz)
+          z = ((z % nzl) + nzl) % nzl;
+        else
+          valid = false;
+      }
+      int32_t p = 0;
+      bool exist = false;
+      if (valid) {
+        const int64_t lin_n = x + nxl * (y + nyl * z);
+        if (plat != nullptr) {
+          const int32_t q = plat[lin_n];
+          if (q >= 0) {
+            exist = true;
+            p = q;
+          }
+        } else {
+          const uint64_t nid = first + (uint64_t)lin_n;
+          const uint64_t *lo = std::lower_bound(cells + a, cells + b, nid);
+          if (lo != cells + b && *lo == nid) {
+            exist = true;
+            p = (int32_t)(lo - cells);
+          }
+        }
+      }
+      po[i] = p;
+      vo[i] = (uint8_t)valid;
+      eo[i] = (uint8_t)exist;
+    }
+  }
+}
+
+// Far-row gather tables written IN PLACE: the level-0 lattice rows of
+// dn_uniform_tables restricted to the far slots and scattered straight
+// into the (arena-reused) [n_rows, k] hybrid table at far_rowidx — no
+// [n0, k] intermediate, no host-side gather + scatter passes.
+// Cross-device entries carry the ``-2 - neighbor_slot`` sentinel and
+// their (far index, item) pair is appended (packed i * k + j) to
+// fix_out so the host fixes up ONLY the partition surface.  Returns
+// the fixup count (may exceed fix_cap: caller re-calls with a larger
+// buffer; table writes are idempotent).
+int64_t dn_far_tables(int64_t nx, int64_t ny, int64_t nz, int32_t px,
+                      int32_t py, int32_t pz, const int64_t *offs, int64_t k,
+                      const int64_t *far_slots, int64_t nf,
+                      const int64_t *far_rowidx, const int32_t *row_of_pos0,
+                      const int32_t *owner0, int32_t pad_row, int32_t *rows_t,
+                      uint8_t *mask_t, int64_t *fix_out, int64_t fix_cap) {
+  const int64_t nxy = nx * ny;
+  std::vector<int64_t> dflat((size_t)k), lo(3, 0), hi(3);
+  hi[0] = nx;
+  hi[1] = ny;
+  hi[2] = nz;
+  for (int64_t j = 0; j < k; ++j) {
+    dflat[(size_t)j] = offs[3 * j] + offs[3 * j + 1] * nx + offs[3 * j + 2] * nxy;
+    lo[0] = std::max(lo[0], -offs[3 * j]);
+    hi[0] = std::min(hi[0], nx - offs[3 * j]);
+    lo[1] = std::max(lo[1], -offs[3 * j + 1]);
+    hi[1] = std::min(hi[1], ny - offs[3 * j + 1]);
+    lo[2] = std::max(lo[2], -offs[3 * j + 2]);
+    hi[2] = std::min(hi[2], nz - offs[3 * j + 2]);
+  }
+  int64_t n_fix = 0;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t i = 0; i < nf; ++i) {
+    const int64_t g = far_slots[i];
+    const int64_t x = g % nx, y = (g / nx) % ny, z = g / nxy;
+    int32_t *rout = rows_t + far_rowidx[i] * k;
+    uint8_t *mout = mask_t + far_rowidx[i] * k;
+    const bool interior = x >= lo[0] && x < hi[0] && y >= lo[1] &&
+                          y < hi[1] && z >= lo[2] && z < hi[2];
+    const int32_t own = owner0 ? owner0[g] : 0;
+    for (int64_t j = 0; j < k; ++j) {
+      int64_t ng;
+      if (interior) {
+        ng = g + dflat[(size_t)j];
+      } else {
+        int64_t xx = x + offs[3 * j], yy = y + offs[3 * j + 1],
+                zz = z + offs[3 * j + 2];
+        bool valid = true;
+        if (xx < 0 || xx >= nx) {
+          if (px)
+            xx = ((xx % nx) + nx) % nx;
+          else
+            valid = false;
+        }
+        if (yy < 0 || yy >= ny) {
+          if (py)
+            yy = ((yy % ny) + ny) % ny;
+          else
+            valid = false;
+        }
+        if (zz < 0 || zz >= nz) {
+          if (pz)
+            zz = ((zz % nz) + nz) % nz;
+          else
+            valid = false;
+        }
+        if (!valid) {
+          rout[j] = pad_row;
+          mout[j] = 0;
+          continue;
+        }
+        ng = xx + yy * nx + zz * nxy;
+      }
+      if (owner0 != nullptr && owner0[ng] != own) {
+        rout[j] = (int32_t)(-2 - ng);
+        int64_t at;
+#ifdef _OPENMP
+#pragma omp atomic capture
+#endif
+        at = n_fix++;
+        if (at < fix_cap)
+          fix_out[at] = i * k + j;
+      } else {
+        rout[j] = row_of_pos0[ng];
+      }
+      mout[j] = 1;
+    }
+  }
+  return n_fix;
+}
+
+// Easy-row gather tables written IN PLACE from the batched level-block
+// lookup results: for every easy cell e and neighborhood item j, the
+// same-level neighbor's row goes straight into the [n_rows, k] table
+// at ridx[e] (hybrid.py's posm/validm staging + resolve_rows pass).
+// `sel` maps each hood item to its row in the [kb, m] batch arrays.
+// Cross-device entries get the ``-2 - neighbor_position`` sentinel +
+// a packed (e * k + j) fixup record, as dn_far_tables.
+int64_t dn_easy_tables(const int64_t *ei, int64_t E, const int64_t *ridx,
+                       const int64_t *sel, int64_t k, const int32_t *pos_all,
+                       const uint8_t *valid_all, int64_t m,
+                       const int32_t *row_of_pos, const int32_t *owner,
+                       const int32_t *edev, int32_t pad_row, int32_t *rows_t,
+                       uint8_t *mask_t, int64_t *fix_out, int64_t fix_cap) {
+  int64_t n_fix = 0;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t e = 0; e < E; ++e) {
+    const int64_t be = ei[e];
+    int32_t *rout = rows_t + ridx[e] * k;
+    uint8_t *mout = mask_t + ridx[e] * k;
+    const int32_t dev = owner ? edev[e] : 0;
+    for (int64_t j = 0; j < k; ++j) {
+      const int64_t row = sel[j];
+      const uint8_t v = valid_all[row * m + be];
+      if (!v) {
+        rout[j] = pad_row;
+        mout[j] = 0;
+        continue;
+      }
+      const int32_t p = pos_all[row * m + be];
+      if (owner != nullptr && owner[p] != dev) {
+        rout[j] = (int32_t)(-2 - p);
+        int64_t at;
+#ifdef _OPENMP
+#pragma omp atomic capture
+#endif
+        at = n_fix++;
+        if (at < fix_cap)
+          fix_out[at] = e * k + j;
+      } else {
+        rout[j] = row_of_pos[p];
+      }
+      mout[j] = 1;
+    }
+  }
+  return n_fix;
+}
+
+// Hard-table shape probe: one scan of the source-sorted entry stream
+// yielding the per-device group (= hard cell) counts and the widest
+// group — the quantities the sticky caps bucket into (Hmax, S_hard).
+// out = [nG, S_needed, counts[0..n_dev)].
+void dn_hard_counts(const int64_t *s_p, int64_t nE, const int32_t *owner,
+                    int64_t n_dev, int64_t *out) {
+  int64_t nG = 0, s_max = 0;
+  for (int64_t d = 0; d < n_dev; ++d)
+    out[2 + d] = 0;
+  int64_t i = 0;
+  while (i < nE) {
+    const int64_t sp = s_p[i];
+    int64_t cnt = 0;
+    while (i < nE && s_p[i] == sp) {
+      ++cnt;
+      ++i;
+    }
+    ++nG;
+    if (cnt > s_max)
+      s_max = cnt;
+    ++out[2 + (owner ? owner[sp] : 0)];
+  }
+  out[0] = nG;
+  out[1] = s_max;
+}
+
+// Fused hard-table writer: grouping, dense per-device row assignment,
+// entry scatter AND pad fill in ONE sequential pass — every byte of
+// the four tables is written exactly once (the numpy path pays a full
+// pad fill plus a fancy-indexed scatter; at 128^3+ the pad fill alone
+// is GBs of cold writes).  Entries arrive source-sorted, so a
+// device's rows fill consecutively (identical to the numpy stable
+// argsort by device).  Cross-device neighbors get the
+// ``-2 - position`` sentinel + a packed flat-table-index fixup, as
+// the far/easy writers.  Returns the fixup count.
+int64_t dn_hard_fill(const int64_t *s_p, const int64_t *s_n,
+                     const int64_t *s_off, int64_t nE, const int32_t *owner,
+                     const int32_t *row_of_pos, int64_t n_dev, int64_t Hmax,
+                     int64_t S, int32_t row_pad, int32_t nbr_pad,
+                     int32_t *rows_dev, int32_t *nbr_dev, int32_t *offs_dev,
+                     uint8_t *mask_dev, int64_t *fix_out, int64_t fix_cap) {
+  std::vector<int64_t> cursor((size_t)n_dev, 0);
+  int64_t n_fix = 0, i = 0;
+  while (i < nE) {
+    const int64_t sp = s_p[i];
+    const int32_t d = owner ? owner[sp] : 0;
+    const int64_t r = cursor[(size_t)d]++;
+    const int64_t cell = (int64_t)d * Hmax + r;
+    rows_dev[cell] = row_of_pos[sp];
+    int64_t slot = 0;
+    for (; i < nE && s_p[i] == sp; ++i, ++slot) {
+      const int64_t at = cell * S + slot;
+      const int64_t np_ = s_n[i];
+      if (owner != nullptr && owner[np_] != d) {
+        nbr_dev[at] = (int32_t)(-2 - np_);
+        if (n_fix < fix_cap)
+          fix_out[n_fix] = at;
+        ++n_fix;
+      } else {
+        nbr_dev[at] = row_of_pos[np_];
+      }
+      offs_dev[3 * at] = (int32_t)s_off[3 * i];
+      offs_dev[3 * at + 1] = (int32_t)s_off[3 * i + 1];
+      offs_dev[3 * at + 2] = (int32_t)s_off[3 * i + 2];
+      mask_dev[at] = 1;
+    }
+    // slot tail of this row
+    for (; slot < S; ++slot) {
+      const int64_t at = cell * S + slot;
+      nbr_dev[at] = nbr_pad;
+      offs_dev[3 * at] = offs_dev[3 * at + 1] = offs_dev[3 * at + 2] = 0;
+      mask_dev[at] = 0;
+    }
+  }
+  // row tails of every device
+  for (int64_t d = 0; d < n_dev; ++d) {
+    for (int64_t r = cursor[(size_t)d]; r < Hmax; ++r) {
+      const int64_t cell = d * Hmax + r;
+      rows_dev[cell] = row_pad;
+      for (int64_t slot = 0; slot < S; ++slot) {
+        const int64_t at = cell * S + slot;
+        nbr_dev[at] = nbr_pad;
+        offs_dev[3 * at] = offs_dev[3 * at + 1] = offs_dev[3 * at + 2] = 0;
+        mask_dev[at] = 0;
+      }
+    }
+  }
+  return n_fix;
+}
+
+// Epoch-to-epoch hard-stream reuse: remap the kept previous-epoch
+// entries' positions through old2new and merge them with the freshly
+// computed entries, both source-position-sorted, in one linear pass
+// (hybrid.py's reuse-branch gather + double-searchsorted merge).  The
+// two runs share no source cell (a cell is wholly fresh or wholly
+// reused), so the merge is unambiguous; within-source entry order is
+// preserved piecewise.  Returns the merged length (may exceed
+// capacity: caller re-allocates and retries).
+int64_t dn_stream_remap_merge(
+    const int64_t *old2new, const uint8_t *reus_old, const int64_t *ps,
+    const int64_t *pn, const int64_t *po, const int64_t *pi, int64_t n_prev,
+    const int64_t *fs, const int64_t *fn, const int64_t *fo,
+    const int64_t *fi, int64_t n_fresh, int64_t *ms, int64_t *mn, int64_t *mo,
+    int64_t *mi, int64_t capacity) {
+  int64_t nb = 0;
+  for (int64_t i = 0; i < n_prev; ++i)
+    nb += (int64_t)(reus_old[ps[i]] != 0);
+  const int64_t total = n_fresh + nb;
+  if (total > capacity)
+    return total;
+  int64_t ia = 0, ib = 0, w = 0;
+  while (ib < n_prev && !reus_old[ps[ib]])
+    ++ib;
+  while (ia < n_fresh || ib < n_prev) {
+    bool take_fresh;
+    if (ib >= n_prev)
+      take_fresh = true;
+    else if (ia >= n_fresh)
+      take_fresh = false;
+    else
+      take_fresh = fs[ia] <= old2new[ps[ib]];
+    if (take_fresh) {
+      ms[w] = fs[ia];
+      mn[w] = fn[ia];
+      mo[3 * w] = fo[3 * ia];
+      mo[3 * w + 1] = fo[3 * ia + 1];
+      mo[3 * w + 2] = fo[3 * ia + 2];
+      mi[w] = fi[ia];
+      ++ia;
+    } else {
+      ms[w] = old2new[ps[ib]];
+      mn[w] = old2new[pn[ib]];
+      mo[3 * w] = po[3 * ib];
+      mo[3 * w + 1] = po[3 * ib + 1];
+      mo[3 * w + 2] = po[3 * ib + 2];
+      mi[w] = pi[ib];
+      ++ib;
+      while (ib < n_prev && !reus_old[ps[ib]])
+        ++ib;
+    }
+    ++w;
+  }
+  return total;
+}
+
+int32_t dn_abi_version(void) { return 2; }
 
 
 // ---------------------------------------------------------------------------

@@ -802,7 +802,8 @@ class ResilientRunner:
     """
 
     def __init__(self, grid, step_fn, checkpoint_path, *, fields=None,
-                 check_every=None, checkpoint_every=10, max_retries=3,
+                 check_every=None, checkpoint_every=10,
+                 checkpoint_seconds=0.0, max_retries=3,
                  backoff=0.05, header=b"", variable=None,
                  diagnostics_dir=None, interrupt_poll=None):
         self.grid = grid
@@ -817,6 +818,16 @@ class ResilientRunner:
         self.check_every = (check_every if check_every is not None
                             else (watchdog_interval(0) or 1))
         self.checkpoint_every = checkpoint_every
+        # wall-clock cadence (monotonic clock, evaluated only at step
+        # boundaries — a save can never land mid-step): a checkpoint
+        # becomes due once this many seconds passed since the last
+        # one, whatever the step count. 0 disables; step-count cadence
+        # may be disabled independently with checkpoint_every=0. On
+        # multi-process meshes the per-rank clocks drift, so due-ness
+        # goes through an any-rank consensus before acting — every
+        # rank enters the collective save together.
+        self.checkpoint_seconds = float(checkpoint_seconds or 0.0)
+        self._last_save_t = None
         self.max_retries = max_retries
         self.backoff = backoff
         self.header = header
@@ -838,6 +849,7 @@ class ResilientRunner:
         save_checkpoint(self.grid, self.checkpoint_path,
                         header=self.header, variable=self.variable)
         self._ckpt_step = self.step
+        self._last_save_t = time.monotonic()
         self.checkpoints += 1
 
     def _rollback(self) -> None:
@@ -1006,7 +1018,15 @@ class ResilientRunner:
                 raise RunInterrupted(self.step)
             self.step += 1
             faults.poison_step(self.grid, self.step)
-            ckpt_due = self.step % self.checkpoint_every == 0
+            ckpt_due = (bool(self.checkpoint_every)
+                        and self.step % self.checkpoint_every == 0)
+            if not ckpt_due and self.checkpoint_seconds > 0:
+                due = (self._last_save_t is not None
+                       and time.monotonic() - self._last_save_t
+                       >= self.checkpoint_seconds)
+                # clocks drift across ranks: agree (any rank due ->
+                # all save) before entering the collective save path
+                ckpt_due = bool(coord.trip_consensus(self.grid, int(due)))
             # a checkpoint step ALWAYS checks first — the rollback
             # target must never capture unverified (poisoned) state,
             # whatever the check/checkpoint cadence ratio

@@ -142,6 +142,14 @@ def step_timeout_default(default: float = 0.0) -> float:
     return _env_float("DCCRG_STEP_TIMEOUT", default)
 
 
+def ckpt_seconds_default(default: float = 0.0) -> float:
+    """The ``DCCRG_CKPT_SECONDS`` env knob: wall-clock checkpoint
+    cadence in seconds (monotonic clock, evaluated at step boundaries
+    only — never mid-step), for runs whose step times are too uneven
+    for a step-count cadence. 0 keeps the step-count cadence alone."""
+    return _env_float("DCCRG_CKPT_SECONDS", default)
+
+
 def preempt_grace(default: float = 30.0) -> float:
     """The ``DCCRG_PREEMPT_GRACE`` env knob: seconds the emergency
     checkpoint may spend after a preemption signal — set it below the
@@ -274,6 +282,63 @@ def _grace_env(grace: float):
             os.environ.pop("DCCRG_BARRIER_TIMEOUT", None)
         else:
             os.environ["DCCRG_BARRIER_TIMEOUT"] = old
+
+
+class LatencyHistogram:
+    """Fixed log-spaced step-latency buckets.
+
+    Bucket 0 covers ``[0, BASE)`` seconds and bucket ``i >= 1`` covers
+    ``[BASE * 2**(i-1), BASE * 2**i)`` (the last absorbs the upper
+    tail), so the whole histogram
+    is ~30 ints — cheap enough to update every step forever, yet wide
+    enough (100 us .. ~15 hours) that a slowly degrading interconnect shows
+    up as mass migrating to the right long before a step actually
+    wedges into :class:`StepTimeoutError`."""
+
+    BASE = 1e-4  # seconds; bucket 0 = anything below 200 us
+    N_BUCKETS = 30
+
+    def __init__(self):
+        self.counts = [0] * self.N_BUCKETS
+        self.total = 0
+        self.max_seconds = 0.0
+
+    def record(self, seconds: float) -> None:
+        seconds = max(float(seconds), 0.0)
+        i = 0 if seconds < self.BASE else int(
+            math.log2(seconds / self.BASE)) + 1
+        self.counts[min(max(i, 0), self.N_BUCKETS - 1)] += 1
+        self.total += 1
+        self.max_seconds = max(self.max_seconds, seconds)
+
+    def buckets(self) -> list:
+        """``[(lo_seconds, hi_seconds, count)]`` for every bucket."""
+        out = []
+        for i, c in enumerate(self.counts):
+            lo = 0.0 if i == 0 else self.BASE * (2.0 ** (i - 1))
+            hi = self.BASE * (2.0 ** i)
+            out.append((lo, hi, c))
+        return out
+
+    def quantile(self, q: float) -> float:
+        """Upper edge of the bucket holding the q-quantile (0 when
+        nothing was recorded)."""
+        if self.total == 0:
+            return 0.0
+        target = max(1, math.ceil(q * self.total))
+        seen = 0
+        for lo, hi, c in self.buckets():
+            seen += c
+            if seen >= target:
+                return hi
+        return self.buckets()[-1][1]
+
+    def summary(self) -> str:
+        if self.total == 0:
+            return "no steps recorded"
+        return (f"{self.total} steps, p50<={self.quantile(0.5):.3g}s, "
+                f"p95<={self.quantile(0.95):.3g}s, "
+                f"max={self.max_seconds:.3g}s")
 
 
 # markers of the transient class of XLA runtime errors (a flaky
@@ -578,24 +643,38 @@ class SupervisedRunner:
 
     Keyword knobs (None = the env default): ``step_timeout``
     (``DCCRG_STEP_TIMEOUT``; 0 disables the per-step deadline thread
-    entirely), ``grace`` (``DCCRG_PREEMPT_GRACE``), ``keep_last``
+    entirely), ``checkpoint_seconds`` (``DCCRG_CKPT_SECONDS``;
+    wall-clock checkpoint cadence for uneven step times — monotonic
+    clock, step boundaries only, 0 keeps the step-count cadence),
+    ``grace`` (``DCCRG_PREEMPT_GRACE``), ``keep_last``
     (``DCCRG_KEEP_LAST``) / ``keep_every`` (retention),
     ``dispatch_retries`` / ``dispatch_backoff`` (transient-error
     retry). Remaining keyword arguments (``fields``, ``check_every``,
     ``checkpoint_every``, ``max_retries``, ``backoff``, ``header``,
     ``variable``, ``diagnostics_dir``) pass through to
-    ``ResilientRunner``."""
+    ``ResilientRunner``. Per-step wall times are recorded into
+    :meth:`latency_histogram` log-spaced buckets."""
 
     def __init__(self, grid, step_fn, checkpoint_dir, *, stem="ckpt",
                  step_timeout=None, dispatch_retries=2,
                  dispatch_backoff=0.05, keep_last=None, keep_every=0,
                  grace=None, signals=None, install_signal_handlers=True,
-                 start_step=0, **runner_kw):
+                 start_step=0, checkpoint_seconds=None, **runner_kw):
         self.grid = grid
         self.step_fn = step_fn
         self.store = CheckpointStore(checkpoint_dir, stem=stem)
         self.step_timeout = (step_timeout_default() if step_timeout is None
                              else float(step_timeout))
+        # wall-clock checkpoint cadence (DCCRG_CKPT_SECONDS): uneven
+        # step times make a step-count cadence either too chatty or
+        # too sparse; the runner checks the monotonic clock at step
+        # boundaries only (never mid-step, consensus-agreed on
+        # multi-process meshes — see ResilientRunner)
+        runner_kw.setdefault(
+            "checkpoint_seconds",
+            ckpt_seconds_default() if checkpoint_seconds is None
+            else float(checkpoint_seconds))
+        self._latency = LatencyHistogram()
         self.dispatch_retries = int(dispatch_retries)
         self.dispatch_backoff = float(dispatch_backoff)
         self.keep_last = (keep_last_default() if keep_last is None
@@ -635,6 +714,14 @@ class SupervisedRunner:
     @property
     def checkpoints(self):
         return self._runner.checkpoints
+
+    def latency_histogram(self) -> list:
+        """Per-step wall-time distribution as ``[(lo_s, hi_s, count)]``
+        log-spaced buckets (see :class:`LatencyHistogram`); a summary
+        line is logged automatically when a step wedges into
+        :class:`StepTimeoutError`, so the latency trend that preceded
+        the wedge is on record."""
+        return self._latency.buckets()
 
     # -- the lifecycle ------------------------------------------------
 
@@ -698,6 +785,21 @@ class SupervisedRunner:
                 time.sleep(delay)
 
     def _timed_step(self, grid, i):
+        t0 = time.perf_counter()
+        try:
+            self._timed_step_inner(grid, i)
+        except StepTimeoutError:
+            self._latency.record(time.perf_counter() - t0)
+            # the latency trend BEFORE the wedge is the diagnosis: a
+            # slowly degrading interconnect shows as mass migrating
+            # into the slow buckets over the preceding steps
+            logger.warning("step %d wedged; latency so far: %s",
+                           i, self._latency.summary())
+            raise
+        else:
+            self._latency.record(time.perf_counter() - t0)
+
+    def _timed_step_inner(self, grid, i):
         timeout = self.step_timeout
         hang = faults.take_step_hang(i)
         if timeout <= 0:

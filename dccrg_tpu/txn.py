@@ -93,6 +93,7 @@ _MISSING = object()
 _REF_ATTRS = (
     "plan",
     "_pending_owner",
+    "_pending_changed_cells",
     "_cells_epoch",
     "_cut_edges",
     "_plan_gather_mode",
@@ -177,6 +178,11 @@ def grid_transaction(grid, op: str = "mutation", validate=None):
 
     snap = snapshot_state(grid)
     grid._txn_depth = 1
+    # the rollback target plan: the hybrid builder's PlanArena keeps
+    # its table buffers protected for the transaction's duration, so a
+    # failed rebuild can never scribble on tables a rollback restores
+    _snap_plan = snap.get("plan")
+    grid._txn_plan = None if _snap_plan is _MISSING else _snap_plan
     try:
         try:
             yield
@@ -206,6 +212,7 @@ def grid_transaction(grid, op: str = "mutation", validate=None):
                     op, e, cells=getattr(e, "cells", ())) from e
     finally:
         grid._txn_depth = 0
+        grid._txn_plan = None
 
 
 def grid_state_bytes(grid, header: bytes = b"") -> bytes:

@@ -5,9 +5,17 @@
 # the main tier-1 run keeps DEBUG off for speed. Mirrors the
 # reference's -DDEBUG CI builds.
 #
+# Also exercises one native-recommit parity test under DEBUG so the
+# post-commit verify_all runs against plans built by the native
+# in-place table writers + PlanArena (the numpy-only fallback is
+# covered by the same test when the native build is unavailable).
+#
 # Usage: tests/ci_debug_leg.sh [extra pytest args]
 set -e
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m "(fuzz or faultinject) and not slow" --dccrg-debug \
     -p no:cacheprovider "$@"
+exec env JAX_PLATFORMS=cpu python -m pytest -q \
+    "tests/test_recommit.py::test_native_numpy_plans_bitwise_identical" \
+    --dccrg-debug -p no:cacheprovider "$@"

@@ -1,0 +1,289 @@
+"""Native recommit fast path: bitwise native/numpy parity + arena
+atomicity.
+
+The AMR plan re-commit hot loops (batched easy-block lookups, the
+in-place far/easy/hard table writers, the stream-reuse position remap)
+live in the native engine with pure-numpy fallbacks; these tests pin
+that BOTH engines produce bitwise-identical plans — layout and every
+hood table — across refine / recommit / unrefine sequences, and that
+the PlanArena (pooled table buffers reused across epochs) can never
+leak a partially-written build into a rolled-back plan.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dccrg_tpu import FaultPlan, Grid, MutationAbortedError, native
+from dccrg_tpu.txn import grid_state_bytes
+
+pytestmark = pytest.mark.recommit
+
+needs_native = pytest.mark.skipif(
+    native.lib is None, reason="native library failed to build")
+
+
+def mesh_of(n):
+    return Mesh(np.array(jax.devices()[:n]), ("dev",))
+
+
+def make_grid(length=(6, 5, 4), periodic=(False, True, False), n_dev=4,
+              max_ref=2):
+    return (
+        Grid(cell_data={"v": jnp.float32})
+        .set_initial_length(length)
+        .set_periodic(*periodic)
+        .set_maximum_refinement_level(max_ref)
+        .set_neighborhood_length(1)
+        .initialize(mesh_of(n_dev))
+    )
+
+
+def adapt_sequence(g):
+    """refine -> recommit (reuse epoch) -> unrefine, yielding a plan
+    fingerprint after every commit."""
+    fps = []
+    for c in (1, 2, 3):
+        g.refine_completely(c)
+    g.stop_refining()
+    fps.append(plan_fingerprint(g))
+    for c in g.plan.cells[:6]:
+        g.refine_completely(int(c))
+    g.stop_refining()
+    fps.append(plan_fingerprint(g))
+    lvl = g.mapping.get_refinement_level(g.plan.cells)
+    deepest = g.plan.cells[lvl == lvl.max()]
+    g.unrefine_completely(int(deepest[0]))
+    g.stop_refining()
+    fps.append(plan_fingerprint(g))
+    return fps
+
+
+def plan_fingerprint(g):
+    """SHA-256 over the full plan: layout + every hood table, bitwise
+    (lazy to-tables and offset tables materialized)."""
+    h = hashlib.sha256()
+    p = g.plan
+    h.update(np.ascontiguousarray(p.cells).tobytes())
+    h.update(np.ascontiguousarray(p.owner).tobytes())
+    h.update(str((p.L, p.R)).encode())
+    h.update(np.ascontiguousarray(p.row_of_pos).tobytes())
+    h.update(np.asarray(p.n_local).tobytes())
+    for d in range(p.n_dev):
+        h.update(np.ascontiguousarray(p.local_ids[d]).tobytes())
+        h.update(np.ascontiguousarray(p.ghost_ids[d]).tobytes())
+    for hid in sorted(p.hoods):
+        hood = p.hoods[hid]
+        h.update(np.ascontiguousarray(hood.nbr_rows).tobytes())
+        h.update(np.ascontiguousarray(hood.nbr_mask).tobytes())
+        h.update(np.ascontiguousarray(hood.nbr_offs).tobytes())
+        if hood.scale_rows is not None:
+            h.update(np.ascontiguousarray(hood.scale_rows).tobytes())
+        for t in (hood.hard_rows, hood.hard_nbr_rows, hood.hard_offs,
+                  hood.hard_mask):
+            if t is not None:
+                h.update(np.ascontiguousarray(t).tobytes())
+        for t in hood._to_tables():
+            h.update(np.ascontiguousarray(t).tobytes())
+        h.update(np.ascontiguousarray(hood.send_rows).tobytes())
+        h.update(np.ascontiguousarray(hood.recv_rows).tobytes())
+        if hood.n_inner is not None:
+            h.update(np.asarray(hood.n_inner).tobytes())
+    return h.hexdigest()
+
+
+CONFIGS = [
+    dict(),
+    dict(periodic=(True, True, True), length=(4, 4, 4), n_dev=2),
+    dict(n_dev=1, length=(5, 4, 4)),
+    dict(length=(4, 4, 2), max_ref=3),
+]
+
+
+@needs_native
+@pytest.mark.parametrize("kw", CONFIGS)
+def test_native_numpy_plans_bitwise_identical(monkeypatch, kw):
+    """The same refine/recommit/unrefine sequence with the native lib
+    on and forced off must produce bitwise-identical plans: layout and
+    every gather/to/hard table."""
+    fps_native = adapt_sequence(make_grid(**kw))
+    monkeypatch.setattr(native, "lib", None)
+    fps_numpy = adapt_sequence(make_grid(**kw))
+    assert fps_native == fps_numpy
+
+
+def test_reuse_and_hint_change_nothing_bitwise():
+    """Stream reuse + the stop_refining dirty-set hint are pure
+    optimizations: plans must be bitwise identical to a from-scratch
+    rebuild with the reuse cache cleared before every commit."""
+    def run(kill_reuse):
+        g = make_grid()
+        fps = []
+        for c in (1, 2, 3):
+            g.refine_completely(c)
+        g.stop_refining()
+        fps.append(plan_fingerprint(g))
+        for step in range(2):
+            if kill_reuse:
+                g._hybrid_reuse = {}
+            for c in g.plan.cells[6 * step:6 * step + 6]:
+                g.refine_completely(int(c))
+            g.stop_refining()
+            fps.append(plan_fingerprint(g))
+        return fps
+
+    assert run(False) == run(True)
+
+
+def test_balance_then_recommit_matches_fresh_reuse():
+    """An owner-only rebuild (balance_load) passes an empty dirty set —
+    every stream is reused with only positions/owners remapped; the
+    result must be bitwise identical to a cache-cleared rebuild."""
+    def run(kill_reuse):
+        g = make_grid(n_dev=3)
+        for c in (1, 2, 3):
+            g.refine_completely(c)
+        g.stop_refining()
+        if kill_reuse:
+            g._hybrid_reuse = {}
+        g.balance_load()
+        fp1 = plan_fingerprint(g)
+        if kill_reuse:
+            g._hybrid_reuse = {}
+        for c in g.plan.cells[:4]:
+            g.refine_completely(int(c))
+        g.stop_refining()
+        return fp1, plan_fingerprint(g)
+
+    assert run(False) == run(True)
+
+
+@pytest.mark.faultinject
+@pytest.mark.parametrize("phase", ["classified", "cached", "tables"])
+def test_arena_rollback_is_bitwise_atomic(phase):
+    """A fault at any recommit phase — including after the arena
+    tables were written — must roll back to a plan whose tables are
+    bitwise identical to the pre-commit state: the arena can never
+    hand a protected (rollback-target) buffer to an in-flight build."""
+    g = make_grid()
+    for c in (1, 2, 3):
+        g.refine_completely(c)
+    g.stop_refining()
+    # one more committed epoch so the arena pool is warm and the next
+    # build actually recycles buffers
+    for c in g.plan.cells[:4]:
+        g.refine_completely(int(c))
+    g.stop_refining()
+
+    before_bytes = grid_state_bytes(g)
+    before_fp = plan_fingerprint(g)
+    before_plan = g.plan
+
+    plan = FaultPlan(seed=3)
+    plan.mutation_error(site="hybrid.recommit", times=1, phase=phase)
+    for c in g.plan.cells[4:8]:
+        g.refine_completely(int(c))
+    with plan:
+        with pytest.raises(MutationAbortedError):
+            g.stop_refining()
+    assert plan.fired("hybrid.recommit") == 1
+    assert g.plan is before_plan
+    assert plan_fingerprint(g) == before_fp
+    assert grid_state_bytes(g) == before_bytes
+
+    # the requests survived the rollback: the retry must succeed and
+    # match an undisturbed control run bitwise
+    g.stop_refining()
+    g2 = make_grid()
+    for c in (1, 2, 3):
+        g2.refine_completely(c)
+    g2.stop_refining()
+    for c in g2.plan.cells[:4]:
+        g2.refine_completely(int(c))
+    g2.stop_refining()
+    for c in g2.plan.cells[4:8]:
+        g2.refine_completely(int(c))
+    g2.stop_refining()
+    assert plan_fingerprint(g) == plan_fingerprint(g2)
+
+
+@needs_native
+def test_sorted_positions_matches_searchsorted():
+    rng = np.random.default_rng(0)
+    hay = np.unique(rng.integers(1, 10_000, 500).astype(np.uint64))
+    needles = np.unique(rng.choice(hay, 200))
+    extra = np.unique(rng.integers(1, 10_000, 50).astype(np.uint64))
+    needles = np.unique(np.concatenate([needles, extra]))
+    got = native.sorted_positions(hay, needles)
+    np.testing.assert_array_equal(got, np.searchsorted(hay, needles))
+
+
+@needs_native
+def test_level_block_batch_matches_numpy_lookup():
+    """The batched native lookup and the per-offset numpy path agree
+    on (valid, exist) everywhere and on pos wherever the neighbor
+    exists (pos is undefined-but-unused elsewhere)."""
+    from dccrg_tpu import hybrid as hybrid_mod
+
+    g = make_grid(length=(4, 4, 4), periodic=(True, False, True), n_dev=1)
+    for c in (1, 5, 22):
+        g.refine_completely(c)
+    g.stop_refining()
+    cells = g.plan.cells
+    mapping, topo = g.mapping, g.topology
+    periodic = tuple(topo.is_periodic(d) for d in range(3))
+    first = np.uint64(mapping._level_first[1])
+    last = np.uint64(mapping._level_first[2])
+    a = int(np.searchsorted(cells, first))
+    b = int(np.searchsorted(cells, last))
+    offs = np.array([[1, 0, 0], [-1, 0, 0], [0, -1, 1], [2, 2, 2]],
+                    dtype=np.int64)
+
+    nat = hybrid_mod._LevelBlock(mapping, periodic, cells, 1, a, b)
+    nat.precompute(offs)
+    ref = hybrid_mod._LevelBlock(mapping, periodic, cells, 1, a, b)
+    ref._plat = None  # force the searchsorted fallback
+    for o in offs:
+        p_n, v_n, e_n = nat.lookup(o)
+        p_r, v_r, e_r = ref.lookup(o)
+        np.testing.assert_array_equal(v_n, v_r)
+        np.testing.assert_array_equal(e_n, e_r)
+        np.testing.assert_array_equal(p_n[e_n], p_r[e_r])
+
+
+@pytest.mark.slow
+def test_recommit_192_parity_light():
+    """192^3-scale smoke (the ROADMAP scale item): slab refine +
+    recommit completes, the arena recycles buffers, and the committed
+    structure passes the consistency verifier."""
+    import jax.numpy as jnp
+
+    g = (Grid(cell_data={"v": jnp.float32})
+         .set_initial_length((192, 192, 192))
+         .set_maximum_refinement_level(1)
+         .set_neighborhood_length(1)
+         .initialize(mesh_of(1)))
+    n0 = np.uint64(192) ** 3
+    nref = int(n0) // 64
+    for c in g.plan.cells[:nref]:
+        g.refine_completely(int(c))
+    g.stop_refining()
+    lvl0 = g.plan.cells[g.plan.cells <= n0]
+    for c in lvl0[-nref:]:
+        g.refine_completely(int(c))
+    g.stop_refining()
+    # third epoch: the arena recycles the first epoch's buffers (two
+    # generations stay protected: live plan + rollback snapshot)
+    lvl1 = g.plan.cells[g.plan.cells > n0]
+    for c in lvl1[:8 * 64:8]:
+        g.unrefine_completely(int(c))
+    g.stop_refining()
+    from dccrg_tpu import verify
+    verify.is_consistent(g)
+    stats = g._plan_arena.stats()
+    assert stats["hits"] > 0, stats

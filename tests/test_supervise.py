@@ -567,3 +567,104 @@ def test_cli_verify_and_gc(tmp_path, capsys):
     assert "applied" in capsys.readouterr().out
     # step 2 is corrupt; 3 verifies and is kept, so policy prunes 1+2
     assert [s for s, _ in store.list()] == [3]
+
+
+# -- wall-clock checkpoint cadence (DCCRG_CKPT_SECONDS) ---------------
+
+def test_wall_clock_cadence_checkpoints_between_step_marks(tmp_path):
+    """With a tiny wall-clock cadence and the step-count cadence
+    effectively off, every (slow) step boundary becomes checkpoint-due
+    — saves land at step boundaries only, numbered per step."""
+    def slow_step(grid, i):
+        _step_fn(grid, i)
+        time.sleep(0.03)
+
+    sup = _sup(tmp_path, "wc", step_fn=slow_step,
+               checkpoint_every=10**9, checkpoint_seconds=0.02)
+    sup.run(4)
+    steps = sorted(s for s, _ in sup.store.list())
+    # step 0's bootstrap save + every boundary after a >=0.02s step
+    assert steps == [0, 1, 2, 3, 4]
+
+
+def test_wall_clock_cadence_off_by_default(tmp_path, monkeypatch):
+    """checkpoint_seconds defaults to DCCRG_CKPT_SECONDS (unset = 0 =
+    step-count cadence only): a fast run saves on the step cadence."""
+    monkeypatch.delenv("DCCRG_CKPT_SECONDS", raising=False)
+    sup = _sup(tmp_path, "off", checkpoint_every=3)
+    assert sup.runner.checkpoint_seconds == 0.0
+    sup.run(6)
+    assert sorted(s for s, _ in sup.store.list()) == [0, 3, 6]
+
+
+def test_wall_clock_cadence_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("DCCRG_CKPT_SECONDS", "7.5")
+    sup = _sup(tmp_path, "env")
+    assert sup.runner.checkpoint_seconds == 7.5
+    # an explicit kwarg beats the env
+    sup2 = _sup(tmp_path, "env2", checkpoint_seconds=1.25)
+    assert sup2.runner.checkpoint_seconds == 1.25
+
+
+def test_wall_clock_cadence_never_saves_mid_step(tmp_path):
+    """The monotonic clock is only consulted at step boundaries: a
+    single long step with an expired cadence still yields exactly the
+    boundary save, no mid-step one (pinned by the save count)."""
+    calls = []
+
+    def one_slow_step(grid, i):
+        calls.append(i)
+        time.sleep(0.05)
+
+    sup = _sup(tmp_path, "mid", step_fn=one_slow_step,
+               checkpoint_every=10**9, checkpoint_seconds=0.01)
+    sup.run(1)
+    assert calls == [0]
+    assert sorted(s for s, _ in sup.store.list()) == [0, 1]
+
+
+# -- per-step latency histogram ---------------------------------------
+
+def test_latency_histogram_counts_every_step(tmp_path):
+    sup = _sup(tmp_path, "lat")
+    sup.run(5)
+    buckets = sup.latency_histogram()
+    assert sum(c for _lo, _hi, c in buckets) == 5
+    # log-spaced edges: monotone, each bucket doubling
+    los = [lo for lo, _hi, _c in buckets]
+    his = [hi for _lo, hi, _c in buckets]
+    assert all(a < b for a, b in zip(his, his[1:]))
+    assert los[0] == 0.0 and los[1:] == his[:-1]
+
+
+def test_latency_histogram_places_slow_step_right(tmp_path):
+    def slow_step(grid, i):
+        time.sleep(0.06)
+
+    sup = _sup(tmp_path, "lat2", step_fn=slow_step)
+    sup.run(2)
+    mass = [(lo, hi, c) for lo, hi, c in sup.latency_histogram() if c]
+    assert sum(c for _l, _h, c in mass) == 2
+    for lo, hi, _c in mass:
+        assert hi > 0.06 * 0.5  # nothing recorded implausibly fast
+    assert sup._latency.quantile(0.5) >= 0.06
+    assert sup._latency.max_seconds >= 0.06
+
+
+def test_latency_summary_logged_on_step_timeout(tmp_path, caplog):
+    """A wedged step logs the latency story so far before raising —
+    the degradation trend is on record even though the run dies."""
+    import logging
+
+    g = _mk()
+    _step_fn(g, 0)  # warm the compiled step: the deadline is tight
+    plan = faults.FaultPlan(seed=4)
+    plan.step_hang(step=2)
+    sup = _sup(tmp_path, "wedge", grid=g, step_timeout=0.5)
+    with caplog.at_level(logging.WARNING, logger="dccrg_tpu.supervise"):
+        with plan, pytest.raises(StepTimeoutError) as ei:
+            sup.run(5)
+    assert ei.value.step == 2
+    assert any("latency so far" in r.message for r in caplog.records)
+    buckets = sup.latency_histogram()
+    assert sum(c for _l, _h, c in buckets) == 3  # steps 0, 1 + the wedge
