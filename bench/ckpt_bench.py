@@ -1,0 +1,167 @@
+"""Checkpoint cost per periodic save: full keyframes vs dirty-field
+deltas (the ROADMAP "Incremental checkpoints" item's measuring stick).
+
+The workload is the production shape delta saves exist for — a step
+loop over a multi-field schema where only the stepped field changes
+between saves (the Vlasov-style wide per-cell payload of the
+reference's home domain stays static): each periodic save is timed
+and sized in both modes, ``full`` (``DCCRG_DELTA=0``: every save a
+keyframe, byte-for-byte the pre-delta behavior — asserted against a
+direct ``resilience.save_checkpoint``) and ``delta``
+(``CheckpointStore.save`` dirty-field chains, keyframe cadence
+``--keyframe-every``).  The final delta chain is materialized and
+compared bitwise against a direct full save — the bench doubles as an
+end-to-end integrity check.
+
+Run:  timeout -k 10 600 python bench/ckpt_bench.py [--n 32] [--saves 8]
+
+JSON rows go to stdout like the other bench emitters; the summary row
+carries the bytes-per-save table PERF.md quotes (acceptance: the
+delta rows >= 10x fewer bytes than the full rows).
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import dccrg_tpu as dt  # noqa: E402
+
+# the multi-field scenario: one narrow stepped field, one wide static
+# per-cell payload (Vlasov-style), one static tag — the step loop
+# dirties ONLY "rho", so a delta carries the 16 B/cell offset-pair
+# table + 4 B/cell of rho against the full format's ~276 B/cell
+SCHEMA = {"rho": jnp.float32, "f": ((64,), jnp.float32),
+          "tag": jnp.int32}
+
+
+def _mk_grid(n, seed=0):
+    g = (dt.Grid(cell_data=SCHEMA)
+         .set_initial_length((n, n, n))
+         .set_maximum_refinement_level(0)
+         .set_neighborhood_length(1)
+         .set_periodic(True, True, True)
+         .initialize())
+    rng = np.random.default_rng(seed)
+    cells = g.plan.cells
+    for name, (shape, dtype) in g.fields.items():
+        g.set(name, cells,
+              (rng.random((len(cells),) + shape) * 100).astype(dtype))
+    g.update_copies_of_remote_neighbors()
+    return g
+
+
+def _kernel(c, nbr, offs, mask):
+    return {"rho": 0.5 * c["rho"] + 0.125 * jnp.sum(
+        jnp.where(mask, nbr["rho"], 0.0), axis=1)}
+
+
+def run_mode(mode, n, saves, keyframe_every, workdir):
+    """One measured pass: a step loop with a periodic save per step,
+    in ``full`` (DCCRG_DELTA=0) or ``delta`` mode. Returns the rows."""
+    from dccrg_tpu import resilience, supervise
+
+    os.environ["DCCRG_DELTA"] = "0" if mode == "full" else "1"
+    store_dir = os.path.join(workdir, mode)
+    g = _mk_grid(n)
+    store = supervise.CheckpointStore(store_dir,
+                                      keyframe_every=keyframe_every)
+    rows = []
+    for step in range(saves):
+        if step:
+            g.run_steps(_kernel, ["rho"], ["rho"], 1)
+        t0 = time.perf_counter()
+        path = store.save(g, step)
+        wall = time.perf_counter() - t0
+        kind = ("delta" if path.endswith(resilience.DELTA_SUFFIX)
+                else "keyframe")
+        row = {"mode": mode, "step": step, "kind": kind,
+               "bytes": os.path.getsize(path),
+               "wall_s": round(wall, 4)}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    final = store.list()[0][1]
+    if mode == "full":
+        # DCCRG_DELTA=0 must be byte-for-byte the pre-delta behavior
+        direct = os.path.join(workdir, "direct.dc")
+        resilience.save_checkpoint(g, direct)
+        with open(final, "rb") as a, open(direct, "rb") as b:
+            assert a.read() == b.read(), \
+                "DCCRG_DELTA=0 save differs from a direct full save"
+    else:
+        # the chain must reconstruct the exact full bytes
+        assert any(r["kind"] == "delta" for r in rows), \
+            "delta mode produced no delta saves"
+        direct = os.path.join(workdir, "direct_delta.dc")
+        resilience.save_checkpoint(g, direct)
+        if final.endswith(resilience.DELTA_SUFFIX):
+            out = final + ".chain.bench"
+            resilience.materialize_chain(final, out, g.fields)
+            with open(out, "rb") as a, open(direct, "rb") as b:
+                assert a.read() == b.read(), \
+                    "materialized delta chain != direct full save"
+            os.unlink(out)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=32,
+                    help="grid edge length (n^3 level-0 cells)")
+    ap.add_argument("--saves", type=int, default=8,
+                    help="periodic saves per mode")
+    ap.add_argument("--keyframe-every", type=int, default=8)
+    args = ap.parse_args()
+
+    # hang-proof backend probe before any jax work (like the other
+    # benches: a wedged accelerator tunnel survives SIGTERM)
+    from dccrg_tpu.resilience import safe_devices
+
+    safe_devices(timeout=120, retries=1, platform="cpu")
+
+    workdir = tempfile.mkdtemp(prefix="dccrg_ckpt_bench_")
+    try:
+        rows = []
+        for mode in ("full", "delta"):
+            rows += run_mode(mode, args.n, args.saves,
+                             args.keyframe_every, workdir)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    full = [r for r in rows if r["mode"] == "full"]
+    delt = [r for r in rows if r["mode"] == "delta"
+            and r["kind"] == "delta"]
+    all_delta_mode = [r for r in rows if r["mode"] == "delta"]
+    mean = lambda rs, k: sum(r[k] for r in rs) / max(1, len(rs))  # noqa: E731
+    summary = {
+        "cells": args.n ** 3, "saves": args.saves,
+        "keyframe_every": args.keyframe_every,
+        "full_bytes_per_save": round(mean(full, "bytes")),
+        "delta_bytes_per_save": round(mean(delt, "bytes")),
+        "chain_mean_bytes_per_save":
+            round(mean(all_delta_mode, "bytes")),
+        "full_wall_s_per_save": round(mean(full, "wall_s"), 4),
+        "delta_wall_s_per_save": round(mean(delt, "wall_s"), 4),
+        "bytes_ratio_full_over_delta":
+            round(mean(full, "bytes") / max(1.0, mean(delt, "bytes")), 1),
+    }
+    print(json.dumps({"summary": summary}), flush=True)
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -98,8 +98,16 @@ def _payload_spec_of(fields, variable=None):
     return fixed, total, var
 
 
-def _payload_spec(grid, variable=None):
-    return _payload_spec_of(grid.fields, variable)
+def _payload_spec(grid, variable=None, names=None):
+    """``names`` restricts the spec to a subset of the grid's fields —
+    the delta-checkpoint path serializes only the dirty fields, in the
+    same sorted-name interleave the full format uses (a delta file IS
+    a valid .dc file of the sub-schema)."""
+    if names is None:
+        return _payload_spec_of(grid.fields, variable)
+    fields = {n: grid.fields[n] for n in names}
+    variable = {n: cf for n, cf in (variable or {}).items() if n in fields}
+    return _payload_spec_of(fields, variable)
 
 
 def parse_metadata(data, header_size: int = 0):
@@ -251,7 +259,8 @@ def _interleave(nc, fixed, var_host, var_nbytes, fixed_bytes, var_spec):
 
 def save_grid_data(grid, filename: str, header: bytes = b"",
                    variable=None, *, sidecar: bool = False,
-                   sidecar_chunk_bytes: int | None = None) -> None:
+                   sidecar_chunk_bytes: int | None = None,
+                   fields=None, sidecar_extra=None) -> None:
     """Write the grid and all cell data (dccrg.hpp:1109-1736), payloads
     streamed in bounded chunks with the device pull of chunk k+1
     overlapping the file write of chunk k (the reference overlaps via
@@ -268,11 +277,18 @@ def save_grid_data(grid, filename: str, header: bytes = b"",
     additionally has the committing rank write the resilience CRC32
     sidecar (with the per-rank slice table); on the single-controller
     path the sidecar is resilience.save_checkpoint's job and these
-    kwargs are ignored."""
+    kwargs are ignored.
+
+    ``fields`` restricts the save to a subset of the grid's fields —
+    the incremental-checkpoint (delta) path: the file is a valid .dc
+    of the sub-schema, byte-layout shared with full saves.
+    ``sidecar_extra`` (a dict) is merged into the committing rank's
+    sidecar record (the delta parent link)."""
     from concurrent.futures import ThreadPoolExecutor
 
     cells = grid.get_cells()
-    fixed_spec, fixed_bytes, var_spec = _payload_spec(grid, variable)
+    fixed_spec, fixed_bytes, var_spec = _payload_spec(grid, variable,
+                                                      names=fields)
 
     meta = bytearray()
     meta += header
@@ -306,7 +322,8 @@ def save_grid_data(grid, filename: str, header: bytes = b"",
         _save_process_slice(grid, filename, bytes(meta), cells, offsets,
                             sizes, counts, fixed_spec, fixed_bytes, var_spec,
                             header_size=len(header), sidecar=sidecar,
-                            sidecar_chunk_bytes=sidecar_chunk_bytes)
+                            sidecar_chunk_bytes=sidecar_chunk_bytes,
+                            sidecar_extra=sidecar_extra)
         return
 
     starts = list(range(0, len(cells), CHUNK))
@@ -367,11 +384,15 @@ def stale_temp_files(dirpath: str) -> list:
     a run that died or was preempted mid-save: ``<f>.mp-tmp`` (an
     unfinished two-phase multi-process save — the atomic rename never
     happened, so the bytes under the final name are still the previous
-    intact checkpoint), and ``<f>.tmp.<pid>`` / ``<f>.salvage.<pid>``
-    whose owning pid is no longer alive. Never matches a finished
-    checkpoint or its sidecar. Only call between runs (or from the
-    process that owns the saves): an ``.mp-tmp`` of a save in flight
-    in ANOTHER process is indistinguishable from a stale one."""
+    intact checkpoint), and ``<f>.tmp.<pid>`` / ``<f>.salvage.<pid>`` /
+    ``<f>.chain.<pid>`` (a delta-chain reconstruction scratch file)
+    whose owning pid is no longer alive. Delta saves share the same
+    temp discipline — ``<f>.dcd.tmp.<pid>`` and ``<f>.dcd.mp-tmp``
+    match through the generic patterns. Never matches a finished
+    checkpoint (keyframe or delta) or its sidecar. Only call between
+    runs (or from the process that owns the saves): an ``.mp-tmp`` of
+    a save in flight in ANOTHER process is indistinguishable from a
+    stale one."""
     out = []
     try:
         names = sorted(os.listdir(dirpath))
@@ -384,7 +405,7 @@ def stale_temp_files(dirpath: str) -> list:
         if name.endswith(MP_TMP_SUFFIX):
             out.append(path)
             continue
-        for marker in (".tmp.", ".salvage."):
+        for marker in (".tmp.", ".salvage.", ".chain."):
             idx = name.rfind(marker)
             if idx < 0:
                 continue
@@ -471,7 +492,8 @@ def _gather_run_crcs(grid, runs, local_crcs, rank, tmp, real):
 
 def _save_process_slice(grid, filename, meta, cells, offsets, sizes, counts,
                         fixed_spec, fixed_bytes, var_spec, header_size=0,
-                        sidecar=False, sidecar_chunk_bytes=None):
+                        sidecar=False, sidecar_chunk_bytes=None,
+                        sidecar_extra=None):
     """Two-phase-commit multi-process save.
 
     Every process writes its OWN cells' payload runs — the reference's
@@ -592,7 +614,8 @@ def _save_process_slice(grid, filename, meta, cells, offsets, sizes, counts,
                                    header_size, sidecar,
                                    sidecar_chunk_bytes, rank,
                                    meta_crc & 0xFFFFFFFF,
-                                   len(meta) + 16 * len(cells))
+                                   len(meta) + 16 * len(cells),
+                                   sidecar_extra=sidecar_extra)
         except faults.InjectedRankDeath:
             raise  # a dead rank coordinates nothing
         except Exception as e:  # noqa: BLE001 - re-raised below
@@ -636,7 +659,7 @@ def _save_process_slice(grid, filename, meta, cells, offsets, sizes, counts,
 
 def _commit_process_slices(grid, filename, tmp, runs, crc_table,
                            header_size, sidecar, sidecar_chunk_bytes, rank,
-                           meta_crc, payload_start):
+                           meta_crc, payload_start, sidecar_extra=None):
     """The committing rank's half of the two-phase save: verify the
     replicated metadata block (against ``meta_crc``, recomputed
     locally) and every payload slice of the temp file against its
@@ -701,6 +724,8 @@ def _commit_process_slices(grid, filename, tmp, runs, crc_table,
                "file_bytes": file_bytes, "payload_start": payload_start,
                "header_size": header_size, "crc32": chunk_crcs,
                "slices": slices}
+        if sidecar_extra:
+            rec.update(sidecar_extra)
     # drop any previous sidecar BEFORE the rename (same reasoning as
     # resilience.save_checkpoint: never a new file under a stale
     # record), keeping its bytes to restore if the rename itself fails
@@ -820,6 +845,9 @@ def _scatter_payloads(grid, raw, cells, offsets, fixed_spec, fixed_bytes,
 
     for name in grid.fields:
         grid.data[name] = put_sharded(hosts[name], grid._sharding())
+    # a wholesale load resets the delta-checkpoint baseline: every
+    # field's saved bytes may now differ from the previous chain's
+    grid._mark_ckpt_dirty()
 
 
 def load_grid_data(grid, filename: str, header_size: int = 0,

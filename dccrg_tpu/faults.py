@@ -24,10 +24,18 @@ active plan through the module hooks:
 
 - :func:`fire` — raise a scheduled exception at a named site
   (``checkpoint.write`` transient I/O errors, ``checkpoint.chunk``
-  mid-stream write failures, ``checkpoint.mp`` two-phase multi-process
-  save phases incl. :meth:`~FaultPlan.rank_death`, ``step.dispatch``
-  simulated ``RESOURCE_EXHAUSTED``, ``device.probe`` hung-probe
-  timeouts, ``coord.barrier`` / ``coord.init`` coordination faults).
+  mid-stream write failures — delta saves stream through the same
+  site, so a torn delta write is the same rule, ``checkpoint.mp``
+  two-phase multi-process save phases incl.
+  :meth:`~FaultPlan.rank_death` — delta saves commit through the same
+  phases, ``checkpoint.gc`` retention-GC unlinks
+  (:meth:`~FaultPlan.gc_error`), ``step.dispatch`` simulated
+  ``RESOURCE_EXHAUSTED``, ``device.probe`` hung-probe timeouts,
+  ``coord.barrier`` / ``coord.init`` coordination faults).
+- :func:`take_delta_parent_corrupt` — non-raising query the delta
+  save uses to land a corrupted parent digest in a delta sidecar
+  (:meth:`~FaultPlan.delta_parent_corrupt`), so chain verification
+  and prefix-fallback resume are what get exercised.
 - :func:`take_barrier_hang` — non-raising query coord.barrier uses to
   turn a scheduled :meth:`~FaultPlan.barrier_hang` into a simulated
   lost-rank hang inside its watchdog thread.
@@ -274,6 +282,24 @@ class FaultPlan:
         rollback."""
         return self._add("supervise.dispatch", "dispatch", times, step=step)
 
+    def delta_parent_corrupt(self, times=1):
+        """Corrupt the parent content digest an incremental (delta)
+        checkpoint records in its sidecar — the parent-link corruption
+        class. Queried — not raised — by
+        :func:`dccrg_tpu.resilience.save_delta_checkpoint` via
+        :func:`take_delta_parent_corrupt`: the save completes with a
+        wrong link, chain verification must then name the broken link
+        and ``resume_latest`` must fall back to the last verifying
+        prefix."""
+        return self._add("checkpoint.delta", "parent_corrupt", times)
+
+    def gc_error(self, times=1):
+        """I/O error mid retention-GC prune (``checkpoint.gc``, fired
+        before an unlink). The chain-aware deletion order — deltas
+        newest-first, keyframe last — must leave NO orphaned delta
+        behind, whichever unlink the fault lands on."""
+        return self._add("checkpoint.gc", "io", times)
+
     def rank_death(self, site="checkpoint.mp", phase=None, rank=None,
                    times=1):
         """This rank dies at an instrumented multi-process point
@@ -380,6 +406,21 @@ def take_barrier_hang(tag: str):
     plan.log.append(("coord.barrier_hang", "hang", {"tag": tag}))
     hang = rule.params.get("hang_s")
     return math.inf if hang is None else float(hang)
+
+
+def take_delta_parent_corrupt() -> bool:
+    """Consume a scheduled :meth:`~FaultPlan.delta_parent_corrupt`;
+    True when one fired. Queried — not raised — by the delta save so
+    the corrupted link LANDS in the sidecar and the chain-verification
+    machinery is what gets exercised."""
+    plan = _active
+    if plan is None:
+        return False
+    rule = plan._take("checkpoint.delta", {})
+    if rule is None:
+        return False
+    plan.log.append(("checkpoint.delta", "parent_corrupt", {}))
+    return True
 
 
 def take_preempt(step: int) -> bool:

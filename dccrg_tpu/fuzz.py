@@ -113,7 +113,11 @@ class GridFuzzer:
         self.mesh = Mesh(np.array(devs[:min(int(n_dev), len(devs))]),
                          ("dev",))
         self.grid = (
-            Grid(cell_data={"rho": np.float32})
+            # "aux" is a static payload the ops never write: with it in
+            # the schema the dirty set {rho} is a proper subset, so the
+            # incremental-checkpoint oracle exercises REAL delta saves
+            # (a single-field grid would keyframe every time)
+            Grid(cell_data={"rho": np.float32, "aux": ((2,), np.float32)})
             .set_initial_length(length)
             .set_maximum_refinement_level(int(max_lvl))
             .set_periodic(True, True, True)
@@ -125,30 +129,42 @@ class GridFuzzer:
         cells = self.grid.get_cells()
         vals = self.rng.random(len(cells)).astype(np.float32)
         self.grid.set("rho", cells, vals)
+        self.grid.set("aux", cells,
+                      self.rng.random((len(cells), 2)).astype(np.float32))
         # the oracle: independent host mirror of every cell's value
         self.oracle = {int(c): np.float32(v) for c, v in zip(cells, vals)}
         self.log = []
         self.ops_run = 0
         self.faults_injected = 0
+        # incremental-checkpoint oracle state (lazy CheckpointStore)
+        self._store = None
+        self._store_step = 0
 
     # -- driver -------------------------------------------------------
 
     def run(self) -> "GridFuzzer":
-        self._check(0)
-        for i in range(1, self.n_ops + 1):
-            name = str(self.rng.choice(self._OPS, p=self._WEIGHTS))
-            try:
-                detail = getattr(self, "_op_" + name)()
-            except FuzzFailure:
-                raise
-            except MutationError as e:
-                raise FuzzFailure(
-                    f"unexpected mutation failure in {name}: {e}",
-                    seed=self.seed, op_index=i,
-                    cells=getattr(e, "cells", ()), log=self.log) from e
-            self.log.append(f"{i}:{name}" + (f"({detail})" if detail else ""))
-            self.ops_run = i
-            self._check(i)
+        import shutil
+
+        try:
+            self._check(0)
+            for i in range(1, self.n_ops + 1):
+                name = str(self.rng.choice(self._OPS, p=self._WEIGHTS))
+                try:
+                    detail = getattr(self, "_op_" + name)()
+                except FuzzFailure:
+                    raise
+                except MutationError as e:
+                    raise FuzzFailure(
+                        f"unexpected mutation failure in {name}: {e}",
+                        seed=self.seed, op_index=i,
+                        cells=getattr(e, "cells", ()), log=self.log) from e
+                self.log.append(f"{i}:{name}"
+                                + (f"({detail})" if detail else ""))
+                self.ops_run = i
+                self._check(i)
+        finally:
+            if self._store is not None:
+                shutil.rmtree(self._store.dir, ignore_errors=True)
         return self
 
     def _check(self, i):
@@ -325,9 +341,20 @@ class GridFuzzer:
         return ""
 
     def _op_checkpoint(self):
-        """Save/load round trip into the live grid; bytes must be
-        stable across an immediate re-save."""
+        """Save/load round trip into the live grid — bytes must be
+        stable across an immediate re-save — plus the incremental-save
+        oracle: a dirty-field delta chain materialized back must be
+        BITWISE identical to a direct full save, whatever random ops
+        (host writes and steps dirty fields; mutations bump the
+        structure epoch and force keyframes) came in between."""
         g = self.grid
+        delta_detail = self._delta_oracle()
+        if self.rng.random() < 0.5:
+            # the load half of the round trip conservatively dirties
+            # every field (correct production behavior), which forces
+            # the NEXT oracle save to a keyframe — run it on half the
+            # visits so the other half leaves delta-able windows
+            return f"delta-only:{delta_detail}"
         fd, path = tempfile.mkstemp(suffix=".dc", prefix="dccrg_fuzz_")
         os.close(fd)
         try:
@@ -344,7 +371,61 @@ class GridFuzzer:
             raise FuzzFailure(
                 "checkpoint round trip is not byte-stable",
                 seed=self.seed, op_index=self.ops_run + 1, log=self.log)
-        return f"{len(first)}B"
+        return f"{len(first)}B:{delta_detail}"
+
+    def _delta_oracle(self) -> str:
+        """Two periodic CheckpointStore saves and their oracle: the
+        reconstructed chain bytes must equal a direct full save. The
+        first save lands as whatever the dirty/epoch state dictates
+        (usually a keyframe — most op windows contain a structural
+        mutation); a random rho write in between makes the second a
+        REAL delta window, so every visit pins the delta machinery."""
+        kinds = [self._one_store_save()]
+        cells = np.asarray(self.grid.get_cells())
+        k = int(self.rng.integers(1, max(2, len(cells) // 3)))
+        pick = self.rng.choice(len(cells), size=k, replace=False)
+        vals = self.rng.random(k).astype(np.float32)
+        self.grid.set("rho", cells[pick], vals)
+        for c, v in zip(cells[pick], vals):
+            self.oracle[int(c)] = np.float32(v)
+        kinds.append(self._one_store_save())
+        return "+".join(kinds)
+
+    def _one_store_save(self) -> str:
+        from . import resilience, supervise
+
+        g = self.grid
+        if self._store is None:
+            self._store = supervise.CheckpointStore(
+                tempfile.mkdtemp(prefix="dccrg_fuzz_store_"),
+                keyframe_every=4)
+        self._store_step += 1
+        path = self._store.save(g, self._store_step)
+        kind = ("delta" if path.endswith(resilience.DELTA_SUFFIX)
+                else "key")
+        fd, ref = tempfile.mkstemp(suffix=".dc", prefix="dccrg_fuzz_ref_")
+        os.close(fd)
+        out = path + ".chain.oracle"
+        try:
+            g.save_grid_data(ref)
+            src = path
+            if kind == "delta":
+                resilience.materialize_chain(path, out, g.fields)
+                src = out
+            with open(ref, "rb") as f:
+                want = f.read()
+            with open(src, "rb") as f:
+                got = f.read()
+        finally:
+            os.unlink(ref)
+            if os.path.exists(out):
+                os.unlink(out)
+        if got != want:
+            raise FuzzFailure(
+                f"incremental checkpoint ({kind}) does not reconstruct "
+                "the direct full-save bytes", seed=self.seed,
+                op_index=self.ops_run + 1, log=self.log)
+        return kind
 
     # -- structure queries vs brute-force oracle ----------------------
 

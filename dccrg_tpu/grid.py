@@ -674,6 +674,14 @@ class Grid:
         self._program_cache = {}
         self._pending = {}
         self._txn_depth = 0  # reentrancy counter (txn.grid_transaction)
+        # delta-checkpoint dirty tracking (resilience/supervise delta
+        # saves): fields whose SAVED bytes may differ from the last
+        # checkpoint baseline (None = everything — the conservative
+        # state every wholesale load or structural rebuild resets to),
+        # and the structure epoch deltas are only valid within (any
+        # cell-set or partition change bumps it and forces a keyframe)
+        self._ckpt_dirty = None
+        self._ckpt_epoch = 0
         self._debug = os.environ.get("DCCRG_DEBUG") == "1"
         # extensible iteration-cache items (dccrg.hpp:7404-7518)
         self._cell_items = {}
@@ -1294,6 +1302,19 @@ class Grid:
                              out_shardings=sh)
                 self._program_cache[key] = fn
             self.data[name] = fn()
+        self._mark_ckpt_dirty()
+
+    def _mark_ckpt_dirty(self, fields=None) -> None:
+        """Record fields whose saved bytes may have changed since the
+        last delta-checkpoint baseline (consumed by the incremental
+        save path in :mod:`dccrg_tpu.supervise` / resilience).
+        ``None`` marks everything dirty. Ghost-only writes (halo
+        exchanges) never call this: checkpoints serialize owned rows
+        only, so ghost refreshes cannot change the saved bytes."""
+        if fields is None:
+            self._ckpt_dirty = None
+        elif getattr(self, "_ckpt_dirty", None) is not None:
+            self._ckpt_dirty.update(fields)
 
     def device_row_ids(self) -> "jnp.ndarray":
         """Sharded ``[n_dev, R] int32`` array of ``cell id - 1`` per
@@ -1464,6 +1485,7 @@ class Grid:
         old device arrays are not read back at all — ghost rows read
         zero until the next halo exchange refreshes them (the pattern
         of per-epoch static-field initialization)."""
+        self._mark_ckpt_dirty(values_by_field)
         dev, rows = self._host_rows(ids)
         fresh = (not preserve_ghosts
                  and len(np.atleast_1d(np.asarray(ids))) == len(self.plan.cells))
@@ -2348,6 +2370,7 @@ class Grid:
                  *(self.data[n] for n in fields_out), *extra_args)
         for n, arr in zip(fields_out, out):
             self.data[n] = arr
+        self._mark_ckpt_dirty(fields_out)
 
 
     def _on_accelerator(self) -> bool:
@@ -2980,6 +3003,7 @@ class Grid:
         )
         for n, arr in zip(fields_out, out):
             self.data[n] = arr
+        self._mark_ckpt_dirty(fields_out)
         # DCCRG_WATCHDOG=N: self-check the stepped fields for NaN/Inf
         # every ~N steps (one device-side scalar; see resilience.py) —
         # a silent blow-up surfaces as NumericsError instead of
@@ -3530,6 +3554,14 @@ class Grid:
         pulling every field to host and re-uploading."""
         old_plan = self.plan
         old_R = old_plan.R
+        # any restructure (cell-set change OR repartition) ends the
+        # delta-checkpoint structure epoch: the offset table and the
+        # per-rank slice layout both derive from cells/owners, so the
+        # next periodic save must be a full keyframe (the AMR commit's
+        # AmrResult.changed_cells dirty seed feeds the plan rebuild;
+        # for checkpointing the whole payload is conservatively dirty)
+        self._ckpt_epoch = getattr(self, "_ckpt_epoch", 0) + 1
+        self._mark_ckpt_dirty()
         same_cells = (len(new_cells) == len(old_plan.cells)
                       and np.array_equal(new_cells, old_plan.cells))
         if not same_cells:
@@ -3675,6 +3707,7 @@ class Grid:
                 pins=self._pins or None
             )
             self._cells_epoch = getattr(self, "_cells_epoch", 0) + 1
+            self._ckpt_epoch = getattr(self, "_ckpt_epoch", 0) + 1
             self._build_plan(cells, owner)
             self._allocate_fields()
             if self._debug:

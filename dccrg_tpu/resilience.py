@@ -65,6 +65,11 @@ logger = logging.getLogger("dccrg_tpu.resilience")
 CRC_CHUNK = 1 << 20  # bytes per sidecar checksum chunk
 SIDECAR_FORMAT = "dccrg-dc-crc-v1"
 SIDECAR_SUFFIX = ".crc"
+#: Incremental (delta) checkpoints: a ``.dcd`` file is a valid ``.dc``
+#: of the dirty-field sub-schema, chained to a parent save through its
+#: sidecar's ``delta`` record (parent file + step + content digest).
+DELTA_SUFFIX = ".dcd"
+_MAX_CHAIN = 4096  # delta-chain depth bound (cycle backstop)
 
 
 class CheckpointCorruptionError(ValueError):
@@ -75,6 +80,20 @@ class CheckpointCorruptionError(ValueError):
     def __init__(self, msg, bad_chunks=()):
         super().__init__(msg)
         self.bad_chunks = list(bad_chunks)
+
+
+class DeltaChainError(CheckpointCorruptionError):
+    """A delta checkpoint's keyframe+delta chain cannot be restored end
+    to end. ``link`` names the broken file; ``chain`` lists the link
+    paths resolved so far (keyframe first, when known). The typed
+    salvage contract: :func:`dccrg_tpu.supervise.resume_latest` catches
+    this and falls back to the last verifying prefix (an older delta or
+    the keyframe) instead of failing the resume."""
+
+    def __init__(self, msg, link=None, chain=()):
+        super().__init__(msg)
+        self.link = link
+        self.chain = list(chain)
 
 
 class NumericsError(RuntimeError):
@@ -271,6 +290,22 @@ def read_sidecar(filename: str):
                         and 0 <= s[2] <= s[3] <= fb
                         for s in sl)):
             raise ValueError("implausible per-rank slice table")
+        # incremental saves extend the record with a delta subrecord
+        # (dirty-field list + parent link); reject a mangled one here
+        # so the chain walk never dereferences garbage
+        d = rec.get("delta")
+        if d is not None:
+            p = d.get("parent") if isinstance(d, dict) else None
+            if not (isinstance(d, dict)
+                    and isinstance(d.get("fields"), list)
+                    and all(isinstance(f, str) for f in d["fields"])
+                    and isinstance(d.get("step"), int)
+                    and isinstance(p, dict)
+                    and isinstance(p.get("file"), str) and p["file"]
+                    and os.path.basename(p["file"]) == p["file"]
+                    and isinstance(p.get("step"), int)
+                    and isinstance(p.get("digest"), int)):
+                raise ValueError("implausible delta record")
         return rec
     except (ValueError, KeyError, TypeError) as e:
         raise CheckpointCorruptionError(
@@ -336,15 +371,249 @@ def verify_checkpoint(filename: str, require_sidecar: bool = True) -> list:
     return _bad_chunks(filename, rec)
 
 
+# ---------------------------------------------------------------------
+# incremental (delta) checkpoints: dirty-field saves chained to a
+# keyframe through sidecar parent links
+# ---------------------------------------------------------------------
+
+def record_digest(rec) -> int:
+    """Content digest of a sidecar record — CRC32 over the per-chunk
+    CRC list + file size, chained with the parent's digest for delta
+    records. Derived (never stored), so a tampered sidecar changes the
+    digest and breaks its children's recorded parent links; together
+    with per-link byte verification this pins a chain to the exact
+    saves that produced it: a parent *replaced* by a different save
+    under the same name is detected even though its own CRCs verify."""
+    import struct
+
+    crcs = np.asarray([int(c) & 0xFFFFFFFF for c in rec["crc32"]],
+                      dtype=np.uint32)
+    d = zlib.crc32(crcs.tobytes(),
+                   zlib.crc32(struct.pack("<Q", int(rec["file_bytes"]))))
+    delta = rec.get("delta")
+    if delta:
+        d = zlib.crc32(
+            struct.pack("<I", int(delta["parent"]["digest"]) & 0xFFFFFFFF),
+            d)
+    return d & 0xFFFFFFFF
+
+
+def is_delta_checkpoint(filename: str, rec=None) -> bool:
+    """True when ``filename`` is an incremental (delta) save — by its
+    ``.dcd`` suffix or its sidecar's delta record."""
+    if filename.endswith(DELTA_SUFFIX):
+        return True
+    if rec is None:
+        try:
+            rec = read_sidecar(filename)
+        except CheckpointCorruptionError:
+            return False
+    return bool(rec and rec.get("delta"))
+
+
+def chain_links(filename: str) -> list:
+    """Resolve ``filename``'s keyframe+delta chain from sidecar parent
+    links: ``[(path, record)]`` KEYFRAME FIRST (a plain full
+    checkpoint is its own one-link chain). Structural resolution only
+    — byte verification is :func:`verify_chain`'s job — but every
+    parent's recorded content digest is checked against the child's
+    link here, so a replaced ancestor is named. Raises
+    :class:`DeltaChainError` naming the broken link on a missing
+    file/sidecar, a digest mismatch, or a cycle."""
+    links, seen = [], set()
+    cur = os.path.abspath(filename)
+    dirpath = os.path.dirname(cur)
+    expect = None  # the child's recorded parent digest
+    while True:
+        done = [p for p, _r in reversed(links)]
+        if cur in seen or len(links) >= _MAX_CHAIN:
+            raise DeltaChainError(
+                f"{filename}: delta parent links form a cycle at {cur}",
+                link=cur, chain=done)
+        seen.add(cur)
+        if not os.path.exists(cur):
+            raise DeltaChainError(
+                f"{filename}: chain link {cur} is missing (its keyframe "
+                "or an intermediate delta was deleted)", link=cur,
+                chain=done)
+        try:
+            rec = read_sidecar(cur)
+        except CheckpointCorruptionError as e:
+            raise DeltaChainError(
+                f"{filename}: chain link {cur} has an unreadable "
+                f"sidecar ({e})", link=cur, chain=done) from e
+        if rec is None:
+            raise DeltaChainError(
+                f"{filename}: chain link {cur} has no sidecar — a delta "
+                "chain cannot be interpreted without one (the "
+                "dirty-field list and parent link live there)",
+                link=cur, chain=done)
+        if expect is not None and record_digest(rec) != expect:
+            raise DeltaChainError(
+                f"{filename}: chain link {cur} does not match its "
+                f"child's recorded parent digest {expect:#010x} — the "
+                "parent was overwritten by a different save", link=cur,
+                chain=done)
+        links.append((cur, rec))
+        delta = rec.get("delta")
+        if not delta:
+            break
+        expect = int(delta["parent"]["digest"]) & 0xFFFFFFFF
+        cur = os.path.join(dirpath, delta["parent"]["file"])
+    links.reverse()
+    return links
+
+
+def verify_chain(filename: str, assume_ok=(), _memo=None) -> list:
+    """Verify every link of ``filename``'s chain — bytes against each
+    sidecar's chunk CRCs plus the parent digest links — and return the
+    link paths, keyframe first. Raises :class:`DeltaChainError` naming
+    the FIRST broken link in chain order (a broken ancestor
+    invalidates every later delta). ``assume_ok`` paths skip the byte
+    pass (the process that just saved and verified them can vouch);
+    ``_memo`` caches per-file results across calls in one sweep."""
+    links = chain_links(filename)
+    memo = _memo if _memo is not None else {}
+    vouched = {os.path.abspath(p) for p in assume_ok}
+    for path, rec in links:
+        if path in vouched:
+            continue
+        bad = memo.get(path)
+        if bad is None:
+            bad = memo[path] = _bad_chunks(path, rec)
+        if bad:
+            names = ", ".join(_chunk_name(i, _rec_ranges(rec))
+                              for i in bad)
+            raise DeltaChainError(
+                f"{filename}: chain link {path} fails verification "
+                f"({names})", link=path, chain=[p for p, _r in links])
+    return [p for p, _r in links]
+
+
+def _chain_scratch(path: str) -> str:
+    """Writable scratch path for a chain materialization: next to the
+    checkpoint when its directory is writable (same filesystem — a
+    multi-GB reconstruction never lands on a small tmpfs — and an
+    orphan is swept by ``checkpoint.stale_temp_files``), else the
+    system temp dir: a READ-ONLY checkpoint directory (archived
+    snapshot, RO-mounted shared volume) must stay resumable, exactly
+    like full ``.dc`` saves which load in place."""
+    dirpath = os.path.dirname(os.path.abspath(path))
+    if os.access(dirpath, os.W_OK):
+        return path + f".chain.{os.getpid()}"
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".chain.")
+    os.close(fd)
+    return tmp
+
+
+def _cell_data_fields(cell_data) -> dict:
+    """Normalize a user ``cell_data`` spec (or ``Grid.fields``) into
+    ``{name: (shape tuple, np.dtype)}`` — the serialization contract
+    the chain materializer computes field column offsets from."""
+    out = {}
+    for name, spec in cell_data.items():
+        if isinstance(spec, tuple):
+            shape, dtype = spec
+        else:
+            shape, dtype = (), spec
+        out[name] = (tuple(shape), np.dtype(dtype))
+    return out
+
+
+def materialize_chain(filename: str, out_path: str, cell_data,
+                      variable=None, verify: bool = True,
+                      _memo=None) -> list:
+    """Reconstruct the full checkpoint bytes of delta ``filename`` into
+    ``out_path``: copy the keyframe, then overlay each delta's
+    dirty-field columns in chain order (each cell's fixed-field block
+    lives at its offset-table position, so the overlay is a strided
+    byte scatter — vectorized, chunked, never the whole payload in
+    RAM). The result is bitwise identical to the full save an
+    uninterrupted run would have written at the delta's step (pinned by
+    the chain tests and the fuzz oracle). ``cell_data`` is the caller's
+    field schema (``Grid.fields`` works too); returns the chain's link
+    paths. On multi-process meshes every rank reconstructs its own
+    scratch copy (``out_path`` must be per-process, e.g. pid-suffixed)
+    and the collective load barrier downstream keeps them aligned."""
+    import shutil
+
+    links = chain_links(filename)
+    if verify:
+        verify_chain(filename, _memo=_memo)
+    key_path, key_rec = links[0]
+    fields = _cell_data_fields(cell_data)
+    fixed_spec, fixed_bytes, _var = checkpoint_mod._payload_spec_of(
+        fields, variable)
+    col_of = {}
+    col = 0
+    for name, _shape, _dtype, nbytes in fixed_spec:
+        col_of[name] = col
+        col += nbytes
+
+    shutil.copyfile(key_path, out_path)
+    header_size = int(key_rec.get("header_size", 0))
+    raw_out = np.memmap(out_path, dtype=np.uint8, mode="r+")
+    try:
+        meta = checkpoint_mod.parse_metadata(raw_out, header_size)
+        cells_full, offs_full = meta[4], meta[5].astype(np.int64)
+        for dpath, drec in links[1:]:
+            dnames = list(drec["delta"]["fields"])
+            if not dnames:
+                continue
+            raw_d = np.memmap(dpath, dtype=np.uint8, mode="r")
+            dmeta = checkpoint_mod.parse_metadata(
+                raw_d, int(drec.get("header_size", 0)))
+            dcells, doffs = dmeta[4], dmeta[5].astype(np.int64)
+            if not np.array_equal(dcells, cells_full):
+                raise DeltaChainError(
+                    f"{filename}: delta {dpath} records a different "
+                    "cell list than its keyframe (a structural change "
+                    "without a keyframe — the chain is inconsistent)",
+                    link=dpath, chain=[p for p, _r in links])
+            try:
+                dspec, _db, _dv = checkpoint_mod._payload_spec_of(
+                    {n: fields[n] for n in dnames}, None)
+            except KeyError as e:
+                raise DeltaChainError(
+                    f"{filename}: delta {dpath} stores field {e} not in "
+                    "the caller's schema", link=dpath,
+                    chain=[p for p, _r in links]) from e
+            src_col = 0
+            for name, _shape, _dtype, nbytes in dspec:
+                dst = offs_full + col_of[name]
+                src = doffs + src_col
+                span = np.arange(nbytes, dtype=np.int64)[None, :]
+                blk = max(1, (8 << 20) // max(nbytes, 1))
+                for s in range(0, len(cells_full), blk):
+                    e = min(s + blk, len(cells_full))
+                    raw_out[dst[s:e, None] + span] = \
+                        raw_d[src[s:e, None] + span]
+                src_col += nbytes
+            del raw_d
+        raw_out.flush()
+    finally:
+        del raw_out
+    return [p for p, _r in links]
+
+
 def save_checkpoint(grid, filename: str, header: bytes = b"",
                     variable=None, sidecar: bool = True, retries: int = 2,
-                    backoff: float = 0.1, chunk_bytes: int = CRC_CHUNK) -> str:
+                    backoff: float = 0.1, chunk_bytes: int = CRC_CHUNK,
+                    *, fields=None, sidecar_extra=None) -> str:
     """Atomic checkpoint save: the pinned ``.dc`` bytes stream into a
     temp file in the target directory, fsync, then one rename — a crash
     at any point leaves either the old or the new checkpoint complete,
     never a torn file under the final name. Transient I/O errors retry
     with exponential backoff. With ``sidecar`` (default) the per-chunk
-    CRC32 sidecar is written after the rename."""
+    CRC32 sidecar is written after the rename.
+
+    ``fields`` restricts the save to a field subset and
+    ``sidecar_extra`` merges extra keys (the delta parent link) into
+    the sidecar record — the incremental-save plumbing; use
+    :func:`save_delta_checkpoint` rather than passing them directly."""
     if grid._multiproc:
         # multi-process meshes take the TWO-PHASE-COMMIT save
         # (checkpoint._save_process_slice): every rank streams its
@@ -359,7 +628,8 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
         faults.fire("checkpoint.write", path=filename, attempt=0)
         checkpoint_mod.save_grid_data(
             grid, filename, header=header, variable=variable,
-            sidecar=sidecar, sidecar_chunk_bytes=chunk_bytes)
+            sidecar=sidecar, sidecar_chunk_bytes=chunk_bytes,
+            fields=fields, sidecar_extra=sidecar_extra)
         faults.corrupt_file(filename)
         return filename
 
@@ -369,7 +639,7 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
     for attempt in range(retries + 1):
         try:
             checkpoint_mod.save_grid_data(grid, tmp, header=header,
-                                          variable=variable)
+                                          variable=variable, fields=fields)
             faults.fire("checkpoint.write", path=filename, attempt=attempt)
             with open(tmp, "rb+") as f:
                 f.flush()
@@ -379,6 +649,8 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
                 # the file the rename publishes
                 rec = _sidecar_record(tmp, header_size=len(header),
                                       chunk_bytes=chunk_bytes)
+                if sidecar_extra:
+                    rec.update(sidecar_extra)
             # drop any previous sidecar BEFORE the rename: a crash in
             # this window leaves the new file with no sidecar — which
             # strict load refuses conservatively — never a new file
@@ -415,6 +687,48 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
     # the good bytes — exactly the at-rest corruption CRCs exist for
     faults.corrupt_file(filename)
     return filename
+
+
+def save_delta_checkpoint(grid, filename: str, *, parent_path: str,
+                          parent_step: int, step: int, fields,
+                          header: bytes = b"", variable=None,
+                          retries: int = 2, backoff: float = 0.1,
+                          chunk_bytes: int = CRC_CHUNK) -> str:
+    """Incremental checkpoint: save only ``fields`` (the dirty set
+    since ``parent_path``) as a ``.dcd`` file — a valid ``.dc`` of the
+    sub-schema, same atomic temp+fsync+rename (or two-phase
+    multi-process commit) discipline as a full save — whose sidecar
+    records the parent link ``{file, step, digest}``. The chain is only
+    valid within one structure epoch and with fixed-size fields (the
+    caller — :meth:`dccrg_tpu.supervise.CheckpointStore.save` — forces
+    a keyframe otherwise). Restore via the chain-aware
+    :func:`load_checkpoint` / ``resume_latest``; the reconstruction is
+    bitwise identical to an uninterrupted full save."""
+    fields = sorted(fields)
+    var = variable or {}
+    ragged = set(var) | set(var.values())
+    if ragged & set(fields):
+        raise ValueError(
+            f"delta fields {sorted(ragged & set(fields))} are ragged "
+            "(or ragged counts): their per-cell byte sizes move the "
+            "offset table — only a full keyframe may capture that")
+    parent_rec = read_sidecar(parent_path)
+    if parent_rec is None:
+        raise CheckpointCorruptionError(
+            f"{parent_path}: delta parent has no sidecar; save a "
+            "keyframe instead")
+    digest = record_digest(parent_rec)
+    if faults.take_delta_parent_corrupt():
+        digest ^= 0x5A5A5A5A  # injected parent-link corruption
+    extra = {"delta": {
+        "fields": fields, "step": int(step),
+        "parent": {"file": os.path.basename(parent_path),
+                   "step": int(parent_step),
+                   "digest": int(digest)}}}
+    return save_checkpoint(grid, filename, header=header,
+                           variable=variable, retries=retries,
+                           backoff=backoff, chunk_bytes=chunk_bytes,
+                           fields=fields, sidecar_extra=extra)
 
 
 def _restore_sidecar(side: str, old_side) -> None:
@@ -462,6 +776,9 @@ class SalvageReport:
     sidecar_missing: bool = False
     bad_slices: list = dataclass_field(default_factory=list)
     dead_ranks: list = dataclass_field(default_factory=list)
+    # the keyframe+delta link paths a chain-aware load replayed
+    # (keyframe first; empty for plain full checkpoints)
+    chain: list = dataclass_field(default_factory=list)
 
     @property
     def clean(self) -> bool:
@@ -481,8 +798,38 @@ def load_checkpoint(filename: str, cell_data, mesh=None,
     default (zero) values — variable-size fields read a zero count —
     and are listed in ``report.corrupt_cells``. Corruption inside the
     metadata block (mapping/geometry/offset table) is never salvageable
-    and raises in both modes."""
+    and raises in both modes.
+
+    An incremental (delta) checkpoint loads CHAIN-AWARE: the whole
+    keyframe+delta chain is verified, materialized into a scratch file
+    (``<file>.chain.<pid>`` next to it, or in the system temp dir
+    when the checkpoint directory is read-only; removed afterwards)
+    and loaded — bitwise
+    identical to the full save an uninterrupted run would have
+    written. A broken chain raises :class:`DeltaChainError` naming the
+    broken link in BOTH modes (zero-salvage cannot repair a missing
+    ancestor); the fallback to the last verifying prefix is
+    ``resume_latest``'s job, which walks to older entries."""
     rec = read_sidecar(filename)
+    if is_delta_checkpoint(filename, rec):
+        if rec is None:
+            raise DeltaChainError(
+                f"{filename}: a delta checkpoint without its sidecar "
+                "cannot be interpreted (the dirty-field list and parent "
+                "link live there); resume from an older link instead",
+                link=filename)
+        tmp = _chain_scratch(filename)
+        try:
+            chain = materialize_chain(filename, tmp, cell_data,
+                                      variable=variable)
+            grid, header = checkpoint_mod.load_grid(
+                tmp, cell_data, mesh=mesh, header_size=header_size,
+                variable=variable,
+                load_balancing_method=load_balancing_method)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return grid, header, SalvageReport(chain=chain)
     if rec is None:
         if strict:
             raise CheckpointCorruptionError(
@@ -845,22 +1192,47 @@ class ResilientRunner:
 
     # -- checkpoint plumbing ------------------------------------------
 
-    def _save(self) -> None:
+    def _write_checkpoint(self) -> str:
+        """Write the periodic checkpoint; returns the path written.
+        The supervision layer's store-backed runner overrides this to
+        route through :meth:`dccrg_tpu.supervise.CheckpointStore.save`
+        (numbered files, dirty-field delta saves)."""
         save_checkpoint(self.grid, self.checkpoint_path,
                         header=self.header, variable=self.variable)
+        return self.checkpoint_path
+
+    def _save(self) -> None:
+        self.checkpoint_path = self._write_checkpoint()
         self._ckpt_step = self.step
         self._last_save_t = time.monotonic()
         self.checkpoints += 1
 
     def _rollback(self) -> None:
-        bad = verify_checkpoint(self.checkpoint_path)
-        if bad:
-            raise CheckpointCorruptionError(
-                f"rollback target {self.checkpoint_path} is itself "
-                f"corrupt (chunks {bad})", bad_chunks=bad)
-        checkpoint_mod.load_grid_data(
-            self.grid, self.checkpoint_path, header_size=len(self.header),
-            variable=self.variable)
+        path = self.checkpoint_path
+        if is_delta_checkpoint(path):
+            # chain-aware rollback: verify + materialize the
+            # keyframe+delta chain, then load the reconstructed full
+            # bytes into the live grid (a broken chain surfaces as
+            # DeltaChainError — a corrupt rollback target either way)
+            tmp = _chain_scratch(path)
+            try:
+                materialize_chain(path, tmp, self.grid.fields,
+                                  variable=self.variable)
+                checkpoint_mod.load_grid_data(
+                    self.grid, tmp, header_size=len(self.header),
+                    variable=self.variable)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        else:
+            bad = verify_checkpoint(path)
+            if bad:
+                raise CheckpointCorruptionError(
+                    f"rollback target {path} is itself "
+                    f"corrupt (chunks {bad})", bad_chunks=bad)
+            checkpoint_mod.load_grid_data(
+                self.grid, path, header_size=len(self.header),
+                variable=self.variable)
         # the load scatters LOCAL rows only; ghost copies of fields the
         # step loop treats as static (never re-exchanged) would stay
         # zero — refresh every field's ghosts so the resumed run sees
@@ -1115,21 +1487,42 @@ def _tool_main(argv) -> int:
     ap = argparse.ArgumentParser(prog="python -m dccrg_tpu.resilience",
                                  description=_tool_main.__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
-    v = sub.add_parser("verify", help="verify a checkpoint's CRC sidecar")
+    v = sub.add_parser("verify", help="verify a checkpoint's CRC "
+                                      "sidecar (a delta checkpoint "
+                                      "verifies its WHOLE chain)")
     v.add_argument("file")
+    c = sub.add_parser("chain", help="print every keyframe->delta "
+                                     "chain in a checkpoint directory "
+                                     "with per-link verification "
+                                     "status")
+    c.add_argument("dir")
+    c.add_argument("--stem", default=None,
+                   help="only checkpoints named <stem>_<step>.dc[d]")
     g = sub.add_parser("gc", help="prune a checkpoint directory by the "
                                   "keep-last-K / keep-every-N retention "
-                                  "policy (dry-run unless --apply)")
+                                  "policy — chain-aware: whole chains "
+                                  "only, never orphans a delta "
+                                  "(dry-run unless --apply)")
     g.add_argument("dir")
     g.add_argument("--keep-last", type=int, default=3)
     g.add_argument("--keep-every", type=int, default=0)
     g.add_argument("--stem", default=None,
-                   help="only checkpoints named <stem>_<step>.dc")
+                   help="only checkpoints named <stem>_<step>.dc[d]")
     g.add_argument("--apply", action="store_true",
                    help="actually delete (default: report only)")
     args = ap.parse_args(argv)
 
     if args.cmd == "verify":
+        if is_delta_checkpoint(args.file):
+            # a delta is only as good as its chain: verify every link
+            try:
+                links = verify_chain(args.file)
+            except CheckpointCorruptionError as e:
+                print(f"CORRUPT {args.file}: {e}")
+                return 1
+            print(f"OK {args.file} (chain of {len(links)}: "
+                  + " -> ".join(os.path.basename(p) for p in links) + ")")
+            return 0
         try:
             bad = verify_checkpoint(args.file)
         except CheckpointCorruptionError as e:
@@ -1145,6 +1538,22 @@ def _tool_main(argv) -> int:
         return 0
 
     from . import supervise  # lazy: resilience must import standalone
+
+    if args.cmd == "chain":
+        chains = supervise.chain_report(args.dir, stem=args.stem)
+        bad = 0
+        for stem_name, links in chains:
+            head = links[-1][0]
+            print(f"chain {stem_name} @ step {head} "
+                  f"({len(links)} link(s)):")
+            for step, path, kind, status in links:
+                if status != "OK":
+                    bad += 1
+                print(f"  {kind:<8} step {step:>8}  {status:<12} "
+                      f"{os.path.basename(path)}")
+        if not chains:
+            print(f"no numbered checkpoints in {args.dir}")
+        return 1 if bad else 0
 
     rep = supervise.gc_checkpoints(
         args.dir, keep_last=args.keep_last, keep_every=args.keep_every,
@@ -1177,7 +1586,7 @@ def _main(argv=None) -> int:
     import argparse
 
     argv = list(sys.argv[1:] if argv is None else argv)
-    if argv and argv[0] in ("verify", "gc"):
+    if argv and argv[0] in ("verify", "gc", "chain"):
         return _tool_main(argv)
     ap = argparse.ArgumentParser(description=_main.__doc__)
     ap.add_argument("--timeout", type=float, default=90.0)

@@ -36,16 +36,25 @@ forever. Transient dispatch errors (the ``UNAVAILABLE`` /
 exponential backoff WITHOUT tripping a rollback. Unset, the step path
 is byte-for-byte today's (no thread, no extra sync).
 
-**Auto-resume + retention GC** — periodic checkpoints land in a
-:class:`CheckpointStore` as one numbered file per step
-(``ckpt_00000042.dc``). :func:`resume_latest` scans such a directory
-and picks the newest checkpoint that passes the CRC sidecar
-verification, falling back to older ones and — last — to a salvage
-load of the newest salvageable file. :func:`gc_checkpoints` applies a
-keep-last-K (``DCCRG_KEEP_LAST``) / keep-every-N retention policy
-after each save; it can NEVER delete the only checkpoint that passes
-verification (and refuses to prune at all when nothing verifies), and
-it sweeps stale save/salvage temp files of dead runs
+**Incremental checkpoints + auto-resume + retention GC** — periodic
+checkpoints land in a :class:`CheckpointStore` as one numbered file
+per step: full keyframes (``ckpt_00000042.dc``) and dirty-field
+DELTAS (``.dcd``) that save only the fields whose bytes changed since
+the previous save, chained through sidecar parent links
+(:meth:`CheckpointStore.save`; ``DCCRG_KEYFRAME_EVERY`` keyframe
+cadence, ``DCCRG_DELTA=0`` opt-out; structural mutations force a
+keyframe). :func:`resume_latest` scans such a directory and picks the
+newest entry that passes verification — CHAIN-AWARE for deltas: the
+whole keyframe+delta chain is verified and replayed, bitwise
+identical to an uninterrupted run, with typed
+:class:`~dccrg_tpu.resilience.DeltaChainError` fallback to the last
+verifying prefix — falling back to older entries and — last — to a
+salvage load of the newest salvageable file. :func:`gc_checkpoints`
+applies a keep-last-K (``DCCRG_KEEP_LAST``) / keep-every-N retention
+policy after each save, chain-aware: whole chains only, it can NEVER
+orphan a delta nor delete the only verifying chain (and refuses to
+prune at all when nothing verifies), and it sweeps stale
+save/salvage/chain-scratch temp files of dead runs
 (:func:`dccrg_tpu.checkpoint.stale_temp_files`).
 
 Every path is pinned deterministically by fault injection
@@ -163,6 +172,29 @@ def keep_last_default(default: int = 3) -> int:
     retention GC keeps (minimum 1)."""
     try:
         return max(1, int(os.environ.get("DCCRG_KEEP_LAST", "")
+                          or default))
+    except ValueError:
+        return default
+
+
+def delta_enabled(default: bool = True) -> bool:
+    """The ``DCCRG_DELTA`` env knob: ``0`` opts out of incremental
+    (dirty-field delta) periodic saves — every save is then a full
+    keyframe, byte-for-byte the pre-delta behavior."""
+    v = os.environ.get("DCCRG_DELTA", "")
+    if v == "":
+        return default
+    return v != "0"
+
+
+def keyframe_every_default(default: int = 8) -> int:
+    """The ``DCCRG_KEYFRAME_EVERY`` env knob: every K-th periodic save
+    is a full keyframe, so a delta chain holds at most K-1 deltas
+    (minimum 1 = every save a keyframe). Long chains save bytes but
+    lengthen resume (each link replays) and widen the blast radius of
+    a lost link — the retention GC never splits a chain either way."""
+    try:
+        return max(1, int(os.environ.get("DCCRG_KEYFRAME_EVERY", "")
                           or default))
     except ValueError:
         return default
@@ -363,12 +395,13 @@ def _is_transient_dispatch(e: BaseException) -> bool:
 # the numbered checkpoint store + retention GC + auto-resume
 # ---------------------------------------------------------------------
 
-_CKPT_RE = re.compile(r"^(?P<stem>.+)_(?P<step>\d{1,12})\.dc$")
+_CKPT_RE = re.compile(r"^(?P<stem>.+)_(?P<step>\d{1,12})\.(?P<ext>dcd?)$")
 
 
 def _scan_checkpoints(dirpath: str) -> list:
-    """``[(stem, step, path)]`` of every numbered checkpoint in
-    ``dirpath``, in name order."""
+    """``[(stem, step, path)]`` of every numbered checkpoint —
+    keyframe (``.dc``) or delta (``.dcd``) — in ``dirpath``, in name
+    order."""
     out = []
     try:
         names = os.listdir(dirpath)
@@ -384,10 +417,14 @@ def _scan_checkpoints(dirpath: str) -> list:
 
 def list_checkpoints(dirpath: str, stem: str | None = None) -> list:
     """``[(step, path)]`` of the numbered checkpoints in ``dirpath``
-    (``<stem>_<step>.dc``), newest step first. ``stem=None`` matches
+    (``<stem>_<step>.dc`` keyframes and ``<stem>_<step>.dcd`` deltas),
+    newest step first; a keyframe outranks a same-step delta (an
+    emergency save landing on a delta's step). ``stem=None`` matches
     any stem."""
     out = [(s, p) for st, s, p in _scan_checkpoints(dirpath)
            if stem is None or st == stem]
+    # ".dc" sorts before ".dcd" (prefix), so path order breaks the tie
+    # toward the keyframe
     out.sort(key=lambda e: (-e[0], e[1]))
     return out
 
@@ -430,27 +467,124 @@ def _unlink(path: str) -> None:
         pass
 
 
+def _chain_index(files) -> dict:
+    """Chain structure of one stem's ``[(step, path)]`` (sorted): maps
+    each chain's root path -> sorted member ``(step, path)`` list. A
+    keyframe roots its own chain; each delta attaches to its sidecar's
+    recorded parent file. A delta whose parent cannot be resolved
+    (missing file, unreadable sidecar, self/cyclic link) roots an
+    already-orphaned chain of its own — it can never verify, so the
+    retention guards treat it like any other dead chain."""
+    by_name = {os.path.basename(p): p for _s, p in files}
+    parent: dict = {}
+    for _s, p in files:
+        if not p.endswith(resilience.DELTA_SUFFIX):
+            continue
+        pf = None
+        try:
+            rec = resilience.read_sidecar(p)
+            d = rec.get("delta") if rec else None
+            pf = d["parent"]["file"] if d else None
+        except resilience.CheckpointCorruptionError:
+            pf = None
+        target = by_name.get(pf) if pf else None
+        if target is not None and target != p:
+            parent[p] = target
+    root_of: dict = {}
+    for _s, p in files:
+        trail, seen, q = [], set(), p
+        while q in parent and q not in root_of and q not in seen:
+            seen.add(q)
+            trail.append(q)
+            q = parent[q]
+        r = root_of.get(q, q)  # a cycle roots at its entry point
+        for t in trail:
+            root_of[t] = r
+        root_of.setdefault(p, r)
+    chains: dict = {}
+    for s, p in files:
+        chains.setdefault(root_of[p], []).append((s, p))
+    for r in chains:
+        chains[r].sort()
+    return chains
+
+
+def chain_report(dirpath: str, stem: str | None = None) -> list:
+    """Every keyframe->delta chain in ``dirpath`` with per-link
+    verification status: ``[(stem, [(step, path, kind, status)])]``,
+    newest chain first per stem, links oldest-first. ``status`` is
+    ``OK`` (the link's whole sub-chain verifies), ``CORRUPT`` (this
+    link's own bytes/sidecar fail) or ``BROKEN(<link>)`` (an ancestor
+    fails, naming it). The ``python -m dccrg_tpu.resilience chain``
+    subcommand prints this."""
+    groups: dict = {}
+    for stem_name, step, path in _scan_checkpoints(dirpath):
+        if stem is not None and stem_name != stem:
+            continue
+        groups.setdefault(stem_name, []).append((step, path))
+    out = []
+    for stem_name in sorted(groups):
+        files = sorted(groups[stem_name])
+        chains = _chain_index(files)
+        memo: dict = {}
+        for root in sorted(chains, key=lambda r: -chains[r][-1][0]):
+            links = []
+            for s, p in chains[root]:
+                kind = ("delta" if p.endswith(resilience.DELTA_SUFFIX)
+                        else "keyframe")
+                try:
+                    resilience.verify_chain(p, _memo=memo)
+                    status = "OK"
+                except resilience.DeltaChainError as e:
+                    if e.link and os.path.abspath(e.link) == \
+                            os.path.abspath(p):
+                        status = "CORRUPT"
+                    else:
+                        status = ("BROKEN("
+                                  + (os.path.basename(e.link)
+                                     if e.link else "?") + ")")
+                except resilience.CheckpointCorruptionError:
+                    status = "CORRUPT"
+                links.append((s, p, kind, status))
+            out.append((stem_name, links))
+    return out
+
+
 def gc_checkpoints(dirpath: str, keep_last: int = 3, keep_every: int = 0,
                    stem: str | None = None, apply: bool = False,
                    assume_ok: int | None = None) -> GCReport:
     """Prune a checkpoint directory by the keep-last-K / keep-every-N
     retention policy (:func:`retention_plan`) — DRY-RUN unless
-    ``apply``.
+    ``apply`` — CHAIN-AWARE over keyframe+delta chains.
 
-    Two safety properties hold regardless of policy (pinned by the
-    fuzzed retention tests): the prune can NEVER remove the only
-    checkpoint that passes CRC verification (if no keeper verifies,
-    the newest verifying dropee is rescued into the keep set), and
-    when NOTHING verifies the GC refuses to prune at all — a salvage
-    load may still need any of those bytes. Checkpoint files are
-    removed before their sidecars, so a crash mid-prune can only
-    leave a harmless orphan sidecar, never an unverifiable-but-named
-    checkpoint. Stale save/salvage temp files of dead runs are swept
-    too (:func:`dccrg_tpu.checkpoint.stale_temp_files`).
+    Safety properties, regardless of policy (pinned by the fuzzed
+    retention tests):
+
+    - **Never orphan a delta.** Chains are pruned WHOLE or kept whole:
+      a chain any of whose members the step policy keeps is kept
+      entirely (a kept delta needs every ancestor down to its
+      keyframe), and a dropped chain is deleted deltas-newest-first
+      with the keyframe LAST, so a crash (or injected
+      ``checkpoint.gc`` fault) mid-prune can only shorten a chain,
+      never strand a delta without its keyframe.
+    - **Never drop the only verifying chain.** A chain counts as
+      verifying when any of its links' sub-chains verifies end to end
+      (= something is strictly resumable from it). If no kept chain
+      verifies, the newest verifying dropped chain is rescued whole;
+      when NOTHING verifies the GC refuses to prune at all — a
+      salvage load may still need any of those bytes.
+
+    Checkpoint files are removed before their sidecars, so a crash
+    mid-prune can only leave a harmless orphan sidecar. Stale
+    save/salvage/chain-scratch temp files of dead runs are swept too
+    (:func:`dccrg_tpu.checkpoint.stale_temp_files`).
 
     ``assume_ok`` lets the process that JUST saved (and sidecar-
-    verified) a step vouch for it, skipping a redundant re-read of a
-    potentially multi-GB file on the per-save GC path.
+    verified) a step vouch for that step's file AND, when that step
+    heads a kept chain, for the chain it extended (the same process
+    wrote and verified every link), so the per-save GC path stays
+    zero-read in the common case; chains the vouching process did not
+    just extend verify normally.
 
     With ``stem=None`` each stem in the directory is an INDEPENDENT
     checkpoint sequence: the retention policy and the only-verifiable
@@ -460,48 +594,78 @@ def gc_checkpoints(dirpath: str, keep_last: int = 3, keep_every: int = 0,
     for stem_name, step, path in _scan_checkpoints(dirpath):
         if stem is not None and stem_name != stem:
             continue
-        groups.setdefault(stem_name, {})[step] = path
+        groups.setdefault(stem_name, []).append((step, path))
     kept, dropped = [], []
     rescued = refused = None
     for stem_name in sorted(groups):
-        by_step = groups[stem_name]
-        keep_steps, drop_steps = retention_plan(
-            by_step, keep_last, keep_every)
-        if drop_steps:
-            def _ok(step):
-                if assume_ok is not None and step == int(assume_ok):
-                    return True
-                try:
-                    return not resilience.verify_checkpoint(
-                        by_step[step])
-                except resilience.CheckpointCorruptionError:
-                    return False
+        files = sorted(groups[stem_name])
+        chains = _chain_index(files)
+        keep_steps, _drop_steps = retention_plan(
+            {s for s, _p in files}, keep_last, keep_every)
+        keep_set = set(keep_steps)
+        heads = sorted(chains, key=lambda r: -chains[r][-1][0])
+        kept_chains = [r for r in heads
+                       if any(s in keep_set for s, _p in chains[r])]
+        drop_chains = [r for r in heads if r not in kept_chains]
+        if drop_chains:
+            memo: dict = {}
+            assume = {p for s, p in files
+                      if assume_ok is not None and s == int(assume_ok)}
 
-            if not any(_ok(s) for s in keep_steps):
-                for s in drop_steps:  # newest first
-                    if _ok(s):
-                        rescued = s
-                        drop_steps = [d for d in drop_steps if d != s]
-                        keep_steps = sorted(keep_steps + [s],
-                                            reverse=True)
+            def _chain_ok(root):
+                # the process that JUST saved (and whose earlier saves
+                # built the links the new one chains to) vouches for
+                # the chain it extended — the zero-read common path:
+                # in steady state every sweep drops an aged-out chain,
+                # and re-reading the kept chain's multi-GB keyframe
+                # each time is exactly the I/O delta saves exist to
+                # avoid. Every OTHER chain still byte-verifies.
+                if (assume_ok is not None
+                        and chains[root][-1][0] == int(assume_ok)):
+                    return True
+                # resumable = some link's whole sub-chain verifies
+                for _s, p in reversed(chains[root]):
+                    try:
+                        resilience.verify_chain(p, assume_ok=assume,
+                                                _memo=memo)
+                        return True
+                    except resilience.CheckpointCorruptionError:
+                        continue
+                return False
+
+            if not any(_chain_ok(r) for r in kept_chains):
+                for r in drop_chains:  # newest chain first
+                    if _chain_ok(r):
+                        rescued = chains[r][-1][0]
+                        drop_chains = [d for d in drop_chains if d != r]
+                        kept_chains.append(r)
                         break
                 else:
                     refused = (
-                        f"no {stem_name!r} checkpoint passes "
+                        f"no {stem_name!r} checkpoint chain passes "
                         "verification; refusing to prune that "
                         "sequence — a salvage load may still need "
                         "any of them")
-                    keep_steps = sorted(keep_steps + drop_steps,
-                                        reverse=True)
-                    drop_steps = []
-        kept.extend((s, by_step[s]) for s in keep_steps)
-        dropped.extend((s, by_step[s]) for s in drop_steps)
+                    kept_chains += drop_chains
+                    drop_chains = []
+        stem_kept = sorted((e for r in kept_chains for e in chains[r]),
+                           key=lambda e: (-e[0], e[1]))
+        kept.extend(stem_kept)
+        # whole chains only, deltas first, keyframe last — in every
+        # chain independently (report order = deletion order)
+        for r in sorted(drop_chains, key=lambda r: -chains[r][-1][0]):
+            dropped.extend(reversed(chains[r]))
     stale = checkpoint_mod.stale_temp_files(dirpath)
     if apply:
-        for _s, path in dropped:
-            _unlink(path)  # the .dc first: a crash here leaves only
+        for s, path in dropped:
+            # fault-injection site: an I/O error (or crash) here may
+            # shorten a chain but can never orphan a delta — its
+            # ancestors, the keyframe included, are deleted after it
+            faults.fire("checkpoint.gc", path=path, step=s)
+            _unlink(path)  # the checkpoint first: a crash leaves only
             _unlink(resilience.sidecar_path(path))  # an orphan sidecar
         for path in stale:
+            faults.fire("checkpoint.gc", path=path, step=None)
             _unlink(path)
     return GCReport(kept=kept, dropped=dropped, stale_temps=stale,
                     rescued=rescued, refused=refused,
@@ -510,19 +674,101 @@ def gc_checkpoints(dirpath: str, keep_last: int = 3, keep_every: int = 0,
 
 class CheckpointStore:
     """A directory of numbered checkpoints, one file per checkpointed
-    step (``<stem>_<step:08d>.dc`` + CRC sidecar): the disk layout
-    retention GC and :func:`resume_latest` operate on."""
+    step — ``<stem>_<step:08d>.dc`` keyframes and ``.dcd`` dirty-field
+    deltas, each with a CRC sidecar: the disk layout retention GC and
+    :func:`resume_latest` operate on.
 
-    def __init__(self, dirpath, stem: str = "ckpt"):
+    :meth:`save` implements the incremental-save policy: a periodic
+    save becomes a delta (only the fields whose bytes changed since
+    the last save, tracked by the grid) chained to the previous save,
+    with a full keyframe forced every ``keyframe_every`` saves
+    (``DCCRG_KEYFRAME_EVERY``), after any structural mutation or
+    shape/partition change (deltas are only valid within one structure
+    epoch), when ragged (variable-size) fields are dirty, and on
+    ``DCCRG_DELTA=0`` (opt-out: every save a keyframe)."""
+
+    def __init__(self, dirpath, stem: str = "ckpt",
+                 keyframe_every: int | None = None):
         self.dir = str(dirpath)
         self.stem = str(stem)
+        self.keyframe_every = (keyframe_every_default()
+                               if keyframe_every is None
+                               else max(1, int(keyframe_every)))
+        # the last save THIS process made: the next delta's parent
+        # (path, step, grid structure epoch, chain length so far)
+        self._parent = None
         os.makedirs(self.dir, exist_ok=True)
 
-    def path_for(self, step: int) -> str:
-        return os.path.join(self.dir, f"{self.stem}_{int(step):08d}.dc")
+    def path_for(self, step: int, delta: bool = False) -> str:
+        ext = resilience.DELTA_SUFFIX if delta else ".dc"
+        return os.path.join(self.dir, f"{self.stem}_{int(step):08d}{ext}")
+
+    def _delta_fields(self, grid, variable, force_keyframe):
+        """The dirty-field list for a delta save, or None when this
+        save must be a full keyframe. Every input is replicated state
+        (dirty set, structure epoch, save counters), so multi-process
+        ranks reach the identical decision without a collective."""
+        if force_keyframe or not delta_enabled():
+            return None
+        last = self._parent
+        if last is None:
+            return None  # nothing to chain to in this process
+        if getattr(grid, "_ckpt_epoch", 0) != last["epoch"]:
+            return None  # structural mutation / repartition: new epoch
+        if last["chain_len"] + 1 >= self.keyframe_every:
+            return None  # periodic keyframe cadence
+        dirty = getattr(grid, "_ckpt_dirty", None)
+        if dirty is None:
+            return None  # conservative: everything may have changed
+        # ragged payloads resize with their counts: a dirty variable
+        # field (or count field) moves the offset table, which only a
+        # keyframe may capture
+        var = variable or {}
+        if dirty & (set(var) | set(var.values())):
+            return None
+        if set(dirty) >= set(grid.fields):
+            return None  # a delta of everything is a keyframe + overhead
+        return sorted(dirty)
+
+    def save(self, grid, step: int, header: bytes = b"", variable=None,
+             force_keyframe: bool = False) -> str:
+        """Periodic save at ``step``: a dirty-field delta chained to
+        this process's previous save when safe (see class docstring),
+        else a full keyframe. Atomic either way (two-phase on
+        multi-process meshes); on success the grid's dirty tracking is
+        re-baselined to this save. Returns the path written."""
+        fields = self._delta_fields(grid, variable, force_keyframe)
+        if fields is not None:
+            path = self.path_for(step, delta=True)
+            try:
+                resilience.save_delta_checkpoint(
+                    grid, path, parent_path=self._parent["path"],
+                    parent_step=self._parent["step"], step=step,
+                    fields=fields, header=header, variable=variable)
+            except resilience.CheckpointCorruptionError as e:
+                # the parent's sidecar went bad under us (external
+                # damage): save a keyframe instead of failing the run
+                logger.warning(
+                    "delta save at step %d fell back to a keyframe "
+                    "(%s)", step, e)
+                fields = None
+        if fields is None:
+            path = self.path_for(step)
+            resilience.save_checkpoint(grid, path, header=header,
+                                       variable=variable)
+        self._parent = {
+            "path": path, "step": int(step),
+            "epoch": getattr(grid, "_ckpt_epoch", 0),
+            "chain_len": (0 if fields is None
+                          else self._parent["chain_len"] + 1),
+        }
+        # re-baseline the dirty tracking: subsequent changes are
+        # relative to THIS save (the next delta's parent)
+        grid._ckpt_dirty = set()
+        return path
 
     def list(self) -> list:
-        """``[(step, path)]``, newest first."""
+        """``[(step, path)]``, newest first (keyframes and deltas)."""
         return list_checkpoints(self.dir, self.stem)
 
     def gc(self, keep_last: int = 3, keep_every: int = 0,
@@ -558,8 +804,17 @@ def resume_latest(dirpath, cell_data, *, stem: str | None = None,
     :class:`ResumeInfo` (grid reconstructed from nothing but the
     file, via :func:`dccrg_tpu.resilience.load_checkpoint` /
     ``load_grid``) or None when the directory holds no usable
-    checkpoint. Resume ordering is pinned by
-    tests/test_supervise.py's planted-corruption fixtures."""
+    checkpoint.
+
+    CHAIN-AWARE: a delta entry loads by verifying and replaying its
+    whole keyframe+delta chain, bitwise identical to an uninterrupted
+    run's full save. A broken link surfaces as a typed
+    :class:`~dccrg_tpu.resilience.DeltaChainError` naming the link;
+    the walk then continues to OLDER entries — which IS the fall-back
+    to the last verifying chain prefix (the delta just before the
+    break) and ultimately the keyframe. Resume ordering is pinned by
+    tests/test_supervise.py's and the chain tests'
+    planted-corruption fixtures."""
     entries = list_checkpoints(dirpath, stem)
     skipped = []
     for step, path in entries:  # newest first: strict, CRC-verified
@@ -609,15 +864,21 @@ def resume_latest(dirpath, cell_data, *, stem: str | None = None,
 class _StoreRunner(resilience.ResilientRunner):
     """A :class:`~dccrg_tpu.resilience.ResilientRunner` whose periodic
     checkpoints land in the supervisor's :class:`CheckpointStore` as
-    numbered per-step files (rollback always targets the newest), with
+    numbered per-step files — dirty-field DELTAS chained to periodic
+    keyframes (:meth:`CheckpointStore.save`) — with rollback always
+    targeting the newest save (chain-aware when it is a delta) and
     retention GC after each save."""
 
     def __init__(self, sup, grid, step_fn, **kw):
         self._sup = sup
         super().__init__(grid, step_fn, sup.store.path_for(0), **kw)
 
+    def _write_checkpoint(self):
+        return self._sup.store.save(self.grid, self.step,
+                                    header=self.header,
+                                    variable=self.variable)
+
     def _save(self):
-        self.checkpoint_path = self._sup.store.path_for(self.step)
         super()._save()
         self._sup._after_save(self.step)
 

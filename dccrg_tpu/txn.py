@@ -95,6 +95,7 @@ _REF_ATTRS = (
     "_pending_owner",
     "_pending_changed_cells",
     "_cells_epoch",
+    "_ckpt_epoch",
     "_cut_edges",
     "_plan_gather_mode",
     "_removed_cells",
@@ -119,8 +120,11 @@ _DICT_ATTRS = (
     "_hybrid_reuse",
 )
 
-# Set attributes (the AMR request queues) cleared by the commit.
-_SET_ATTRS = ("_refines", "_unrefines", "_dont_refines", "_dont_unrefines")
+# Set attributes (the AMR request queues) cleared by the commit, plus
+# the delta-checkpoint dirty-field set (mutated via .update; its None
+# sentinel — everything dirty — passes through the isinstance guard).
+_SET_ATTRS = ("_refines", "_unrefines", "_dont_refines",
+              "_dont_unrefines", "_ckpt_dirty")
 
 
 def snapshot_state(grid) -> dict:

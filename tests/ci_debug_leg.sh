@@ -8,7 +8,11 @@
 # Also exercises one native-recommit parity test under DEBUG so the
 # post-commit verify_all runs against plans built by the native
 # in-place table writers + PlanArena (the numpy-only fallback is
-# covered by the same test when the native build is unavailable).
+# covered by the same test when the native build is unavailable),
+# and one incremental-checkpoint chain-integrity test (corrupt/
+# truncate/delete each keyframe+delta link position; typed
+# DeltaChainError fallback asserted) so the delta data plane runs
+# with continuous invariant verification on.
 #
 # Usage: tests/ci_debug_leg.sh [extra pytest args]
 set -e
@@ -18,4 +22,5 @@ env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -p no:cacheprovider "$@"
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_recommit.py::test_native_numpy_plans_bitwise_identical" \
+    "tests/test_checkpoint_integrity.py::test_chain_salvage_falls_back_to_verifying_prefix" \
     --dccrg-debug -p no:cacheprovider "$@"

@@ -3,12 +3,16 @@
 # harness (tests/mp_harness.py) — save/restore through the two-phase
 # commit with REAL barriers and the REAL cross-rank CRC all-gather,
 # _replicated_pull psum consistency, barrier-timeout, rank-kill
-# recovery, distributed trip consensus, and the SIGTERM round trip
+# recovery, distributed trip consensus, the SIGTERM round trip
 # (a REAL kill -TERM of one rank mid-run: every rank must take the
 # collective emergency checkpoint, exit with the resumable code 75,
-# and supervise.resume_latest must reconverge bitwise). Complements
-# the faked splits of tests/test_multiprocess.py (which run in
-# tier-1) with actual OS processes.
+# and supervise.resume_latest must reconverge bitwise), and the
+# incremental-checkpoint delta_rank_kill scenario (keyframe+delta
+# chains through the real two-phase commit, a REAL rank death at
+# every delta-commit phase, chain-aware resume digest-compared with
+# an uninterrupted run). Complements the faked splits of
+# tests/test_multiprocess.py (which run in tier-1) with actual OS
+# processes.
 #
 # Skips cleanly (exit 0, with a notice) where jax.distributed on CPU
 # is unavailable — the harness probes the environment first and exits

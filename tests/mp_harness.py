@@ -34,6 +34,17 @@ drives the scenarios the faked splits cannot truthfully exercise:
   timeouts) and exit with the resumable code 75; (resume)
   ``supervise.resume_latest`` picks the emergency checkpoint up, the
   run completes, and its digest must equal ref's bit-for-bit.
+- ``delta_rank_kill`` — incremental (delta) checkpoints through the
+  REAL two-phase commit, in two parts: (restore) a step loop writes a
+  keyframe + dirty-field delta chain through real barriers and the
+  real CRC all-gather, ``resume_latest`` replays the chain and the
+  resumed run's digest must equal the uninterrupted run's
+  bit-for-bit; (kill) a FaultPlan ``rank_death`` really exits one
+  rank's OS process at EACH delta-commit phase (meta/slice/written on
+  a slice writer, commit/publish on the committing rank — re-pointed
+  at the non-leader, see DELTA_KILL_PHASES) — the survivor must get
+  a typed timeout, the previous keyframe+delta chain must stay
+  bitwise intact, and ``resume_latest`` must resume from it.
 
 Runs are DETERMINISTIC: ``--seed`` drives the field values and fault
 placement the same way fuzz.py's seeds do — two runs with the same
@@ -70,10 +81,21 @@ SKIP_RC = 77
 DEATH_RC = 17
 RESUMABLE_RC = 75  # supervise.RESUMABLE_EXIT (EX_TEMPFAIL)
 SCENARIOS = ("save_restore", "psum", "barrier_timeout", "rank_kill",
-             "consensus", "preempt")
+             "consensus", "preempt", "delta_rank_kill")
 # child-side phase names of the parent-orchestrated preempt scenario
 PREEMPT_PHASES = ("preempt_ref", "preempt_kill", "preempt_resume")
 PREEMPT_STEPS = 8
+# child-side legs of the parent-orchestrated delta_rank_kill scenario
+DELTA_LEGS = ("delta_restore", "delta_kill")
+# two-phase-commit phases a rank death is injected at (checkpoint.mp
+# fault sites). The death always lands on rank 1: rank 0 is the
+# jax.distributed LEADER, and killing it takes the coordination
+# service down with it — the service then hard-kills the survivor
+# before it can recover, testing the service's liveness instead of
+# our protocol. For commit/publish the committer role is re-pointed
+# at rank 1 (the _ckpt_commits override checkpoint.py honors), so the
+# death still lands on the committing rank mid-commit.
+DELTA_KILL_PHASES = ("meta", "slice", "written", "commit", "publish")
 
 
 # =====================================================================
@@ -123,14 +145,22 @@ def _kv_allgather(key, value: str, rank: int, nprocs: int,
             for r in range(nprocs)]
 
 
-def _mk_grid(seed: int):
+def _mk_grid(seed: int, static_extra: bool = False):
     import numpy as np
 
     import jax.numpy as jnp
 
     from dccrg_tpu.grid import Grid
 
-    g = (Grid(cell_data={"v": jnp.float32})
+    # ``static_extra`` adds a wide field the step loop never writes —
+    # the production shape incremental (delta) checkpoints exist for:
+    # the dirty set {v} is then a PROPER subset of the schema, so a
+    # periodic save really lands as a .dcd (a one-field grid would
+    # keyframe every time: a delta of everything is pure overhead)
+    schema = {"v": jnp.float32}
+    if static_extra:
+        schema["aux"] = ((4,), jnp.float32)
+    g = (Grid(cell_data=schema)
          .set_initial_length((8, 8, 4))
          .set_periodic(True, True, False)
          .set_maximum_refinement_level(0)
@@ -145,6 +175,9 @@ def _mk_grid(seed: int):
     # (seed-deterministic), put_sharded serves each rank's shards
     vals = _expected(cells, seed)
     g.set("v", cells, vals)
+    if static_extra:
+        g.set("aux", cells,
+              np.tile(vals[:, None], (1, 4)).astype(np.float32) + 1.0)
     g.update_copies_of_remote_neighbors()
     return g
 
@@ -380,6 +413,145 @@ def _sup_kernel(c, nbr, offs, mask):
         jnp.where(mask, nbr["v"], 0.0), axis=1)}
 
 
+_DELTA_SCHEMA = None  # set lazily (jnp import must follow _child_setup)
+
+
+def _delta_schema():
+    global _DELTA_SCHEMA
+    if _DELTA_SCHEMA is None:
+        import jax.numpy as jnp
+
+        _DELTA_SCHEMA = {"v": jnp.float32, "aux": ((4,), jnp.float32)}
+    return _DELTA_SCHEMA
+
+
+def scenario_delta_restore(args):
+    """Incremental (delta) checkpoints through the REAL two-phase
+    commit: a step loop writes a keyframe + dirty-field delta chain
+    (real prepare/commit/done barriers, real cross-rank CRC
+    all-gather), ``resume_latest`` replays the whole chain, and the
+    resumed run's state must be bitwise identical to the live run
+    that never stopped — the acceptance digest of the incremental
+    data plane."""
+    import zlib
+
+    import numpy as np
+
+    from dccrg_tpu import checkpoint as checkpoint_mod
+    from dccrg_tpu import coord, resilience, supervise
+
+    store_dir = os.path.join(args.tmp, "store")
+    os.makedirs(store_dir, exist_ok=True)
+    g = _mk_grid(args.seed, static_extra=True)
+    cells = g.plan.cells
+    store = supervise.CheckpointStore(store_dir, keyframe_every=8)
+
+    paths = [store.save(g, 0)]
+    for s in range(1, 5):
+        g.run_steps(_sup_kernel, ["v"], ["v"], 1)
+        paths.append(store.save(g, s))
+    names = [os.path.basename(p) for p in paths]
+    assert paths[0].endswith(".dc") and all(
+        p.endswith(resilience.DELTA_SUFFIX) for p in paths[1:]), names
+    rec = resilience.read_sidecar(paths[-1])
+    assert rec["slices"], "two-phase delta must carry the slice table"
+    assert resilience.verify_chain(paths[-1])
+
+    # the uninterrupted reference IS the live grid; the resumed grid
+    # must shadow it bitwise from here on
+    info = supervise.resume_latest(store_dir, _delta_schema(),
+                                   load_balancing_method="block")
+    assert info is not None and info.step == 4 and not info.salvaged
+    assert len(info.report.chain) == 5, info.report.chain
+    g2 = info.grid
+    g2.update_copies_of_remote_neighbors()
+    for _ in range(2):
+        g.run_steps(_sup_kernel, ["v"], ["v"], 1)
+        g2.run_steps(_sup_kernel, ["v"], ["v"], 1)
+    want = checkpoint_mod._replicated_pull(g, "v", cells).tobytes()
+    got = checkpoint_mod._replicated_pull(g2, "v", cells).tobytes()
+    assert got == want, \
+        "resumed delta chain diverged from the uninterrupted run"
+    h = f"{zlib.crc32(got):08x}"
+    hashes = _kv_allgather("delta_restore_crc", h, args.rank, args.procs)
+    assert len(set(hashes)) == 1, hashes
+    print(f"[rank {args.rank}] DIGEST delta_restore {h}", flush=True)
+    coord.barrier("delta_restore_done", timeout=60)
+
+
+def scenario_delta_kill(args):
+    """One REAL rank death at the two-phase delta-commit phase named
+    by ``--phase``: the dying rank's OS process exits mid-protocol
+    (InjectedRankDeath -> hard exit in child_main). The survivor must
+    get a typed timeout within the configured bound — never a hang —
+    the previous keyframe+delta chain must stay bitwise intact on
+    disk, and ``resume_latest`` must restore the pre-kill step from
+    it."""
+    import numpy as np
+
+    from dccrg_tpu import coord, faults, resilience, supervise
+
+    assert args.phase in DELTA_KILL_PHASES, args.phase
+    # tight bound, same reasoning as scenario_rank_kill: jax's
+    # coordination service hard-kills survivors ~10s after a peer
+    # dies, so the whole recovery must finish first
+    os.environ["DCCRG_BARRIER_TIMEOUT"] = "3"
+    store_dir = os.path.join(args.tmp, f"store_{args.phase}")
+    os.makedirs(store_dir, exist_ok=True)
+    g = _mk_grid(args.seed, static_extra=True)
+    cells = g.plan.cells
+    store = supervise.CheckpointStore(store_dir, keyframe_every=8)
+
+    kf = store.save(g, 0)
+    g.run_steps(_sup_kernel, ["v"], ["v"], 1)
+    d1 = store.save(g, 1)
+    assert d1.endswith(resilience.DELTA_SUFFIX), d1
+    # per-rank expected state at step 1, LOCAL rows only: once the
+    # peer is dead, collectives are off the table (rank_kill contract)
+    mine = cells[g._proc_local_dev[g.plan.owner]]
+    want_mine = np.asarray(g.get("v", mine)).copy()
+    before = {}
+    for p in (kf, d1):
+        with open(p, "rb") as f:
+            before[p] = f.read()
+    coord.barrier("delta_chain_ready", timeout=60)
+
+    g.run_steps(_sup_kernel, ["v"], ["v"], 1)
+    dying = 1
+    if args.phase in ("commit", "publish"):
+        # the commit-side phases fire on the COMMITTING rank only;
+        # re-point that role at the dying rank (killing the leader,
+        # rank 0, would take the coordination service down — see
+        # DELTA_KILL_PHASES)
+        g._ckpt_writes_meta = args.rank == 0
+        g._ckpt_commits = args.rank == dying
+    if args.rank == dying:
+        plan = faults.FaultPlan(seed=args.seed)
+        plan.rank_death(phase=args.phase, rank=None)
+        with plan:
+            store.save(g, 2)  # raises InjectedRankDeath -> hard exit
+        raise AssertionError(
+            f"rank {args.rank} should have died at phase {args.phase}")
+    try:
+        store.save(g, 2)
+        raise AssertionError("delta save completed despite a dead rank")
+    except (coord.BarrierTimeoutError, coord.CheckpointCommitError):
+        pass
+    for p in (kf, d1):
+        with open(p, "rb") as f:
+            assert f.read() == before[p], \
+                f"phase {args.phase} tore chain link {p}"
+    assert resilience.verify_chain(d1)
+    info = supervise.resume_latest(store_dir, _delta_schema(),
+                                   load_balancing_method="block")
+    assert info is not None and not info.salvaged
+    assert info.step == 1, (args.phase, info.step)
+    g3 = info.grid
+    mine3 = g3.plan.cells[g3._proc_local_dev[g3.plan.owner]]
+    np.testing.assert_array_equal(
+        np.asarray(g3.get("v", mine3)), want_mine)
+
+
 def _make_supervised(args, store, sleep_s=0.0, grid=None, start_step=0):
     """A SupervisedRunner over the harness grid whose step_fn reports
     progress to ``<store>/progress.rank<r>`` (the parent's cue for
@@ -471,6 +643,8 @@ CHILD_SCENARIOS = {
     "preempt_ref": scenario_preempt_ref,
     "preempt_kill": scenario_preempt_kill,
     "preempt_resume": scenario_preempt_resume,
+    "delta_restore": scenario_delta_restore,
+    "delta_kill": scenario_delta_kill,
 }
 
 
@@ -629,6 +803,26 @@ def _run_preempt_kill(args, store) -> str:
     return "ok" if ok else "fail"
 
 
+def _run_delta(args) -> str:
+    """The delta_rank_kill scenario (see module docstring): the
+    restore/digest leg first, then one REAL rank death per two-phase
+    delta-commit phase — prepare-side phases kill a slice writer,
+    commit/publish kill the committing rank (re-pointed at rank 1;
+    see DELTA_KILL_PHASES on why the leader must survive)."""
+    v = _run_scenario("delta_restore", args)
+    if v != "ok":
+        return v
+    for phase in DELTA_KILL_PHASES:
+        expect = [DEATH_RC if r == 1 else 0
+                  for r in range(args.procs)]
+        v = _run_scenario("delta_kill", args, expect_rcs=expect,
+                          extra=("--phase", phase))
+        print(f"    delta_kill[{phase:<7}] {v}")
+        if v != "ok":
+            return v
+    return "ok"
+
+
 def _run_preempt(args) -> str:
     """The SIGTERM round trip (see module docstring): ref run, real
     mid-run kill of rank 1, resume — and the resumed digest must be
@@ -682,6 +876,9 @@ def parent_main(args) -> int:
         if sc == "preempt":  # parent-orchestrated three-phase round trip
             def run(_sc, args_, expect_rcs=None):  # noqa: ARG001
                 return _run_preempt(args_)
+        if sc == "delta_rank_kill":  # parent-orchestrated phase loop
+            def run(_sc, args_, expect_rcs=None):  # noqa: ARG001
+                return _run_delta(args_)
         verdict = run(sc, args, expect_rcs=expect)
         print(f"  {sc:<16} {verdict}")
         if verdict == "fail":
@@ -705,10 +902,14 @@ def main(argv=None) -> int:
     ap.add_argument("--procs", type=int, default=2)
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--scenario", default=None,
-                    choices=(None, "probe") + SCENARIOS + PREEMPT_PHASES)
+                    choices=(None, "probe") + SCENARIOS + PREEMPT_PHASES
+                            + DELTA_LEGS)
     ap.add_argument("--store", default="",
                     help="shared checkpoint-store dir of the preempt "
                          "phases (parent-provided)")
+    ap.add_argument("--phase", default="",
+                    help="two-phase-commit phase the delta_kill leg "
+                         "injects the rank death at (parent-provided)")
     ap.add_argument("--seed", type=int, default=0,
                     help="deterministic data/fault seed (fuzz.py style)")
     ap.add_argument("--tmp", default=os.path.join(

@@ -274,6 +274,53 @@ def test_failed_save_preserves_previous_checkpoint(saved, tmp_path):
                 if ".tmp." in p]
 
 
+@pytest.mark.deltackpt
+@pytest.mark.parametrize("broken", [0, 1, 2, 3])
+@pytest.mark.parametrize("damage", ["flip", "truncate", "delete"])
+def test_chain_salvage_falls_back_to_verifying_prefix(tmp_path, broken,
+                                                      damage):
+    """Incremental-checkpoint chain salvage: corrupt/truncate/delete
+    EACH link position of a keyframe+3-delta chain. load_checkpoint
+    raises the typed DeltaChainError naming the broken link, and
+    resume_latest falls back to the newest state the surviving prefix
+    can restore (an older delta, the keyframe, or — keyframe gone —
+    nothing)."""
+    from test_delta_checkpoint import SCHEMA, _plant_chain
+
+    from dccrg_tpu import resilience, supervise
+    from dccrg_tpu.resilience import DeltaChainError
+
+    g, store, paths, states = _plant_chain(tmp_path)
+    victim = paths[broken]
+    if damage == "flip":
+        faults.flip_bit(victim, os.path.getsize(victim) - 3, bit=2)
+    elif damage == "truncate":
+        faults.truncate_file(victim, os.path.getsize(victim) // 2)
+    else:
+        os.unlink(victim)
+        os.unlink(resilience.sidecar_path(victim))
+    with pytest.raises(DeltaChainError) as ei:
+        resilience.load_checkpoint(paths[-1], SCHEMA,
+                                   load_balancing_method="block")
+    assert os.path.basename(victim) in str(ei.value)
+    info = supervise.resume_latest(tmp_path, SCHEMA,
+                                   load_balancing_method="block")
+    if broken == 0 and damage != "delete":
+        # dead keyframe, salvage leg: flip/truncate damage may still
+        # salvage the keyframe's intact chunks; require a typed
+        # non-strict outcome, never a wrong strict success
+        assert info is None or info.salvaged or info.step < len(paths) - 1
+        return
+    if broken == 0:
+        assert info is None  # nothing survives a deleted keyframe
+        return
+    assert info is not None and not info.salvaged
+    assert info.step == broken - 1  # newest link BEFORE the break
+    cells = g.plan.cells
+    np.testing.assert_array_equal(
+        np.asarray(info.grid.get("rho", cells)), states[broken - 1])
+
+
 def test_corruption_injected_through_plan(saved, tmp_path):
     """The FaultPlan file-corruption path (seeded random bit flip after
     a save) is caught by verification — the end-to-end story a torn

@@ -1,0 +1,676 @@
+"""Crash-consistent incremental checkpoints: dirty-chunk delta saves
+with chain-aware resume, never-orphan retention GC, and fault
+injection at every phase (resilience.py delta machinery +
+supervise.CheckpointStore.save).
+
+Covers: bitwise keyframe+delta reconstruction, the keyframe-forcing
+rules (structural mutation / partition change / ragged fields /
+DCCRG_DELTA=0 / DCCRG_KEYFRAME_EVERY), chain-aware rollback and
+resume with typed prefix fallback, parent-link corruption, torn delta
+writes, the two-phase multi-process delta commit under rank death at
+every phase (faked splits; the REAL-process legs live in
+tests/mp_harness.py), the fuzzed never-orphan / only-verifying-chain
+retention properties, GC fault injection and GC-racing-a-save, stale
+delta temp litter, and the chain CLI."""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dccrg_tpu import checkpoint as checkpoint_mod
+from dccrg_tpu import faults, resilience, supervise
+from dccrg_tpu.grid import Grid
+from dccrg_tpu.resilience import DeltaChainError
+from dccrg_tpu.supervise import CheckpointStore, gc_checkpoints
+
+pytestmark = pytest.mark.deltackpt
+
+# a static-heavy schema: "rho" is the stepped field, "mat"/"tag" never
+# change after init — the production shape delta saves exist for
+SCHEMA = {"rho": jnp.float32, "mat": ((16,), jnp.float32),
+          "tag": jnp.int32}
+
+
+def _mk_grid(seed=0, n=(4, 4, 2), max_lvl=1, n_dev=None, schema=None):
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[: (n_dev or min(2, len(devs)))]), ("dev",))
+    g = (Grid(cell_data=schema or SCHEMA)
+         .set_initial_length(n)
+         .set_periodic(True, True, True)
+         .set_maximum_refinement_level(max_lvl)
+         .set_neighborhood_length(1)
+         .set_load_balancing_method("block")
+         .initialize(mesh))
+    rng = np.random.default_rng(seed)
+    cells = g.plan.cells
+    for name, (shape, dtype) in g.fields.items():
+        vals = (rng.random((len(cells),) + shape) * 100).astype(dtype)
+        g.set(name, cells, vals)
+    return g
+
+
+def _step(g, rng):
+    """A 'stepped field' change: rho only, like a step loop."""
+    cells = g.plan.cells
+    g.set("rho", cells, rng.random(len(cells)).astype(np.float32))
+
+
+def _full_bytes(g, tmp_path, name="__direct.dc"):
+    p = str(tmp_path / name)
+    g.save_grid_data(p)
+    with open(p, "rb") as f:
+        data = f.read()
+    os.unlink(p)
+    return data
+
+
+def _materialized_bytes(path, fields):
+    out = path + ".chain.test"
+    try:
+        resilience.materialize_chain(path, out, fields)
+        with open(out, "rb") as f:
+            return f.read()
+    finally:
+        if os.path.exists(out):
+            os.unlink(out)
+
+
+# ---------------------------------------------------------------------
+# the save policy + bitwise reconstruction
+# ---------------------------------------------------------------------
+
+def test_delta_roundtrip_bitwise_and_resume(tmp_path):
+    g = _mk_grid()
+    rng = np.random.default_rng(1)
+    store = CheckpointStore(tmp_path, keyframe_every=8)
+    assert store.save(g, 0).endswith(".dc")  # nothing to chain to yet
+    for step in (1, 2, 3):
+        _step(g, rng)
+        p = store.save(g, step)
+        assert p.endswith(".dcd"), p
+        # reconstruction == a direct full save, bit for bit
+        assert _materialized_bytes(p, g.fields) == _full_bytes(g, tmp_path)
+    info = supervise.resume_latest(tmp_path, SCHEMA,
+                                   load_balancing_method="block")
+    assert info.step == 3 and not info.salvaged
+    assert len(info.report.chain) == 4  # keyframe + 3 deltas
+    cells = g.plan.cells
+    for name in SCHEMA:
+        np.testing.assert_array_equal(info.grid.get(name, cells),
+                                      g.get(name, cells))
+
+
+def test_keyframe_cadence_and_optout(tmp_path, monkeypatch):
+    g = _mk_grid()
+    rng = np.random.default_rng(2)
+    store = CheckpointStore(tmp_path / "a", keyframe_every=3)
+    kinds = []
+    for step in range(7):
+        _step(g, rng)
+        kinds.append(store.save(g, step).endswith(".dcd"))
+    # keyframe, d, d, keyframe, d, d, keyframe
+    assert kinds == [False, True, True, False, True, True, False]
+
+    monkeypatch.setenv("DCCRG_DELTA", "0")
+    store2 = CheckpointStore(tmp_path / "b", keyframe_every=3)
+    for step in range(3):
+        _step(g, rng)
+        assert store2.save(g, step).endswith(".dc")  # opt-out: all full
+
+
+def test_structural_mutation_forces_keyframe(tmp_path):
+    g = _mk_grid()
+    rng = np.random.default_rng(3)
+    store = CheckpointStore(tmp_path, keyframe_every=50)
+    store.save(g, 0)
+    _step(g, rng)
+    assert store.save(g, 1).endswith(".dcd")
+    g.refine_completely(int(g.plan.cells[0]))
+    g.stop_refining()
+    assert store.save(g, 2).endswith(".dc")  # new structure epoch
+    _step(g, rng)
+    assert store.save(g, 3).endswith(".dcd")  # chains to the new keyframe
+    g.balance_load()  # partition change ends the epoch too
+    assert store.save(g, 4).endswith(".dc")
+
+
+def test_ragged_and_all_dirty_force_keyframe(tmp_path):
+    schema = {"rho": jnp.float32, "count": jnp.int32,
+              "pos": ((4, 3), jnp.float32)}
+    g = _mk_grid(schema=schema)
+    cells = g.plan.cells
+    g.set("count", cells, np.full(len(cells), 2, np.int32))
+    variable = {"pos": "count"}
+    store = CheckpointStore(tmp_path, keyframe_every=50)
+    store.save(g, 0, variable=variable)
+    # a dirty ragged field (or its count) moves the offset table
+    g.set("pos", cells, np.zeros((len(cells), 4, 3), np.float32))
+    assert store.save(g, 1, variable=variable).endswith(".dc")
+    g.set("rho", cells, np.ones(len(cells), np.float32))
+    assert store.save(g, 2, variable=variable).endswith(".dcd")
+    # every field dirty -> a delta would be a keyframe plus overhead
+    for name in schema:
+        vals = np.asarray(g.get(name, cells))
+        g.set(name, cells, vals)
+    assert store.save(g, 3, variable=variable).endswith(".dc")
+    # save_delta_checkpoint itself refuses ragged fields loudly
+    with pytest.raises(ValueError, match="ragged"):
+        resilience.save_delta_checkpoint(
+            g, str(tmp_path / "x.dcd"), parent_path=store.path_for(3),
+            parent_step=3, step=4, fields=["pos"], variable=variable)
+
+
+def test_delta_bytes_are_small(tmp_path):
+    """The point of the exercise: with static-heavy payloads a delta
+    save costs a small fraction of a full one (the bench pins the
+    >=10x target on a bigger grid; this is the tier-1 canary)."""
+    g = _mk_grid(n=(8, 8, 4), max_lvl=0)
+    rng = np.random.default_rng(4)
+    store = CheckpointStore(tmp_path, keyframe_every=8)
+    kf = store.save(g, 0)
+    _step(g, rng)
+    dp = store.save(g, 1)
+    assert dp.endswith(".dcd")
+    # full = 16B pairs + 4B rho + 64B mat + 4B tag per cell;
+    # delta = 16B pairs + 4B rho per cell
+    assert os.path.getsize(dp) < 0.3 * os.path.getsize(kf)
+
+
+# ---------------------------------------------------------------------
+# chain-aware rollback + typed salvage
+# ---------------------------------------------------------------------
+
+def test_runner_rolls_back_to_delta_and_reconverges(tmp_path):
+    """A NaN poison lands after a delta save: the rollback target is
+    the newest DELTA, restored chain-aware, and the recovered run
+    reconverges bitwise with an undisturbed one."""
+    def make(run_dir, plan=None):
+        g = _mk_grid(seed=7)
+
+        def step_fn(grid, i):
+            cells = grid.plan.cells
+            vals = np.asarray(grid.get("rho", cells))
+            grid.set("rho", cells, (vals * 0.5 + 1.0).astype(np.float32))
+
+        sup = supervise.SupervisedRunner(
+            g, step_fn, run_dir, check_every=1, checkpoint_every=2,
+            backoff=0.0, keep_last=16, install_signal_handlers=False)
+        if plan is None:
+            sup.run(6)
+        else:
+            with plan:
+                sup.run(6)
+        return g, sup
+
+    ref, _ = make(str(tmp_path / "ref"))
+    plan = faults.FaultPlan(seed=5)
+    plan.nan_poison("rho", step=5, times=1)
+    g, sup = make(str(tmp_path / "run"), plan)
+    assert sup.rollbacks >= 1
+    # the trip bundle records the rollback target at trip time: the
+    # newest periodic save, which was a DELTA (step 4 of cadence 2)
+    assert sup.trips[0]["checkpoint"].endswith(".dcd")
+    # after the rollback everything is conservatively dirty again, so
+    # the post-recovery save is a keyframe
+    assert sup.runner.checkpoint_path.endswith(".dc")
+    cells = g.plan.cells
+    np.testing.assert_array_equal(g.get("rho", cells),
+                                  ref.get("rho", cells))
+
+
+def _plant_chain(tmp_path, n_deltas=3, seed=11, keyframe_every=16):
+    g = _mk_grid(seed=seed)
+    rng = np.random.default_rng(seed)
+    store = CheckpointStore(tmp_path, keyframe_every=keyframe_every)
+    paths = [store.save(g, 0)]
+    states = [np.asarray(g.get("rho", g.plan.cells))]
+    for s in range(1, n_deltas + 1):
+        _step(g, rng)
+        paths.append(store.save(g, s))
+        states.append(np.asarray(g.get("rho", g.plan.cells)))
+    return g, store, paths, states
+
+
+def test_parent_link_corruption_detected(tmp_path):
+    """FaultPlan.delta_parent_corrupt lands a wrong parent digest in a
+    delta sidecar: chain verification names the link and resume falls
+    back to the parent."""
+    g = _mk_grid()
+    rng = np.random.default_rng(6)
+    store = CheckpointStore(tmp_path, keyframe_every=16)
+    store.save(g, 0)
+    _step(g, rng)
+    plan = faults.FaultPlan(seed=1)
+    plan.delta_parent_corrupt(times=1)
+    with plan:
+        p1 = store.save(g, 1)
+    assert plan.fired("checkpoint.delta") == 1
+    assert p1.endswith(".dcd")
+    with pytest.raises(DeltaChainError, match="parent digest"):
+        resilience.verify_chain(p1)
+    info = supervise.resume_latest(tmp_path, SCHEMA,
+                                   load_balancing_method="block")
+    assert info.step == 0 and not info.salvaged
+
+
+def test_parent_replaced_by_different_save_detected(tmp_path):
+    """A keyframe OVERWRITTEN by a different save (its own CRCs
+    verify!) breaks its deltas' digest links — the chain must refuse
+    to mix generations."""
+    g, store, paths, _states = _plant_chain(tmp_path, n_deltas=1)
+    g2 = _mk_grid(seed=99)  # different data, same shape
+    resilience.save_checkpoint(g2, paths[0])  # replace the keyframe
+    assert resilience.verify_checkpoint(paths[0]) == []  # self-valid
+    with pytest.raises(DeltaChainError, match="parent digest"):
+        resilience.verify_chain(paths[1])
+
+
+def test_torn_delta_write_preserves_chain(tmp_path):
+    """An I/O fault mid delta payload stream: the previous chain is
+    untouched and still resumable; no litter under the final name."""
+    g, store, paths, states = _plant_chain(tmp_path, n_deltas=1)
+    before = {p: open(p, "rb").read() for p in paths}
+    _step(g, np.random.default_rng(8))
+    plan = faults.FaultPlan()
+    plan.chunk_io_error(times=faults.EVERY)
+    with plan, pytest.raises(OSError):
+        store.save(g, 2)
+    assert not os.path.exists(store.path_for(2, delta=True))
+    for p in paths:
+        assert open(p, "rb").read() == before[p]
+    assert resilience.verify_chain(paths[-1])
+    info = supervise.resume_latest(tmp_path, SCHEMA,
+                                   load_balancing_method="block")
+    assert info.step == 1
+
+
+def test_delta_at_rest_corruption_caught_by_chain_verify(tmp_path):
+    """A seeded random bit flip after a delta save (FaultPlan's
+    at-rest corruption) fails chain verification."""
+    g, store, paths, _states = _plant_chain(tmp_path, n_deltas=1)
+    _step(g, np.random.default_rng(9))
+    plan = faults.FaultPlan(seed=3)
+    plan.bit_flip(times=1)
+    with plan:
+        p2 = store.save(g, 2)
+    assert p2.endswith(".dcd") and plan.fired("checkpoint.file") == 1
+    with pytest.raises(DeltaChainError):
+        resilience.verify_chain(p2)
+    info = supervise.resume_latest(tmp_path, SCHEMA,
+                                   load_balancing_method="block")
+    assert info.step == 1  # the prefix before the flipped delta
+
+
+# ---------------------------------------------------------------------
+# two-phase multi-process delta commit (faked splits; real-process
+# versions live in tests/mp_harness.py scenario delta_rank_kill)
+# ---------------------------------------------------------------------
+
+def _fake_split(g, local_devs, rank, writes_meta, commits):
+    g._proc_local_dev = np.array(
+        [d in set(local_devs) for d in range(g.n_dev)], dtype=bool)
+    g._ckpt_rank = rank
+    g._ckpt_writes_meta = writes_meta
+    g._ckpt_commits = commits
+
+
+def _unfake(g):
+    g._proc_local_dev = np.ones(g.n_dev, dtype=bool)
+    g._ckpt_rank = None
+    for attr in ("_ckpt_writes_meta", "_ckpt_commits"):
+        if hasattr(g, attr):
+            delattr(g, attr)
+
+
+def _two_pass_delta(g, path, parent, parent_step, step, fields):
+    half = g.n_dev // 2
+    for rank in (0, 1):
+        _fake_split(g, range(half) if rank == 0 else range(half, g.n_dev),
+                    rank, writes_meta=rank == 0, commits=rank == 1)
+        resilience.save_delta_checkpoint(
+            g, path, parent_path=parent, parent_step=parent_step,
+            step=step, fields=fields)
+    _unfake(g)
+
+
+@pytest.fixture(autouse=True)
+def _clean_mp_stage():
+    yield
+    checkpoint_mod._MP_CRC_STAGE.clear()
+
+
+def test_two_phase_delta_commit_bitwise(tmp_path):
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 faked devices")
+    g = _mk_grid(n=(8, 8, 4), max_lvl=0, n_dev=4)
+    half = g.n_dev // 2
+    kf = str(tmp_path / "mp_00000000.dc")
+    for rank in (0, 1):
+        _fake_split(g, range(half) if rank == 0 else range(half, g.n_dev),
+                    rank, writes_meta=rank == 0, commits=rank == 1)
+        resilience.save_checkpoint(g, kf)
+    _unfake(g)
+    _step(g, np.random.default_rng(10))
+    dp = str(tmp_path / "mp_00000001.dcd")
+    _two_pass_delta(g, dp, kf, 0, 1, ["rho"])
+    rec = resilience.read_sidecar(dp)
+    assert rec["slices"], "two-phase delta must carry the slice table"
+    assert _materialized_bytes(dp, g.fields) == _full_bytes(g, tmp_path)
+
+
+@pytest.mark.parametrize("phase", ["meta", "slice", "written", "commit",
+                                   "publish"])
+def test_delta_rank_death_every_phase_keeps_chain(tmp_path, phase):
+    """A rank death at EVERY two-phase delta-commit phase leaves the
+    previous keyframe+delta chain bitwise intact and resumable."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 faked devices")
+    g = _mk_grid(n=(8, 8, 4), max_lvl=0, n_dev=4)
+    half = g.n_dev // 2
+    kf = str(tmp_path / "dk_00000000.dc")
+    for rank in (0, 1):
+        _fake_split(g, range(half) if rank == 0 else range(half, g.n_dev),
+                    rank, writes_meta=rank == 0, commits=rank == 1)
+        resilience.save_checkpoint(g, kf)
+    _unfake(g)
+    rng = np.random.default_rng(12)
+    _step(g, rng)
+    d1 = str(tmp_path / "dk_00000001.dcd")
+    _two_pass_delta(g, d1, kf, 0, 1, ["rho"])
+    before = {p: open(p, "rb").read() for p in (kf, d1)}
+    _step(g, rng)
+    d2 = str(tmp_path / "dk_00000002.dcd")
+    # the dying rank: rank 0 for prepare-side phases, the committing
+    # rank (1) for commit/publish
+    dying = 1 if phase in ("commit", "publish") else 0
+    plan = faults.FaultPlan()
+    plan.rank_death(phase=phase, rank=dying)
+    with plan:
+        try:
+            _two_pass_delta(g, d2, kf, 0, 2, ["rho"])
+        except faults.InjectedRankDeath:
+            pass
+    _unfake(g)
+    for p in (kf, d1):
+        assert open(p, "rb").read() == before[p], f"{phase} tore {p}"
+    assert resilience.verify_chain(d1)
+    info = supervise.resume_latest(tmp_path, SCHEMA, stem="dk",
+                                   load_balancing_method="block")
+    if phase == "publish":
+        # death AFTER the rename, before the sidecar: the new delta
+        # exists but cannot be interpreted — the old chain answers
+        assert os.path.exists(d2)
+    assert info is not None and info.step == 1 and not info.salvaged
+
+
+# ---------------------------------------------------------------------
+# chain-aware retention GC
+# ---------------------------------------------------------------------
+
+def test_gc_keeps_whole_chain_of_kept_steps(tmp_path):
+    _g, store, paths, _states = _plant_chain(tmp_path, n_deltas=3)
+    rep = store.gc(keep_last=1, apply=True)
+    # keeping step 3 (a delta) forces its whole chain to survive
+    assert [s for s, _ in store.list()] == [3, 2, 1, 0]
+    assert not rep.dropped
+
+
+def test_gc_prunes_whole_dead_chains_keyframe_last(tmp_path):
+    g, store, paths, _states = _plant_chain(tmp_path, n_deltas=2,
+                                            keyframe_every=16)
+    # start a second chain so the first can age out
+    g.refine_completely(int(g.plan.cells[0]))
+    g.stop_refining()
+    store.save(g, 3)  # keyframe (new epoch)
+    _step(g, np.random.default_rng(13))
+    store.save(g, 4)
+    rep = store.gc(keep_last=2, apply=False)
+    # the whole old chain {0,1,2} is prunable, deltas-first order
+    assert [s for s, _ in rep.dropped] == [2, 1, 0]
+    rep = store.gc(keep_last=2, apply=True)
+    assert [s for s, _ in store.list()] == [4, 3]
+    assert resilience.verify_chain(store.path_for(4, delta=True))
+
+
+def test_gc_fault_mid_prune_never_orphans(tmp_path):
+    """An injected I/O error on ANY unlink of the prune: every
+    surviving delta still has its full ancestor chain on disk."""
+    g, store, paths, _states = _plant_chain(tmp_path, n_deltas=2,
+                                            keyframe_every=16)
+    g.refine_completely(int(g.plan.cells[0]))
+    g.stop_refining()
+    store.save(g, 3)
+    for kill_at in range(3):
+        shutil.rmtree(tmp_path)
+        g2, store2, _p, _s = _plant_chain(tmp_path, n_deltas=2,
+                                          keyframe_every=16)
+        g2.refine_completely(int(g2.plan.cells[0]))
+        g2.stop_refining()
+        store2.save(g2, 3)
+        plan = faults.FaultPlan()
+        plan.gc_error(times=1)
+        for _skip in range(kill_at):
+            plan.rules[0].fired += 1  # advance the rule to unlink k
+        plan.rules[0].times = kill_at + 1
+        with plan, pytest.raises(faults.InjectedIOError):
+            store2.gc(keep_last=1, apply=True)
+        # invariant: no delta without its ancestors
+        remaining = dict(store2.list())
+        for step, path in remaining.items():
+            if path.endswith(".dcd"):
+                resilience.chain_links(path)  # raises if orphaned
+
+
+def test_gc_never_drops_only_verifying_chain(tmp_path):
+    """Both chains policy-prunable, newest chain corrupt: the verifying
+    older chain is rescued WHOLE; nothing verifying -> refuse."""
+    g, store, _p, _s = _plant_chain(tmp_path, n_deltas=1,
+                                    keyframe_every=16)
+    g.refine_completely(int(g.plan.cells[0]))
+    g.stop_refining()
+    k2 = store.save(g, 2)
+    _step(g, np.random.default_rng(14))
+    d3 = store.save(g, 3)
+    # wreck the NEW chain's keyframe: its deltas can't restore anything
+    faults.flip_bit(k2, os.path.getsize(k2) - 5, bit=1)
+    rep = store.gc(keep_last=1, apply=True)
+    kept = [s for s, _ in store.list()]
+    assert 0 in kept and 1 in kept, (kept, rep)
+    assert rep.rescued == 1
+    # now wreck the old chain too: nothing verifies -> refuse to prune
+    faults.flip_bit(store.path_for(0),
+                    os.path.getsize(store.path_for(0)) - 5, bit=1)
+    rep = gc_checkpoints(str(tmp_path), keep_last=1, apply=True)
+    assert rep.refused and not rep.dropped
+
+
+def test_gc_property_fuzz_never_orphans_never_drops_last(tmp_path):
+    """Seeded property fuzz: random chains, random corruption, random
+    policy — (a) no surviving delta is ever orphaned, (b) if any chain
+    verified before the prune, one still does after, (c) dropped
+    chains are dropped whole."""
+    for seed in range(6):
+        rng = np.random.default_rng(seed)
+        d = tmp_path / f"s{seed}"
+        g = _mk_grid(seed=seed)
+        store = CheckpointStore(d, keyframe_every=int(rng.integers(2, 5)))
+        step = 0
+        for _ in range(int(rng.integers(4, 9))):
+            if rng.random() < 0.3:
+                if rng.random() < 0.5:
+                    g.refine_completely(int(
+                        g.plan.cells[rng.integers(len(g.plan.cells))]))
+                    g.stop_refining()
+                else:
+                    g.balance_load()
+            else:
+                _step(g, rng)
+            store.save(g, step)
+            step += 1
+        files = dict(store.list())
+        # random corruption
+        for s, p in files.items():
+            if rng.random() < 0.3:
+                faults.flip_bit(p, int(rng.integers(
+                    0, os.path.getsize(p))), int(rng.integers(0, 8)))
+
+        def survivors_ok(dirpath):
+            for _s, p in supervise.list_checkpoints(str(dirpath)):
+                if p.endswith(".dcd"):
+                    resilience.chain_links(p)  # raises if orphaned
+
+        def any_chain_verifies(dirpath):
+            for _s, p in supervise.list_checkpoints(str(dirpath)):
+                try:
+                    resilience.verify_chain(p)
+                    return True
+                except resilience.CheckpointCorruptionError:
+                    continue
+            return False
+
+        had_verifying = any_chain_verifies(d)
+        before = set(dict(store.list()).values())
+        rep = store.gc(keep_last=int(rng.integers(1, 4)),
+                       keep_every=int(rng.choice([0, 2, 3])),
+                       apply=True)
+        survivors_ok(d)                                   # (a)
+        if had_verifying:
+            assert any_chain_verifies(d), f"seed {seed}"  # (b)
+        # (c) whole chains only: a dropped file's chain-mates are
+        # all dropped or all kept — no partial chains among survivors
+        after = set(dict(store.list()).values())
+        for p in before - after:
+            for _s2, p2 in rep.kept:
+                if p2 in after and p2.endswith(".dcd"):
+                    assert p not in [q for q in
+                                     resilience.chain_links(p2)]
+
+
+def test_gc_racing_a_save_keeps_chain_resumable(tmp_path, monkeypatch):
+    """A GC sweep firing INSIDE a delta save's publish window (sidecar
+    dropped, rename pending — the worst moment) must not break the
+    chain the save is extending: the parent is policy-kept, and the
+    directory stays resumable throughout."""
+    g, store, paths, _states = _plant_chain(tmp_path, n_deltas=1)
+    _step(g, np.random.default_rng(15))
+    real_replace = os.replace
+    raced = []
+
+    def racing_replace(src, dst):
+        if dst.endswith(".dcd") and not raced:
+            raced.append(dst)
+            gc_checkpoints(str(tmp_path), keep_last=2, apply=True)
+            info = supervise.resume_latest(
+                tmp_path, SCHEMA, load_balancing_method="block")
+            assert info is not None and info.step == 1
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", racing_replace)
+    p2 = store.save(g, 2)
+    monkeypatch.undo()
+    assert raced and p2.endswith(".dcd")
+    assert resilience.verify_chain(p2)
+
+
+def test_gc_vouched_chain_skips_byte_verification(tmp_path, monkeypatch):
+    """The per-save GC path stays ZERO-READ in the common case: the
+    just-saved step vouches for the chain it extended, so dropping an
+    aged-out chain never re-reads the kept chain's keyframe bytes
+    (the multi-GB I/O delta saves exist to avoid)."""
+    g, store, _p, _s = _plant_chain(tmp_path, n_deltas=2,
+                                    keyframe_every=16)
+    g.refine_completely(int(g.plan.cells[0]))
+    g.stop_refining()
+    store.save(g, 3)  # new-epoch keyframe: a second chain
+    _step(g, np.random.default_rng(21))
+    p4 = store.save(g, 4)
+    assert p4.endswith(".dcd")
+    calls = []
+    real = resilience._bad_chunks
+    monkeypatch.setattr(
+        resilience, "_bad_chunks",
+        lambda *a, **k: (calls.append(a[0]), real(*a, **k))[1])
+    rep = gc_checkpoints(str(tmp_path), keep_last=2, apply=True,
+                         assume_ok=4)
+    assert [s for s, _ in rep.dropped] == [2, 1, 0]
+    assert not calls, f"vouched kept chain was byte-verified: {calls}"
+
+
+def test_readonly_store_still_resumes_delta(tmp_path, monkeypatch):
+    """A delta in a READ-ONLY checkpoint directory (archived snapshot,
+    RO mount) must still load: the materialization scratch falls back
+    to the system temp dir instead of failing next to the file."""
+    g, store, paths, states = _plant_chain(tmp_path, n_deltas=2)
+    ro_dir = os.path.abspath(str(tmp_path))
+    real_access = os.access
+
+    def ro_access(p, mode, **kw):
+        if mode == os.W_OK and os.path.abspath(str(p)) == ro_dir:
+            return False
+        return real_access(p, mode, **kw)
+
+    monkeypatch.setattr(os, "access", ro_access)
+    scratch = resilience._chain_scratch(paths[-1])
+    assert os.path.dirname(os.path.abspath(scratch)) != ro_dir
+    os.unlink(scratch)
+    grid, _h, rep = resilience.load_checkpoint(
+        paths[-1], SCHEMA, load_balancing_method="block")
+    monkeypatch.undo()
+    assert len(rep.chain) == 3
+    cells = g.plan.cells
+    np.testing.assert_array_equal(np.asarray(grid.get("rho", cells)),
+                                  states[-1])
+    assert not [n for n in os.listdir(tmp_path) if ".chain." in n]
+
+
+# ---------------------------------------------------------------------
+# litter, CLI
+# ---------------------------------------------------------------------
+
+def test_stale_delta_temp_suffixes_detected(tmp_path):
+    """Regression (satellite): an interrupted delta save / chain
+    reconstruction leaves only litter the sweeper recognizes."""
+    _g, store, paths, _states = _plant_chain(tmp_path, n_deltas=1)
+    dead_pid = 999999999
+    litter = [
+        store.path_for(2, delta=True) + ".mp-tmp",
+        store.path_for(2, delta=True) + f".tmp.{dead_pid}",
+        paths[-1] + f".chain.{dead_pid}",
+    ]
+    alive = paths[-1] + f".chain.{os.getpid()}"
+    for p in litter + [alive]:
+        with open(p, "wb") as f:
+            f.write(b"x")
+    found = checkpoint_mod.stale_temp_files(str(tmp_path))
+    assert sorted(found) == sorted(litter)
+    rep = store.gc(keep_last=5, apply=True)
+    assert sorted(rep.stale_temps) == sorted(litter)
+    for p in litter:
+        assert not os.path.exists(p)
+    assert os.path.exists(alive)  # its owner (us) is still running
+    os.unlink(alive)
+
+
+def test_cli_chain_and_delta_verify(tmp_path, capsys):
+    _g, store, paths, _states = _plant_chain(tmp_path, n_deltas=2)
+    assert resilience._main(["verify", paths[-1]]) == 0
+    out = capsys.readouterr().out
+    assert "chain of 3" in out
+    assert resilience._main(["chain", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "keyframe" in out and out.count("delta") >= 2
+    # break the middle link: chain prints CORRUPT + BROKEN, verify
+    # of the head fails naming the link
+    faults.flip_bit(paths[1], os.path.getsize(paths[1]) - 2, bit=0)
+    assert resilience._main(["verify", paths[-1]]) == 1
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out and os.path.basename(paths[1]) in out
+    assert resilience._main(["chain", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out and "BROKEN" in out
