@@ -13,7 +13,10 @@ throughputs (tests/geometry README, recorded in BASELINE.md):
 lookups through dccrg_tpu's vectorized geometry layer and prints one
 JSON line per metric with the speedup over the reference midpoint.
 
-  python bench/geometry_bench.py [n_lookups]
+Run:  timeout -k 10 600 python bench/geometry_bench.py [n_lookups]
+
+(No safe_devices probe: this bench is pure numpy/ctypes host code and
+never touches jax, so there is no accelerator tunnel to hang on.)
 """
 
 import json

@@ -21,7 +21,12 @@ reference dccrg library (header-only C++/MPI/Zoltan; see SURVEY.md):
 - preemption-aware run supervision (``supervise``: SIGTERM/SIGINT
   emergency checkpoints with a resumable exit code, a step-hang
   deadline watchdog, auto-resume from the newest verified checkpoint
-  and keep-last-K/keep-every-N retention GC).
+  and keep-last-K/keep-every-N retention GC),
+- a fleet serving layer (``fleet``/``scheduler``: N independent
+  same-shape scenario runs stacked along a batch axis into one
+  jitted device program, fronted by a priority job queue with
+  per-job checkpoint stems, per-slot NaN/OOM isolation and
+  preemption-requeue — ``python -m dccrg_tpu.fleet``).
 
 Reference: /root/reference (dccrg.hpp and friends). This package is a
 re-design for TPU, not a translation: structure (cell lists, neighbor
@@ -52,6 +57,8 @@ from .resilience import (CheckpointCorruptionError, DeviceProbeError,
 from .supervise import (RESUMABLE_EXIT, CheckpointStore, PreemptedError,
                         StepTimeoutError, SupervisedRunner,
                         gc_checkpoints, resume_latest)
+from .fleet import FleetJob, GridBatch
+from .scheduler import FleetPreemptedError, FleetScheduler
 
 __version__ = "0.1.0"
 
@@ -99,4 +106,8 @@ __all__ = [
     "SupervisedRunner",
     "gc_checkpoints",
     "resume_latest",
+    "FleetJob",
+    "GridBatch",
+    "FleetPreemptedError",
+    "FleetScheduler",
 ]

@@ -415,6 +415,34 @@ def stale_temp_files(dirpath: str) -> list:
             break
     return out
 
+def state_digest(grid, fields=None) -> str:
+    """Deterministic SHA-256 over the grid's OWNED cell bytes — the
+    exact payload rows a checkpoint serializes (per device, rows
+    ``[0, n_local[d])``; ghost and pad rows excluded), field-name
+    sorted with the name/shape/dtype folded in. Two grids with the
+    same structure digest equal iff every owned field byte is equal,
+    so the fleet isolation tests (and bench parity checks) compare
+    'final field bytes identical' without writing checkpoint files.
+    Process-local on multi-process meshes: each rank digests its own
+    addressable shards (compare per rank, or gather host-side)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    names = sorted(fields if fields is not None else grid.fields)
+    for name in names:
+        shape, dtype = grid.fields[name]
+        h.update(repr((name, tuple(shape), str(dtype))).encode())
+        arr = grid.data[name]
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        for s in shards:
+            d = s.index[0].start or 0
+            n_own = int(grid.plan.n_local[d])
+            h.update(np.ascontiguousarray(
+                np.asarray(s.data)[0, :n_own]).tobytes())
+    return h.hexdigest()
+
+
 # Faked-split CRC staging: {tmp_path: {dev: (rank, [crc per run])}}.
 # REAL multi-process meshes never touch this — their CRCs cross ranks
 # through the device all-gather at the commit barrier; the table only

@@ -130,7 +130,7 @@ class _Rule:
     def matches(self, site: str, ctx: dict) -> bool:
         if self.site != site or self.fired >= self.times:
             return False
-        for key in ("mode", "step", "phase", "tag", "rank"):
+        for key in ("mode", "step", "phase", "tag", "rank", "job"):
             want = self.params.get(key)
             if want is None:
                 continue
@@ -220,20 +220,27 @@ class FaultPlan:
         return self._add("checkpoint.file", "bitflip", times,
                          byte_index=byte_index, bit=bit)
 
-    def resource_exhausted(self, times=1, mode=None):
+    def resource_exhausted(self, times=1, mode=None, job=None):
         """Simulated XLA RESOURCE_EXHAUSTED at step dispatch. With
         ``mode`` the rule fires only for that gather mode (e.g. only
-        the dense path OOMs; the slot-wise fallback fits)."""
-        return self._add("step.dispatch", "oom", times, mode=mode)
+        the dense path OOMs; the slot-wise fallback fits). With
+        ``job`` the rule fires only for that fleet job's dispatch
+        (the fleet layer fires ``step.dispatch`` per admitted job, so
+        chaos tests can OOM exactly one batch slot — its neighbors'
+        bits must not move)."""
+        return self._add("step.dispatch", "oom", times, mode=mode, job=job)
 
     def nan_poison(self, fld, step, cells=None, value=float("nan"),
-                   times=1):
+                   times=1, job=None):
         """Write ``value`` into ``fld`` for ``cells`` (default: one
         seeded local cell) after step ``step`` completes. ``times > 1``
         re-poisons on every replay of that step (a deterministic
-        blow-up the rollback cannot outrun — the retry-bound test)."""
+        blow-up the rollback cannot outrun — the retry-bound test).
+        With ``job`` the poison targets ONE fleet batch slot (consumed
+        via :func:`poison_fleet` by the fleet layer; job-scoped rules
+        never fire at the plain per-grid ``poison_step`` site)."""
         return self._add("step.poison", "nan", times, field=fld, step=step,
-                         cells=cells, value=value)
+                         cells=cells, value=value, job=job)
 
     def probe_hang(self, times=1):
         """Device probe times out (dead accelerator tunnel)."""
@@ -275,12 +282,14 @@ class FaultPlan:
         return self._add("supervise.hang", "hang", times, step=step,
                          hang_s=hang_s)
 
-    def dispatch_error(self, times=1, step=None):
+    def dispatch_error(self, times=1, step=None, job=None):
         """Transient dispatch failure (:class:`InjectedDispatchError`,
         the UNAVAILABLE class) at step dispatch. The supervision layer
         must retry with bounded backoff and succeed WITHOUT tripping a
-        rollback."""
-        return self._add("supervise.dispatch", "dispatch", times, step=step)
+        rollback. With ``job`` the rule fires only for that fleet
+        job's dispatch (the fleet retries just that job's quantum)."""
+        return self._add("supervise.dispatch", "dispatch", times, step=step,
+                         job=job)
 
     def delta_parent_corrupt(self, times=1):
         """Corrupt the parent content digest an incremental (delta)
@@ -521,6 +530,37 @@ def poison_step(grid, step: int) -> list:
                           "cells": cells.tolist()}))
         applied.append((name, cells))
     return applied
+
+
+def poison_fleet(job: str, after_step: int, through_step: int) -> list:
+    """Consume scheduled NaN poisonings targeting fleet job ``job``
+    whose step falls in ``(after_step, through_step]`` — the window
+    one batched quantum advanced that job through. Returns
+    ``[(field, cells, value, step)]``; the FLEET layer writes the
+    poison into the job's batch slot itself (a slot is not a grid, so
+    :func:`poison_step` cannot). Rules with ``job=None`` keep wildcard
+    semantics and match whichever job is polled first; job-scoped
+    rules fire only for their job."""
+    plan = _active
+    out = []
+    if plan is None:
+        return out
+    for rule in plan.rules:
+        if rule.site != "step.poison" or rule.fired >= rule.times:
+            continue
+        want_job = rule.params.get("job")
+        if want_job is not None and want_job != job:
+            continue
+        step = rule.params.get("step")
+        if step is None or not after_step < step <= through_step:
+            continue
+        rule.fired += 1
+        plan.log.append(("step.poison", "nan",
+                         {"step": step, "job": job,
+                          "field": rule.params["field"]}))
+        out.append((rule.params["field"], rule.params["cells"],
+                    rule.params["value"], int(step)))
+    return out
 
 
 # -- standalone corruption helpers (also used directly by tests) ------

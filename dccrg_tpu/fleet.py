@@ -1,0 +1,586 @@
+"""Fleet execution layer: batched many-grid multiplexing.
+
+The production story for this framework is not one 512^3 grid — it is
+THOUSANDS of concurrent small/medium scenario runs per chip (the
+reference dccrg is the grid layer of simulation codes launched as
+fleets of independent runs). On an accelerator the idiomatic form is a
+**batch axis over same-shape grids**: N independent uniform grids are
+stacked along a leading batch dimension into ONE jitted device program
+(a ``jax.vmap`` of the single-grid step over the stacked field
+arrays), so N scenarios share one compile, one dispatch, and one HBM
+residency pass per step instead of N.
+
+:class:`GridBatch` is that execution layer. Jobs are **bucketed** by
+``(shape, periodicity, field schema, step kernel, #params)`` — the
+same shape-keyed discipline as the grid's compiled-program caches — so
+wildly different scenarios (different dt, seeds, step counts,
+priorities) land in shared compiles; per-job parameters (dt etc.)
+ride as batched scalars through the vmap. Batch capacities are
+rounded with :func:`~dccrg_tpu.grid.bucket_capacity` so a drained,
+backfilled bucket keeps its program.
+
+**Per-job isolation** is the contract that makes a multi-tenant batch
+safe (pinned by tests/test_fleet.py):
+
+- the numerics watchdog is evaluated **per batch slot**
+  (:meth:`GridBatch.finite_slots` — one ``[B]`` bool vector, one
+  device round-trip for the whole fleet);
+- NaN trips, injected OOMs and requeues touch ONLY the tripped slot:
+  a slot rolls back from its own per-job checkpoint
+  (:func:`dccrg_tpu.resilience.load_checkpoint_into` into the
+  bucket's scratch grid, scattered into the slot) while every other
+  slot's bits are untouched — the vmapped step has no cross-batch
+  ops, and slot updates go through per-slot selects that preserve
+  neighbor bytes exactly;
+- a job's fleet-run final state is **bitwise identical** to running
+  it alone (``Grid.run_steps``), because the batched gather delivers
+  the same neighbor bytes the grid's own stencil paths do.
+
+The job queue, admission, drain/backfill, per-job checkpoint stems,
+preemption and retention GC live in
+:class:`dccrg_tpu.scheduler.FleetScheduler`; ``python -m
+dccrg_tpu.fleet`` runs a job file through it (see
+:func:`_main`). Env knobs: ``DCCRG_FLEET_MAX_BATCH`` (slots per
+bucket, default 128), ``DCCRG_FLEET_QUANTUM`` (steps per batched
+dispatch between scheduler polls, default 8).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import checkpoint as checkpoint_mod
+from .grid import DEFAULT_NEIGHBORHOOD_ID, Grid, default_mesh
+
+logger = logging.getLogger("dccrg_tpu.fleet")
+
+
+def max_batch_default(default: int = 128) -> int:
+    """The ``DCCRG_FLEET_MAX_BATCH`` env knob: maximum batch slots per
+    bucket (one bucket = one compiled device program)."""
+    try:
+        return max(1, int(os.environ.get("DCCRG_FLEET_MAX_BATCH", "")
+                          or default))
+    except ValueError:
+        return default
+
+
+def quantum_default(default: int = 8) -> int:
+    """The ``DCCRG_FLEET_QUANTUM`` env knob: steps per batched
+    dispatch between scheduler polls. Larger quanta amortize dispatch
+    overhead; smaller quanta tighten the watchdog/checkpoint/preempt
+    poll cadence (all of which run at quantum boundaries)."""
+    try:
+        return max(1, int(os.environ.get("DCCRG_FLEET_QUANTUM", "")
+                          or default))
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------
+# the step-kernel registry (the CLI's serializable kernel names)
+# ---------------------------------------------------------------------
+
+FLEET_KERNELS: dict = {}
+
+
+def register_kernel(name: str, fn) -> None:
+    """Register a grid step kernel under a name job files can
+    reference. The kernel has the standard grid-kernel signature
+    ``kernel(cell_fields, nbr_fields, offs, mask, *params) ->
+    {field: new_values}`` with per-job ``params`` as scalars."""
+    FLEET_KERNELS[str(name)] = fn
+
+
+def _diffuse_kernel(c, nbr, offs, mask, dt):
+    """Explicit neighbor-coupling relaxation of ``rho`` (the bench/
+    fuzz workhorse): rho += dt * sum_nbr (rho_nbr - rho)."""
+    rho = c["rho"]
+    s = jnp.sum(jnp.where(mask, nbr["rho"], 0.0), axis=1)
+    deg = jnp.sum(mask, axis=1).astype(rho.dtype)
+    return {"rho": rho + dt * (s - deg * rho)}
+
+
+def _advect_x_kernel(c, nbr, offs, mask, cfl):
+    """First-order upwind advection of ``rho`` along +x, selecting the
+    upwind neighbor through the slot offsets."""
+    up = (offs[..., 0] < 0) & (offs[..., 1] == 0) & (offs[..., 2] == 0)
+    upv = jnp.sum(jnp.where(up & mask, nbr["rho"], 0.0), axis=1)
+    return {"rho": (1.0 - cfl) * c["rho"] + cfl * upv}
+
+
+register_kernel("diffuse", _diffuse_kernel)
+register_kernel("advect_x", _advect_x_kernel)
+
+
+# ---------------------------------------------------------------------
+# jobs
+# ---------------------------------------------------------------------
+
+class FleetJob:
+    """One scenario run: an independent uniform grid with its own
+    schema, kernel, parameters, step count, priority and checkpoint
+    stem. Jobs whose :meth:`bucket_key` matches share one batched
+    device program; everything else about them may differ.
+
+    ``kernel`` is a registry name (:data:`FLEET_KERNELS`) or a
+    grid-kernel callable; ``params`` are per-job float scalars passed
+    to it as batched extras. ``init`` is a ``fn(grid)`` that fills the
+    fields (default: a seeded uniform-random fill — the same bytes a
+    solo run initializes with). The ``name`` doubles as the job's
+    :class:`~dccrg_tpu.supervise.CheckpointStore` stem, so it must be
+    unique within a scheduler."""
+
+    def __init__(self, name, *, length=(16, 16, 16), kernel="diffuse",
+                 n_steps=10, cell_data=None, fields_in=("rho",),
+                 fields_out=("rho",), params=(0.1,), priority=0,
+                 periodic=(True, True, True), hood_len=1,
+                 checkpoint_every=8, max_retries=3, seed=0, init=None):
+        self.name = str(name)
+        self.length = tuple(int(v) for v in length)
+        self.kernel = kernel
+        self.n_steps = int(n_steps)
+        cell_data = cell_data if cell_data is not None else {
+            "rho": jnp.float32}
+        self.cell_data = {}
+        for fname, spec in cell_data.items():
+            if isinstance(spec, tuple):
+                shape, dtype = spec
+            else:
+                shape, dtype = (), spec
+            self.cell_data[fname] = (tuple(shape), jnp.dtype(dtype))
+        self.fields_in = tuple(fields_in)
+        self.fields_out = tuple(fields_out)
+        self.params = tuple(float(p) for p in params)
+        self.priority = int(priority)
+        self.periodic = tuple(bool(p) for p in periodic)
+        self.hood_len = int(hood_len)
+        self.checkpoint_every = int(checkpoint_every)
+        self.max_retries = int(max_retries)
+        self.seed = int(seed)
+        self.init = init
+        # scheduler-owned runtime state
+        self.steps_done = 0
+        self.retries = 0
+        self.requeues = 0
+        self.transient_retries = 0
+        self.trips = []  # [(kind, at_step)]
+        self.status = "queued"
+        self.digest = None
+        self.last_save_step = None
+        self._last_trip_step = -1
+
+    def resolved_kernel(self):
+        if callable(self.kernel):
+            return self.kernel
+        fn = FLEET_KERNELS.get(str(self.kernel))
+        if fn is None:
+            raise KeyError(
+                f"job {self.name!r}: unknown kernel {self.kernel!r} "
+                f"(registered: {sorted(FLEET_KERNELS)})")
+        return fn
+
+    def bucket_key(self):
+        """The compile-sharing key: jobs with equal keys stack into
+        one batched program. Parameters, seeds, priorities and step
+        counts are NOT part of it (they ride as batched scalars or
+        scheduler state)."""
+        schema = tuple(sorted(
+            (n, tuple(shape), str(dtype))
+            for n, (shape, dtype) in self.cell_data.items()))
+        # a registry name buckets by that name; a callable buckets by
+        # its own identity (two jobs share a program only when they
+        # share the function object)
+        return (self.length, self.periodic, self.hood_len, schema,
+                self.kernel,
+                self.fields_in, self.fields_out, len(self.params))
+
+    def apply_init(self, grid) -> None:
+        """Fill ``grid``'s fields with this job's initial state —
+        byte-identical whether the grid is a fleet scratch grid or a
+        solo run's own."""
+        if self.init is not None:
+            self.init(grid)
+        else:
+            seeded_random_init(grid, self.seed)
+        grid.update_copies_of_remote_neighbors()
+
+
+def seeded_random_init(grid, seed: int) -> None:
+    """The default job init: a seeded uniform-random fill of every
+    field (deterministic in (schema, cell count, seed))."""
+    rng = np.random.default_rng(seed)
+    cells = grid.plan.cells
+    for name in sorted(grid.fields):
+        shape, dtype = grid.fields[name]
+        vals = (rng.random((len(cells),) + shape) * 100.0).astype(dtype)
+        grid.set(name, cells, vals)
+
+
+def template_grid(job: FleetJob, device=None) -> Grid:
+    """The single-device uniform grid a job describes — the bucket's
+    template/scratch grid, and the solo baseline's grid."""
+    if device is None:
+        device = jax.devices()[0]
+    return (Grid(cell_data=dict(job.cell_data))
+            .set_initial_length(job.length)
+            .set_maximum_refinement_level(0)
+            .set_neighborhood_length(job.hood_len)
+            .set_periodic(*job.periodic)
+            .initialize(default_mesh([device])))
+
+
+def run_solo(job: FleetJob, device=None) -> str:
+    """Run ``job`` alone through the ordinary ``Grid.run_steps`` path
+    and return its final-state digest
+    (:func:`dccrg_tpu.checkpoint.state_digest`) — the one-grid-at-a-
+    time baseline every fleet-run job must match bitwise."""
+    g = template_grid(job, device)
+    job.apply_init(g)
+    extras = tuple(jnp.float32(p) for p in job.params)
+    kernel = job.resolved_kernel()
+    if job.n_steps:
+        g.run_steps(kernel, job.fields_in, job.fields_out, job.n_steps,
+                    extra_args=extras)
+    return checkpoint_mod.state_digest(g)
+
+
+# ---------------------------------------------------------------------
+# the batched execution layer
+# ---------------------------------------------------------------------
+
+# compiled fleet programs, shared across GridBatch instances (and
+# therefore across drained/recreated buckets) by (bucket key,
+# capacity). FIFO-bounded: the cache outlives batches.
+_FLEET_PROGRAMS: dict = {}
+_FLEET_PROGRAMS_MAX = 64
+
+
+class GridBatch:
+    """N independent same-shape uniform grids stacked along a leading
+    batch axis into one jitted device program.
+
+    The batch owns one **template grid** (also its checkpoint scratch
+    grid) whose plan supplies the neighbor gather tables, and per-field
+    state arrays of shape ``[capacity, R, *field_shape]``. The step
+    program is ``vmap`` of the single-grid table-gather step with
+    per-job parameters as batched scalars, run under
+    ``lax.fori_loop`` with a per-slot step **budget**: slot ``k``
+    advances ``budget[k]`` steps this dispatch and its bytes are
+    FROZEN afterwards (a per-slot select keeps the old array bits),
+    which is how jobs at different step counts, finished jobs and
+    tripped/masked slots coexist in one program."""
+
+    def __init__(self, proto: FleetJob, capacity: int, device=None):
+        self.key = proto.bucket_key()
+        self.capacity = int(capacity)
+        self.device = device
+        self.grid = template_grid(proto, device)
+        plan = self.grid.plan
+        self.L = int(plan.L)
+        self.R = int(plan.R)
+        self.n_own = int(plan.n_local[0])
+        hood = plan.hoods[DEFAULT_NEIGHBORHOOD_ID]
+        # [L, S] rows / mask and the mask-zeroed [L, S, 3] offsets —
+        # exactly the neighbor bytes the grid's own stencil paths
+        # deliver (invalid slots point at the permanent zero pad row)
+        self._rows = np.asarray(hood.nbr_rows[0])
+        self._mask = np.asarray(hood.nbr_mask[0])
+        self._offs = np.asarray(hood.nbr_offs[0])
+        self.fields_in = proto.fields_in
+        self.fields_out = proto.fields_out
+        self.kernel = proto.resolved_kernel()
+        self.n_extra = len(proto.params)
+        self.schema = dict(self.grid.fields)
+        self.slots: list = [None] * self.capacity
+        self._extras = np.zeros((self.capacity, self.n_extra),
+                                dtype=np.float32)
+        self.state = {}
+        for name, (shape, dtype) in self.schema.items():
+            z = jnp.zeros((self.capacity, self.R) + shape, dtype=dtype)
+            if device is not None:
+                z = jax.device_put(z, device)
+            self.state[name] = z
+        self.dispatches = 0
+
+    # -- program construction (shared per bucket key) -----------------
+
+    def _programs(self):
+        key = (self.key, self.capacity)
+        hit = _FLEET_PROGRAMS.get(key)
+        if hit is not None:
+            return hit
+        rows = jnp.asarray(self._rows)
+        mask = jnp.asarray(self._mask)
+        offs = jnp.asarray(self._offs)
+        L, fin, fout = self.L, self.fields_in, self.fields_out
+        kernel, n_extra = self.kernel, self.n_extra
+
+        def step_one(state, ex):
+            cell = {n: state[n][:L] for n in fin}
+            nbr = {n: state[n][rows] for n in fin}
+            extras = tuple(ex[i] for i in range(n_extra))
+            out = kernel(cell, nbr, offs, mask, *extras)
+            new = dict(state)
+            for n in fout:
+                new[n] = state[n].at[:L].set(out[n].astype(state[n].dtype))
+            return new
+
+        vstep = jax.vmap(step_one, in_axes=(0, 0))
+
+        def run_quantum(state, extras, budget, q):
+            def body(i, st):
+                new = vstep(st, extras)
+                live = i < budget  # [B]: per-slot step budget
+
+                def sel(a, b):
+                    m = live.reshape((-1,) + (1,) * (a.ndim - 1))
+                    return jnp.where(m, a, b)
+
+                # exhausted/masked slots keep their OLD array bits —
+                # the per-slot freeze the isolation contract rests on
+                return {n: sel(new[n], st[n]) for n in st}
+
+            return jax.lax.fori_loop(0, q, body, state)
+
+        watched = [n for n in sorted(self.schema)
+                   if jnp.issubdtype(self.schema[n][1], jnp.inexact)]
+        # locals only: a `self` capture would pin every batch (its
+        # [capacity, R, ...] device arrays included) in the
+        # module-global program cache for the process lifetime
+        cap = self.capacity
+
+        def finite(state):
+            ok = jnp.ones((cap,), bool)
+            for n in watched:
+                v = state[n][:, :L]
+                ok = ok & jnp.isfinite(v).reshape(v.shape[0], -1).all(axis=1)
+            return ok
+
+        hit = (jax.jit(run_quantum), jax.jit(finite))
+        if len(_FLEET_PROGRAMS) >= _FLEET_PROGRAMS_MAX:
+            _FLEET_PROGRAMS.pop(next(iter(_FLEET_PROGRAMS)))
+        _FLEET_PROGRAMS[key] = hit
+        return hit
+
+    # -- slot management ----------------------------------------------
+
+    def free_slot(self):
+        """Lowest free slot index, or None when the batch is full."""
+        try:
+            return self.slots.index(None)
+        except ValueError:
+            return None
+
+    @property
+    def jobs(self):
+        """``[(slot, job)]`` of the occupied slots."""
+        return [(i, j) for i, j in enumerate(self.slots) if j is not None]
+
+    def admit(self, job: FleetJob, from_grid: bool = True):
+        """Place ``job`` into the lowest free slot. With ``from_grid``
+        (default) the template/scratch grid's current field data —
+        just initialized or just restored from the job's checkpoint —
+        is scattered into the slot."""
+        slot = self.free_slot()
+        if slot is None:
+            raise RuntimeError("batch is full")
+        self.slots[slot] = job
+        self._extras[slot] = np.asarray(job.params, dtype=np.float32)
+        if from_grid:
+            self.read_grid(slot)
+        return slot
+
+    def clear(self, slot: int) -> None:
+        """Free a slot (job finished/failed/requeued). The slot's
+        bytes stay as they are — budget 0 freezes them and the next
+        occupant overwrites every row."""
+        self.slots[slot] = None
+
+    def read_grid(self, slot: int) -> None:
+        """Scatter the scratch grid's field data into ``slot``
+        (admission and per-slot restore). Only the target slot's rows
+        change; every other slot's bits are preserved exactly."""
+        for n in self.schema:
+            self.state[n] = self.state[n].at[slot].set(self.grid.data[n][0])
+
+    def write_grid(self, slot: int) -> Grid:
+        """Gather ``slot``'s field data into the scratch grid (per-slot
+        checkpoint save) and return it."""
+        sh = self.grid._sharding()
+        for n in self.schema:
+            self.grid.data[n] = jax.device_put(self.state[n][slot][None], sh)
+        return self.grid
+
+    def extract(self, slot: int) -> dict:
+        """Host copies of ``slot``'s field arrays (``[R, *shape]``)."""
+        return {n: np.asarray(self.state[n][slot]) for n in self.schema}
+
+    # -- the batched dispatch -----------------------------------------
+
+    def step(self, budget) -> int:
+        """Advance slot ``k`` by ``budget[k]`` steps in ONE jitted
+        batched dispatch; returns the quantum length (max budget).
+        Slots with budget 0 (empty, finished, tripped-and-masked) are
+        frozen bit-exactly."""
+        budget = np.asarray(budget, dtype=np.int32)
+        q = int(budget.max()) if len(budget) else 0
+        if q <= 0:
+            return 0
+        fn, _finite = self._programs()
+        self.state = fn(self.state, jnp.asarray(self._extras),
+                        jnp.asarray(budget), jnp.int32(q))
+        self.dispatches += 1
+        return q
+
+    def finite_slots(self) -> np.ndarray:
+        """Per-slot numerics watchdog: ``[capacity]`` bool, True where
+        every watched (inexact) field element of the slot is finite.
+        One device round-trip for the whole fleet; a poisoned slot
+        cannot hide behind its neighbors."""
+        _fn, finite = self._programs()
+        return np.asarray(finite(self.state))
+
+    def poison(self, slot: int, fld: str, cells, value) -> None:
+        """Write ``value`` into ``fld`` at ``cells`` of ONE slot — the
+        fleet-scoped fault-injection landing pad
+        (:func:`dccrg_tpu.faults.poison_fleet`)."""
+        _dev, rows = self.grid._host_rows(cells)
+        self.state[fld] = self.state[fld].at[slot, rows].set(value)
+
+    def digest(self, slot: int) -> str:
+        """SHA-256 over the slot's OWNED cell bytes — matches
+        :func:`dccrg_tpu.checkpoint.state_digest` of a solo grid
+        holding the same state."""
+        h = hashlib.sha256()
+        for name in sorted(self.schema):
+            shape, dtype = self.schema[name]
+            h.update(repr((name, tuple(shape), str(dtype))).encode())
+            h.update(np.ascontiguousarray(
+                np.asarray(self.state[name][slot])[:self.n_own]).tobytes())
+        return h.hexdigest()
+
+
+# ---------------------------------------------------------------------
+# CLI: python -m dccrg_tpu.fleet <jobs.json> | --demo N
+# ---------------------------------------------------------------------
+
+def _jobs_from_spec(spec: dict) -> list:
+    """Parse a job-file dict (``{"jobs": [{...}]}``) into
+    :class:`FleetJob` objects. Per-job keys: ``name`` (required,
+    unique), ``n`` (cube edge) or ``length`` [x, y, z], ``kernel``
+    (registry name), ``steps``, ``params`` (list of floats; ``dt`` is
+    shorthand for one), ``priority``, ``seed``, ``checkpoint_every``,
+    ``periodic`` [bool, bool, bool]."""
+    jobs = []
+    for row in spec.get("jobs", []):
+        if "name" not in row:
+            raise ValueError(f"job row without a name: {row}")
+        length = (tuple(row["length"]) if "length" in row
+                  else (int(row.get("n", 16)),) * 3)
+        params = row.get("params")
+        if params is None:
+            params = [float(row.get("dt", 0.1))]
+        jobs.append(FleetJob(
+            row["name"], length=length,
+            kernel=row.get("kernel", "diffuse"),
+            n_steps=int(row.get("steps", 10)), params=params,
+            priority=int(row.get("priority", 0)),
+            seed=int(row.get("seed", 0)),
+            periodic=tuple(row.get("periodic", (True, True, True))),
+            checkpoint_every=int(row.get("checkpoint_every", 8)),
+        ))
+    return jobs
+
+
+def _main(argv=None) -> int:
+    """``python -m dccrg_tpu.fleet jobs.json [--workdir DIR]`` — run a
+    fleet job file through :class:`~dccrg_tpu.scheduler
+    .FleetScheduler` (``--demo N`` synthesizes N diffuse jobs
+    instead). Prints one JSON row per finished job plus a summary;
+    exits 75 (resumable) when preempted mid-fleet — rerun with the
+    same workdir to resume every requeued job from its emergency
+    checkpoint."""
+    import argparse
+    import json
+    import sys
+    import tempfile
+    import time
+
+    ap = argparse.ArgumentParser(prog="python -m dccrg_tpu.fleet",
+                                 description=_main.__doc__)
+    ap.add_argument("jobs_file", nargs="?", default=None,
+                    help="JSON job file ({'jobs': [{...}]})")
+    ap.add_argument("--demo", type=int, default=None, metavar="N",
+                    help="synthesize N diffuse jobs instead of a file")
+    ap.add_argument("--n", type=int, default=16,
+                    help="--demo grid edge length (default 16)")
+    ap.add_argument("--steps", type=int, default=20,
+                    help="--demo steps per job (default 20)")
+    ap.add_argument("--workdir", default=None,
+                    help="checkpoint directory (default: a temp dir)")
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--quantum", type=int, default=None)
+    ap.add_argument("--keep-last", type=int, default=None)
+    ap.add_argument("--no-resume", action="store_true",
+                    help="ignore existing checkpoints in the workdir")
+    args = ap.parse_args(argv)
+
+    from .scheduler import FleetPreemptedError, FleetScheduler
+
+    if args.demo is not None:
+        jobs = [FleetJob(f"demo{i:04d}", length=(args.n,) * 3,
+                         n_steps=args.steps, params=(0.05,), seed=i,
+                         priority=i % 3)
+                for i in range(args.demo)]
+    elif args.jobs_file:
+        with open(args.jobs_file) as f:
+            jobs = _jobs_from_spec(json.load(f))
+    else:
+        ap.error("either a jobs file or --demo N is required")
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="dccrg_fleet_")
+    sched = FleetScheduler(
+        workdir, jobs, max_batch=args.max_batch, quantum=args.quantum,
+        keep_last=args.keep_last, resume=not args.no_resume,
+        install_signal_handlers=True)
+    t0 = time.perf_counter()
+    try:
+        report = sched.run()
+    except FleetPreemptedError as e:
+        print(json.dumps({"preempted": True,
+                          "requeued": e.requeued,
+                          "workdir": workdir}), flush=True)
+        return e.exit_code
+    wall = time.perf_counter() - t0
+    done = failed = steps = 0
+    for name in sorted(report):
+        row = dict(report[name], name=name)
+        print(json.dumps(row), flush=True)
+        done += row["status"] == "done"
+        failed += row["status"] == "failed"
+        steps += row["steps"]
+    print(json.dumps({"summary": {
+        "jobs": len(report), "done": done, "failed": failed,
+        "steps_total": steps, "wall_s": round(wall, 3),
+        "runs_per_s": round(done / wall, 3) if wall > 0 else None,
+        "workdir": workdir}}), flush=True)
+    return 0 if failed == 0 else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via CLI
+    import sys
+
+    # standalone gotcha (ROUND6_NOTES): the image's site hook may have
+    # pre-imported jax pointed at a dead accelerator tunnel; force the
+    # CPU backend unless the caller opted out
+    if os.environ.get("DCCRG_FLEET_BACKEND", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    sys.exit(_main())

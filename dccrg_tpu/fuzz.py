@@ -484,11 +484,83 @@ class GridFuzzer:
         return int(hits[0]) if len(hits) else 0
 
 
+# -- fleet-isolation scenario (the fleet layer's oracle) --------------
+
+def fleet_isolation_case(seed: int, jobs: int = 8, n: int = 8,
+                         quantum: int = 4) -> dict:
+    """One seeded fleet-isolation scenario: ``jobs`` randomized
+    same-shape scenario runs (random kernels, dt, seeds, step counts,
+    priorities) are multiplexed through one
+    :class:`~dccrg_tpu.scheduler.FleetScheduler` batch while a
+    :class:`~dccrg_tpu.faults.FaultPlan` poisons ONE random victim
+    job's field with NaN at a random step. The oracle is the
+    one-grid-at-a-time path: every job — the victim included, whose
+    trip must roll back and replay clean — must finish with a final-
+    state digest bitwise equal to its solo ``Grid.run_steps`` run,
+    and ONLY the victim may trip. Raises :class:`FuzzFailure`;
+    returns ``{victim, trips, report}`` on success."""
+    import tempfile
+
+    from .fleet import FleetJob, run_solo
+    from .scheduler import FleetScheduler
+
+    rng = np.random.default_rng(seed)
+    kernels = ("diffuse", "advect_x")
+
+    def mk(i):
+        return FleetJob(
+            f"f{seed}_{i:02d}", length=(n,) * 3,
+            kernel=kernels[int(rng.integers(0, len(kernels)))],
+            n_steps=int(rng.integers(6, 24)),
+            params=(float(rng.uniform(0.01, 0.08)),),
+            priority=int(rng.integers(0, 3)),
+            seed=int(rng.integers(0, 2 ** 31)),
+            checkpoint_every=int(rng.integers(3, 9)))
+
+    specs = [mk(i) for i in range(jobs)]
+    solo = {j.name: run_solo(FleetJob(
+        j.name, length=j.length, kernel=j.kernel, n_steps=j.n_steps,
+        params=j.params, seed=j.seed)) for j in specs}
+    victim = specs[int(rng.integers(0, jobs))]
+    poison_step = int(rng.integers(1, victim.n_steps + 1))
+    plan = FaultPlan(seed=seed)
+    plan.nan_poison("rho", step=poison_step, job=victim.name)
+    with tempfile.TemporaryDirectory(prefix="dccrg_fleet_fuzz_") as wd:
+        with plan:
+            report = FleetScheduler(wd, specs, quantum=quantum).run()
+    if plan.fired("step.poison") != 1:
+        raise FuzzFailure(
+            f"fleet poison for {victim.name} at step {poison_step} "
+            f"never landed", seed=seed)
+    for j in specs:
+        row = report.get(j.name)
+        if row is None or row["status"] != "done":
+            raise FuzzFailure(
+                f"fleet job {j.name} did not finish: {row}", seed=seed)
+        if row["digest"] != solo[j.name]:
+            raise FuzzFailure(
+                f"fleet job {j.name} final digest differs from its "
+                f"solo run (victim was {victim.name}, poisoned after "
+                f"step {poison_step})", seed=seed)
+        if j.name != victim.name and row["trips"]:
+            raise FuzzFailure(
+                f"non-victim job {j.name} tripped {row['trips']} "
+                f"time(s); only {victim.name} was poisoned", seed=seed)
+    if report[victim.name]["trips"] < 1:
+        raise FuzzFailure(
+            f"victim {victim.name} (poisoned after step {poison_step} "
+            f"of {victim.n_steps}) never tripped", seed=seed)
+    return {"victim": victim.name,
+            "trips": report[victim.name]["trips"], "report": report}
+
+
 # -- CLI --------------------------------------------------------------
 
 def _main(argv=None) -> int:
     """``python -m dccrg_tpu.fuzz --seed N --ops M`` — run one (or
-    ``--seeds K``: seeds 0..K-1) deterministic fuzz run and report."""
+    ``--seeds K``: seeds 0..K-1) deterministic fuzz run and report;
+    ``--fleet K`` runs K seeded fleet-isolation scenarios
+    (:func:`fleet_isolation_case`) instead."""
     import argparse
     import time
 
@@ -501,7 +573,28 @@ def _main(argv=None) -> int:
     ap.add_argument("--length", type=int, nargs=3, default=(4, 4, 2))
     ap.add_argument("--max-level", type=int, default=1)
     ap.add_argument("--devices", type=int, default=2)
+    ap.add_argument("--fleet", type=int, default=None, metavar="K",
+                    help="run K seeded fleet-isolation scenarios "
+                         "(one poisoned batch slot; every job must "
+                         "match its solo digest) instead of the "
+                         "mutation fuzz")
     args = ap.parse_args(argv)
+
+    if args.fleet is not None:
+        import time as time_mod
+
+        t0 = time_mod.time()
+        for s in range(args.fleet):
+            try:
+                out = fleet_isolation_case(s)
+            except FuzzFailure as e:
+                print(f"FAIL {e}")
+                return 1
+            print(f"fleet seed {s}: victim {out['victim']} tripped "
+                  f"{out['trips']}x, all digests match solo")
+        print(f"OK {args.fleet} fleet seed(s), "
+              f"{time_mod.time() - t0:.1f}s")
+        return 0
 
     seeds = range(args.seeds) if args.seeds is not None else [args.seed]
     t0 = time.time()

@@ -785,6 +785,47 @@ class SalvageReport:
         return not self.bad_chunks and not self.sidecar_missing
 
 
+def load_checkpoint_into(grid, filename: str, *, header_size: int = 0,
+                         variable=None, verify: bool = True) -> None:
+    """Load a checkpoint's exact bytes into an ALREADY-CONSTRUCTED
+    grid of matching structure — the rollback/per-slot-restore
+    primitive shared by :class:`ResilientRunner` and the fleet layer
+    (:mod:`dccrg_tpu.fleet`, which restores ONE batch member into a
+    scratch grid). CHAIN-AWARE: a delta checkpoint verifies and
+    materializes its whole keyframe+delta chain into a scratch file
+    first (a broken chain raises :class:`DeltaChainError`); a full
+    checkpoint is CRC-verified against its sidecar (``verify=False``
+    skips that for bytes the caller just wrote and verified). Ghost
+    copies are refreshed afterwards, so static never-re-exchanged
+    fields read exactly the checkpointed state."""
+    if is_delta_checkpoint(filename):
+        tmp = _chain_scratch(filename)
+        try:
+            materialize_chain(filename, tmp, grid.fields,
+                              variable=variable, verify=verify)
+            checkpoint_mod.load_grid_data(grid, tmp,
+                                          header_size=header_size,
+                                          variable=variable)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    else:
+        if verify:
+            bad = verify_checkpoint(filename)
+            if bad:
+                raise CheckpointCorruptionError(
+                    f"rollback target {filename} is itself "
+                    f"corrupt (chunks {bad})", bad_chunks=bad)
+        checkpoint_mod.load_grid_data(grid, filename,
+                                      header_size=header_size,
+                                      variable=variable)
+    # the load scatters LOCAL rows only; ghost copies of fields the
+    # step loop treats as static (never re-exchanged) would stay
+    # zero — refresh every field's ghosts so the resumed run sees
+    # exactly the checkpointed state
+    grid.update_copies_of_remote_neighbors()
+
+
 def load_checkpoint(filename: str, cell_data, mesh=None,
                     header_size: int = 0, variable=None, strict: bool = True,
                     load_balancing_method=None):
@@ -1208,36 +1249,13 @@ class ResilientRunner:
         self.checkpoints += 1
 
     def _rollback(self) -> None:
-        path = self.checkpoint_path
-        if is_delta_checkpoint(path):
-            # chain-aware rollback: verify + materialize the
-            # keyframe+delta chain, then load the reconstructed full
-            # bytes into the live grid (a broken chain surfaces as
-            # DeltaChainError — a corrupt rollback target either way)
-            tmp = _chain_scratch(path)
-            try:
-                materialize_chain(path, tmp, self.grid.fields,
-                                  variable=self.variable)
-                checkpoint_mod.load_grid_data(
-                    self.grid, tmp, header_size=len(self.header),
-                    variable=self.variable)
-            finally:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
-        else:
-            bad = verify_checkpoint(path)
-            if bad:
-                raise CheckpointCorruptionError(
-                    f"rollback target {path} is itself "
-                    f"corrupt (chunks {bad})", bad_chunks=bad)
-            checkpoint_mod.load_grid_data(
-                self.grid, path, header_size=len(self.header),
-                variable=self.variable)
-        # the load scatters LOCAL rows only; ghost copies of fields the
-        # step loop treats as static (never re-exchanged) would stay
-        # zero — refresh every field's ghosts so the resumed run sees
-        # exactly the checkpointed state
-        self.grid.update_copies_of_remote_neighbors()
+        # chain-aware when the target is a delta: the shared primitive
+        # verifies + materializes the keyframe+delta chain (a broken
+        # chain surfaces as DeltaChainError — a corrupt rollback
+        # target either way)
+        load_checkpoint_into(self.grid, self.checkpoint_path,
+                             header_size=len(self.header),
+                             variable=self.variable)
         self.step = self._ckpt_step
         self.rollbacks += 1
 

@@ -1,0 +1,495 @@
+"""Fleet job scheduler: a priority queue over batched grid buckets.
+
+:class:`FleetScheduler` turns :mod:`dccrg_tpu.fleet`'s batched
+execution layer into a multi-tenant serving loop, reusing the
+per-run lifecycle machinery of :mod:`dccrg_tpu.supervise` PER JOB:
+
+- **admission**: jobs pop in priority order and land in the
+  :class:`~dccrg_tpu.fleet.GridBatch` bucket their
+  ``(shape, schema, kernel)`` key selects — created on demand with a
+  :func:`~dccrg_tpu.grid.bucket_capacity`-rounded slot count (capped
+  by ``DCCRG_FLEET_MAX_BATCH``) so the compiled program survives
+  drain and backfill; a job that does not fit waits in the queue and
+  **backfills** the next slot a finishing/failing/requeued job frees;
+- **checkpoints**: every job owns a
+  :class:`~dccrg_tpu.supervise.CheckpointStore` stem (its name) in
+  ONE shared directory — periodic per-job saves (dirty-field deltas
+  chained to keyframes, exactly the single-run data plane) happen at
+  quantum boundaries when a job crosses its ``checkpoint_every``
+  cadence, followed by per-stem retention GC
+  (:func:`~dccrg_tpu.supervise.gc_checkpoints`, which treats each
+  stem as an independent sequence);
+- **isolation trips**: the per-slot numerics watchdog
+  (:meth:`~dccrg_tpu.fleet.GridBatch.finite_slots`) rolls a tripped
+  job back from ITS OWN newest verifying checkpoint in place
+  (bounded retries, then ``failed``); a job-scoped injected OOM
+  (:meth:`~dccrg_tpu.faults.FaultPlan.resource_exhausted` with
+  ``job=``) **requeues** only that job — it re-admits from its
+  checkpoint, possibly into a different slot or bucket instance,
+  while every neighbor slot's bytes stay frozen-exact. A REAL
+  (unattributed) ``RESOURCE_EXHAUSTED`` from the batched dispatch
+  requeues the lower-priority half of the bucket's jobs to shrink
+  the working set;
+- **preemption**: the loop polls the supervision layer's preempt
+  flag (SIGTERM/SIGINT handlers, :func:`~dccrg_tpu.supervise
+  .request_preempt`, or a faked
+  :meth:`~dccrg_tpu.faults.FaultPlan.preempt_signal`) at quantum
+  boundaries; on preemption every admitted job takes an emergency
+  keyframe into its own stem and is requeued, then
+  :class:`FleetPreemptedError` surfaces with the resumable exit code
+  75 — rerunning the scheduler over the same directory resumes every
+  job from its checkpoint (``resume=True``), bitwise identical to an
+  uninterrupted fleet.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import os
+import time
+from contextlib import nullcontext
+
+import numpy as np
+
+from . import faults, resilience, supervise
+from .fleet import (FleetJob, GridBatch, max_batch_default,
+                    quantum_default)
+from .grid import bucket_capacity
+
+logger = logging.getLogger("dccrg_tpu.scheduler")
+
+
+class FleetPreemptedError(RuntimeError):
+    """The fleet stopped at a quantum boundary on a preemption signal;
+    every admitted job saved an emergency keyframe into its own stem
+    and was requeued. ``exit_code`` is the resumable 75
+    (:data:`~dccrg_tpu.supervise.RESUMABLE_EXIT`); rerun the
+    scheduler over the same checkpoint directory to resume."""
+
+    exit_code = supervise.RESUMABLE_EXIT
+
+    def __init__(self, requeued):
+        super().__init__(
+            f"fleet preempted; {len(requeued)} job(s) emergency-"
+            f"checkpointed and requeued (exit code {self.exit_code})")
+        self.requeued = list(requeued)
+
+
+class FleetScheduler:
+    """Admit, multiplex, checkpoint and drain a fleet of
+    :class:`~dccrg_tpu.fleet.FleetJob` runs (see module docstring).
+
+    ``checkpoint_dir`` holds every job's numbered checkpoint stem.
+    Knobs (None = env default): ``max_batch``
+    (``DCCRG_FLEET_MAX_BATCH``), ``quantum``
+    (``DCCRG_FLEET_QUANTUM``), ``keep_last`` (``DCCRG_KEEP_LAST``) /
+    ``keep_every`` (per-stem retention). ``resume`` (default) restores
+    a job with existing checkpoints from its newest verifying one
+    instead of reinitializing. ``devices`` spreads bucket instances
+    round-robin over a device list (default: the default device)."""
+
+    def __init__(self, checkpoint_dir, jobs=(), *, max_batch=None,
+                 quantum=None, keep_last=None, keep_every=0,
+                 resume=True, devices=None,
+                 install_signal_handlers=False):
+        self.dir = str(checkpoint_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.max_batch = (max_batch_default() if max_batch is None
+                          else max(1, int(max_batch)))
+        self.quantum = (quantum_default() if quantum is None
+                        else max(1, int(quantum)))
+        self.keep_last = (supervise.keep_last_default()
+                          if keep_last is None else max(1, int(keep_last)))
+        self.keep_every = int(keep_every)
+        self.resume = bool(resume)
+        self.devices = list(devices) if devices else [None]
+        self._install = bool(install_signal_handlers)
+        self._queue: list = []  # heap of (-priority, seq, job)
+        self._seq = itertools.count()
+        self._by_name: dict = {}
+        self.buckets: dict = {}  # bucket key -> [GridBatch]
+        self._stores: dict = {}  # job name -> CheckpointStore
+        self._next_dev = 0
+        self.report: dict = {}
+        self.ticks = 0
+        for j in jobs:
+            self.add(j)
+
+    # -- queue --------------------------------------------------------
+
+    def add(self, job: FleetJob) -> None:
+        """Queue a job (higher ``priority`` admits first; FIFO within
+        a priority). The name is the checkpoint stem — unique per
+        scheduler."""
+        known = self._by_name.get(job.name)
+        if known is not None and known is not job:
+            raise ValueError(
+                f"duplicate job name {job.name!r}: the name is the "
+                "checkpoint stem and must be unique per scheduler")
+        self._by_name[job.name] = job
+        job.status = "queued"
+        heapq.heappush(self._queue, (-job.priority, next(self._seq), job))
+
+    def store_for(self, job: FleetJob) -> supervise.CheckpointStore:
+        st = self._stores.get(job.name)
+        if st is None:
+            st = supervise.CheckpointStore(self.dir, stem=job.name)
+            self._stores[job.name] = st
+        return st
+
+    # -- admission + backfill -----------------------------------------
+
+    def _bucket_for(self, job: FleetJob) -> GridBatch:
+        """A bucket instance with a free slot for ``job``'s key, or
+        None. Creates a new instance (round-robin over ``devices``)
+        sized to the demand visible NOW — bucket_capacity-rounded so
+        later fluctuations reuse the compile — when every existing
+        one is full and the device list allows another."""
+        key = job.bucket_key()
+        insts = self.buckets.setdefault(key, [])
+        for b in insts:
+            if b.free_slot() is not None:
+                return b
+        if len(insts) >= len(self.devices):
+            return None
+        same_key = 1 + sum(1 for _p, _s, j in self._queue
+                           if j.bucket_key() == key)
+        cap = min(self.max_batch, bucket_capacity(same_key))
+        b = GridBatch(job, cap,
+                      device=self.devices[self._next_dev % len(self.devices)])
+        self._next_dev += 1
+        insts.append(b)
+        return b
+
+    def _admit_pending(self) -> int:
+        """One admission pass: place every queued job that fits
+        (priority order; non-fitting jobs go back and backfill
+        later). Returns how many were admitted."""
+        deferred, admitted = [], 0
+        while self._queue:
+            item = heapq.heappop(self._queue)
+            job = item[2]
+            batch = self._bucket_for(job)
+            if batch is None:
+                deferred.append(item)
+                continue
+            self._admit_into(batch, job)
+            admitted += 1
+        for item in deferred:
+            heapq.heappush(self._queue, item)
+        return admitted
+
+    def _admit_into(self, batch: GridBatch, job: FleetJob) -> None:
+        store = self.store_for(job)
+        restored = None
+        if self.resume or job.steps_done > 0 or job.requeues:
+            restored = self._load_newest(batch, store, job)
+        elif store.list():
+            # resume=False over a dir holding a PREVIOUS run's stem:
+            # purge it now, or the first trip/requeue/preemption would
+            # _load_newest the stale (higher-step) state — and the
+            # per-save GC would keep those stale files over this
+            # run's fresh step-0 keyframe
+            self._purge_stem(store, job)
+        if restored is None:
+            job.apply_init(batch.grid)
+            job.steps_done = 0
+        else:
+            job.steps_done = restored
+            # the restored checkpoint IS the last save: the periodic
+            # cadence continues from it
+            job.last_save_step = restored
+        slot = batch.admit(job, from_grid=True)
+        job.status = "running"
+        logger.debug("admitted %s at step %d into slot %d", job.name,
+                     job.steps_done, slot)
+        if restored is None:
+            # the rollback target always exists (the ResilientRunner
+            # invariant, per job): a step-0 keyframe before stepping
+            self._save_job(batch, slot, job, force_keyframe=True)
+
+    def _purge_stem(self, store, job) -> None:
+        """Delete every checkpoint (and sidecar) of ``job``'s stem —
+        the ``resume=False`` contract is a from-scratch run."""
+        n = 0
+        for _step, path in store.list():
+            for p in (path, resilience.sidecar_path(path)):
+                try:
+                    os.remove(p)
+                    n += 1
+                except OSError:
+                    pass
+        logger.warning("resume=False: purged %d stale checkpoint "
+                       "file(s) of stem %s", n, job.name)
+
+    def _load_newest(self, batch, store, job):
+        """Restore the newest verifying checkpoint of ``job``'s stem
+        into the bucket's scratch grid (chain-aware; older entries are
+        the fallback, mirroring ``resume_latest``). Returns the
+        restored step or None."""
+        for step, path in store.list():
+            try:
+                resilience.load_checkpoint_into(batch.grid, path)
+            except Exception as e:  # noqa: BLE001 - walk to older
+                logger.warning("fleet resume of %s skipped %s (%s)",
+                               job.name, path, e)
+                continue
+            return int(step)
+        return None
+
+    # -- per-job checkpointing + retention ----------------------------
+
+    def _save_job(self, batch, slot, job, force_keyframe=False) -> None:
+        g = batch.write_grid(slot)
+        store = self.store_for(job)
+        store.save(g, job.steps_done, dirty_fields=set(job.fields_out),
+                   force_keyframe=force_keyframe)
+        job.last_save_step = job.steps_done
+        try:
+            supervise.gc_checkpoints(
+                self.dir, keep_last=self.keep_last,
+                keep_every=self.keep_every, stem=job.name, apply=True,
+                assume_ok=job.steps_done)
+        except OSError as e:  # GC must never kill the fleet
+            logger.warning("per-stem GC failed for %s (%s)", job.name, e)
+
+    # -- trips: per-slot isolation ------------------------------------
+
+    def _trip(self, batch, slot, job, kind) -> None:
+        """One job tripped (NaN in its slot, or a job-scoped OOM).
+        Neighbors are untouched by construction; this job rolls back
+        from its own checkpoint — in place for numerics trips, via
+        requeue for OOMs (the slot is freed so the working set
+        shrinks; re-admission restores from the same stem, possibly
+        into a different slot or bucket)."""
+        job.trips.append((kind, job.steps_done))
+        if job.steps_done > job._last_trip_step:
+            job.retries = 0  # progress since the last trip
+        job._last_trip_step = job.steps_done
+        job.retries += 1
+        logger.warning(
+            "fleet job %s tripped (%s) at step %d; retry %d/%d",
+            job.name, kind, job.steps_done, job.retries, job.max_retries)
+        if job.retries > job.max_retries:
+            self._finish(batch, slot, job, status="failed")
+            return
+        if kind == "oom":
+            # the fault fires BEFORE the dispatch, so the slot state
+            # is intact — keyframe it (same premise as _batch_oom /
+            # _preempt) so re-admission resumes from here instead of
+            # replaying everything since the last periodic save
+            self._save_job(batch, slot, job, force_keyframe=True)
+            batch.clear(slot)
+            job.requeues += 1
+            self.add(job)
+            return
+        restored = self._load_newest(batch, self.store_for(job), job)
+        if restored is None:
+            logger.error("fleet job %s has no loadable checkpoint to "
+                         "roll back to", job.name)
+            self._finish(batch, slot, job, status="failed")
+            return
+        batch.read_grid(slot)
+        job.steps_done = restored
+        # re-baseline the cadence like _admit_into: a fallback to an
+        # OLDER checkpoint would otherwise leave steps_done -
+        # last_save_step negative, suppressing saves over the whole
+        # replayed region
+        job.last_save_step = restored
+
+    def _finish(self, batch, slot, job, status="done") -> None:
+        if status == "done":
+            job.digest = batch.digest(slot)
+        job.status = status
+        batch.clear(slot)
+        self.report[job.name] = {
+            "status": status, "steps": job.steps_done,
+            "digest": job.digest, "trips": len(job.trips),
+            "retries_final": job.retries, "requeues": job.requeues,
+            "transient_retries": job.transient_retries,
+        }
+
+    # -- one bucket quantum -------------------------------------------
+
+    def _fire_dispatch_faults(self, batch) -> None:
+        """Per-job injection points before the batched dispatch:
+        transient dispatch errors retry in place (no rollback, the
+        supervision-layer discipline); a job-scoped simulated OOM
+        requeues exactly that job."""
+        if faults.active() is None:
+            return
+        for slot, job in batch.jobs:
+            for attempt in range(3):
+                try:
+                    faults.fire("supervise.dispatch", step=job.steps_done,
+                                job=job.name, attempt=attempt)
+                    break
+                except faults.InjectedDispatchError as e:
+                    job.transient_retries += 1
+                    logger.warning(
+                        "transient dispatch error for fleet job %s "
+                        "(%s); retrying", job.name, e)
+                    time.sleep(0.01 * (2 ** attempt))
+            else:
+                # retries exhausted: the single-run discipline raises
+                # (SupervisedRunner._dispatch); the fleet analogue is
+                # failing ONLY this job — neighbors keep serving
+                logger.error(
+                    "fleet job %s: transient dispatch error persisted "
+                    "through 3 attempts; failing the job", job.name)
+                self._finish(batch, slot, job, status="failed")
+                continue
+            try:
+                faults.fire("step.dispatch", mode="fleet",
+                            step=job.steps_done, job=job.name)
+            except Exception as e:  # noqa: BLE001 - filtered below
+                if not resilience._is_resource_exhausted(e):
+                    raise
+                logger.warning("fleet job %s dispatch OOM (%s)",
+                               job.name, e)
+                self._trip(batch, slot, job, "oom")
+
+    def _quantum(self, batch) -> None:
+        self._fire_dispatch_faults(batch)
+        active = batch.jobs
+        if not active:
+            return
+        budget = np.zeros(batch.capacity, dtype=np.int32)
+        prev = {}
+        for slot, job in active:
+            budget[slot] = min(self.quantum,
+                               max(0, job.n_steps - job.steps_done))
+            prev[slot] = job.steps_done
+        try:
+            batch.step(budget)
+        except Exception as e:  # noqa: BLE001 - filtered below
+            if not resilience._is_resource_exhausted(e):
+                raise
+            self._batch_oom(batch, e)
+            return
+        for slot, job in active:
+            job.steps_done += int(budget[slot])
+        # fleet-scoped NaN poison (chaos tests): land scheduled
+        # poisons for the steps this quantum advanced each job through
+        if faults.active() is not None:
+            for slot, job in active:
+                for fld, cells, value, _ps in faults.poison_fleet(
+                        job.name, prev[slot], job.steps_done):
+                    if cells is None:
+                        local = batch.grid.plan.cells
+                        pick = int(faults.active().rng.integers(
+                            0, len(local)))
+                        cells = [int(local[pick])]
+                    batch.poison(slot, fld, cells, value)
+        # per-slot watchdog: a tripped slot rolls back alone
+        ok = batch.finite_slots()
+        tripped = set()
+        for slot, job in active:
+            if batch.slots[slot] is job and not ok[slot]:
+                tripped.add(slot)
+                self._trip(batch, slot, job, "nan")
+        # periodic per-job checkpoints + completion (never checkpoint
+        # a slot that tripped this quantum: its state just rolled
+        # back — the cadence restarts from the restored step)
+        for slot, job in batch.jobs:
+            if slot in tripped:
+                continue
+            if job.steps_done >= job.n_steps:
+                self._finish(batch, slot, job)
+            elif (job.checkpoint_every > 0 and job.last_save_step
+                  is not None and job.steps_done - job.last_save_step
+                  >= job.checkpoint_every):
+                self._save_job(batch, slot, job)
+
+    def _batch_oom(self, batch, err) -> None:
+        """A REAL (unattributed) RESOURCE_EXHAUSTED from the batched
+        dispatch: the whole working set is too big. Requeue the
+        lower-priority half of the bucket's jobs (their slot state is
+        intact — the dispatch failed wholesale — so each saves a
+        keyframe first) and REBUILD the bucket at a smaller capacity:
+        occupancy alone frees no device memory (the state arrays and
+        the compiled program are both sized ``[capacity, ...]``), and
+        the freed slots would be backfilled from the queue on the very
+        next tick, re-creating the same working set forever. The
+        survivors migrate bit-exactly into the half-size batch;
+        repeated OOMs keep halving until a single job's failure is
+        surfaced."""
+        active = batch.jobs
+        if len(active) <= 1:
+            raise resilience.ResilienceExhaustedError(
+                f"fleet bucket OOMs even with {len(active)} job(s)"
+            ) from err
+        by_prio = sorted(active, key=lambda e: (e[1].priority, -e[0]))
+        drop = len(active) // 2
+        for slot, job in by_prio[:drop]:
+            self._save_job(batch, slot, job, force_keyframe=True)
+            batch.clear(slot)
+            job.requeues += 1
+            self.add(job)
+        survivors = batch.jobs
+        new_cap = max(len(survivors), batch.capacity // 2)
+        small = GridBatch(survivors[0][1], new_cap, device=batch.device)
+        for slot, job in survivors:
+            state = batch.extract(slot)
+            new_slot = small.admit(job, from_grid=False)
+            for name, arr in state.items():
+                small.state[name] = small.state[name].at[new_slot].set(arr)
+        insts = self.buckets[batch.key]
+        insts[insts.index(batch)] = small
+        logger.warning(
+            "fleet bucket OOM: requeued %d of %d job(s), rebuilt the "
+            "bucket at capacity %d (was %d)", drop, len(active),
+            new_cap, batch.capacity)
+
+    # -- preemption ---------------------------------------------------
+
+    def _preempt(self) -> None:
+        requeued = []
+        for insts in self.buckets.values():
+            for batch in insts:
+                for slot, job in batch.jobs:
+                    self._save_job(batch, slot, job, force_keyframe=True)
+                    batch.clear(slot)
+                    job.requeues += 1
+                    self.add(job)
+                    requeued.append(job.name)
+        supervise.clear_preempt()
+        raise FleetPreemptedError(requeued)
+
+    # -- the serving loop ---------------------------------------------
+
+    def active_jobs(self) -> list:
+        """``[(batch, slot, job)]`` of every admitted job."""
+        return [(b, s, j) for insts in self.buckets.values()
+                for b in insts for s, j in b.jobs]
+
+    def run(self, max_ticks=None) -> dict:
+        """Serve until the queue and every bucket drain (or
+        ``max_ticks`` quantum rounds elapse). Returns the per-job
+        report ``{name: {status, steps, digest, trips, ...}}``.
+        Raises :class:`FleetPreemptedError` after emergency-saving
+        and requeueing every admitted job when preempted."""
+        ctx = (supervise.preemption_handlers() if self._install
+               else nullcontext())
+        with ctx:
+            while True:
+                if (supervise.preempt_requested()
+                        or faults.take_preempt(self.ticks)):
+                    self._preempt()
+                self._admit_pending()
+                active = [b for insts in self.buckets.values()
+                          for b in insts if b.jobs]
+                if not active:
+                    if self._queue:
+                        raise RuntimeError(
+                            "fleet wedged: queued jobs but no bucket "
+                            "can admit them")
+                    break
+                for batch in active:
+                    self._quantum(batch)
+                self.ticks += 1
+                if max_ticks is not None and self.ticks >= int(max_ticks):
+                    break
+        return self.report

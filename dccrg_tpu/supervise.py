@@ -703,7 +703,8 @@ class CheckpointStore:
         ext = resilience.DELTA_SUFFIX if delta else ".dc"
         return os.path.join(self.dir, f"{self.stem}_{int(step):08d}{ext}")
 
-    def _delta_fields(self, grid, variable, force_keyframe):
+    def _delta_fields(self, grid, variable, force_keyframe,
+                      dirty_override=None):
         """The dirty-field list for a delta save, or None when this
         save must be a full keyframe. Every input is replicated state
         (dirty set, structure epoch, save counters), so multi-process
@@ -717,7 +718,8 @@ class CheckpointStore:
             return None  # structural mutation / repartition: new epoch
         if last["chain_len"] + 1 >= self.keyframe_every:
             return None  # periodic keyframe cadence
-        dirty = getattr(grid, "_ckpt_dirty", None)
+        dirty = (set(dirty_override) if dirty_override is not None
+                 else getattr(grid, "_ckpt_dirty", None))
         if dirty is None:
             return None  # conservative: everything may have changed
         # ragged payloads resize with their counts: a dirty variable
@@ -731,13 +733,18 @@ class CheckpointStore:
         return sorted(dirty)
 
     def save(self, grid, step: int, header: bytes = b"", variable=None,
-             force_keyframe: bool = False) -> str:
+             force_keyframe: bool = False, dirty_fields=None) -> str:
         """Periodic save at ``step``: a dirty-field delta chained to
         this process's previous save when safe (see class docstring),
         else a full keyframe. Atomic either way (two-phase on
         multi-process meshes); on success the grid's dirty tracking is
-        re-baselined to this save. Returns the path written."""
-        fields = self._delta_fields(grid, variable, force_keyframe)
+        re-baselined to this save. Returns the path written.
+        ``dirty_fields`` overrides the grid's own dirty tracking — the
+        fleet layer saves ONE batch slot through a shared scratch grid
+        whose tracking reflects whatever slot passed through last, but
+        it knows exactly which fields its step program writes."""
+        fields = self._delta_fields(grid, variable, force_keyframe,
+                                    dirty_override=dirty_fields)
         if fields is not None:
             path = self.path_for(step, delta=True)
             try:

@@ -14,11 +14,20 @@
 # DeltaChainError fallback asserted) so the delta data plane runs
 # with continuous invariant verification on.
 #
+# Also runs a small fleet smoke leg: >= 8 concurrent jobs multiplexed
+# through one batch with ONE injected NaN trip — the victim must roll
+# back alone and every job must finish bitwise equal to its solo run
+# (the fleet-isolation fuzz scenario plus the CLI round trip).
+#
 # Usage: tests/ci_debug_leg.sh [extra pytest args]
 set -e
 cd "$(dirname "$0")/.."
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m "(fuzz or faultinject) and not slow" --dccrg-debug \
+    -p no:cacheprovider "$@"
+env JAX_PLATFORMS=cpu python -m pytest -q \
+    "tests/test_fleet.py::test_fleet_fuzz_isolation_scenario" \
+    "tests/test_fleet.py::test_cli_runs_a_job_file" \
     -p no:cacheprovider "$@"
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_recommit.py::test_native_numpy_plans_bitwise_identical" \

@@ -1,0 +1,455 @@
+"""Fleet execution layer: batched many-grid multiplexing with
+per-job isolation.
+
+The acceptance pins: with >= 32 concurrent jobs in ONE batch, an
+injected NaN trip (and separately an injected OOM) in one job rolls
+back / requeues ONLY that job — every other job's final field bytes
+are identical to a run without the fault, and every job's fleet-run
+digest (the victim included, after rollback + clean replay) matches
+its solo one-grid-at-a-time ``Grid.run_steps`` digest bitwise. Plus:
+per-slot checkpoint round-trips that resume into a DIFFERENT bucket
+position, drain/backfill past bucket capacity, compile sharing across
+same-shape jobs, per-stem delta chains + retention GC, preemption =
+emergency save + requeue + bitwise resume, and the CLI."""
+
+import glob
+import json
+import os
+
+import pytest
+
+import jax.numpy as jnp
+
+from dccrg_tpu import checkpoint as checkpoint_mod
+from dccrg_tpu import faults, resilience, supervise
+from dccrg_tpu.faults import FaultPlan
+from dccrg_tpu.fleet import (FLEET_KERNELS, FleetJob, GridBatch,
+                             _FLEET_PROGRAMS, run_solo, template_grid)
+from dccrg_tpu.fuzz import fleet_isolation_case
+from dccrg_tpu.scheduler import FleetPreemptedError, FleetScheduler
+
+pytestmark = pytest.mark.fleet
+
+N_BIG = 33  # the >= 32-concurrent-jobs acceptance fleet
+
+
+def _specs(count=N_BIG, steps=14, kernel="diffuse", **kw):
+    """Fresh job objects (the scheduler mutates runtime state, so
+    every run gets its own)."""
+    return [FleetJob(f"j{i:03d}", length=(8, 8, 8), kernel=kernel,
+                     n_steps=steps, params=(0.02 + 0.005 * (i % 5),),
+                     seed=i, checkpoint_every=5, **kw)
+            for i in range(count)]
+
+
+def _solo_digests(specs):
+    """Solo ``Grid.run_steps`` digests, ONE shared grid + compile for
+    every job of a bucket (re-initialized per job — byte-identical to
+    a fresh grid, cheaper than 33 compiles)."""
+    grids = {}
+    out = {}
+    for j in specs:
+        g = grids.get(j.bucket_key())
+        if g is None:
+            g = grids[j.bucket_key()] = template_grid(j)
+        j.apply_init(g)
+        if j.n_steps:
+            g.run_steps(j.resolved_kernel(), j.fields_in, j.fields_out,
+                        j.n_steps,
+                        extra_args=tuple(jnp.float32(p)
+                                         for p in j.params))
+        out[j.name] = checkpoint_mod.state_digest(g)
+    return out
+
+
+@pytest.fixture(scope="module")
+def big_solo():
+    return _solo_digests(_specs())
+
+
+@pytest.fixture(scope="module")
+def big_nofault(tmp_path_factory, big_solo):
+    """The no-fault fleet reference run — also pins the base parity:
+    every fleet digest equals its solo digest bitwise."""
+    wd = tmp_path_factory.mktemp("fleet_ref")
+    sched = FleetScheduler(wd, _specs(), quantum=4)
+    report = sched.run()
+    assert all(r["status"] == "done" for r in report.values())
+    assert {n: r["digest"] for n, r in report.items()} == big_solo
+    # all 33 jobs really were CONCURRENT: one bucket instance, every
+    # job admitted into it
+    insts = [b for bs in sched.buckets.values() for b in bs]
+    assert len(insts) == 1 and insts[0].capacity >= N_BIG
+    return {n: r["digest"] for n, r in report.items()}
+
+
+def test_fleet_parity_solo_bitwise(big_nofault, big_solo):
+    assert big_nofault == big_solo
+
+
+def test_nan_trip_isolates_one_job(tmp_path, big_solo, big_nofault):
+    """The acceptance pin: one poisoned slot in a >= 32-job batch
+    trips, rolls back from its OWN checkpoint and replays clean;
+    every neighbor's final bytes equal the fault-free run."""
+    victim = "j017"
+    plan = FaultPlan(seed=1)
+    plan.nan_poison("rho", step=9, job=victim)
+    with plan:
+        report = FleetScheduler(tmp_path, _specs(), quantum=4).run()
+    assert plan.fired("step.poison") == 1
+    assert all(r["status"] == "done" for r in report.values())
+    # only the victim tripped, exactly once
+    assert {n for n, r in report.items() if r["trips"]} == {victim}
+    # neighbors: bitwise identical to the run WITHOUT the fault
+    for n, r in report.items():
+        if n != victim:
+            assert r["digest"] == big_nofault[n], n
+    # and the victim reconverged to its solo digest (rollback + clean
+    # replay — the poison rule was consumed)
+    assert report[victim]["digest"] == big_solo[victim]
+
+
+def test_oom_isolates_one_job(tmp_path, big_solo, big_nofault):
+    """Separately: a job-scoped injected RESOURCE_EXHAUSTED requeues
+    only that job (it re-admits from its own checkpoint stem);
+    neighbors' bytes never move."""
+    victim = "j005"
+    plan = FaultPlan(seed=2)
+    plan.resource_exhausted(job=victim)
+    with plan:
+        report = FleetScheduler(tmp_path, _specs(), quantum=4).run()
+    assert plan.fired("step.dispatch") == 1
+    assert all(r["status"] == "done" for r in report.values())
+    assert report[victim]["requeues"] == 1
+    assert {n for n, r in report.items() if r["trips"]} == {victim}
+    for n, r in report.items():
+        if n != victim:
+            assert r["digest"] == big_nofault[n], n
+    assert report[victim]["digest"] == big_solo[victim]
+
+
+def test_real_batch_oom_shrinks_the_bucket(tmp_path, monkeypatch):
+    """A REAL (unattributed) RESOURCE_EXHAUSTED from the batched
+    dispatch must SHRINK the bucket, not just requeue: freed slots
+    are backfilled on the next tick and occupancy alone frees no
+    device memory (state arrays + program are sized by capacity), so
+    without a capacity rebuild the same OOM would repeat forever.
+    Survivors migrate bit-exactly, the requeued half re-admits from
+    its keyframes, and every digest still matches solo."""
+    solo = _solo_digests(_specs(count=8, steps=10))
+    real_step = GridBatch.step
+
+    def step(self, budget):
+        if self.capacity > 4:
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: out of memory (injected)")
+        return real_step(self, budget)
+
+    monkeypatch.setattr(GridBatch, "step", step)
+    sched = FleetScheduler(tmp_path, _specs(count=8, steps=10),
+                           quantum=4)
+    report = sched.run()
+    assert all(r["status"] == "done" for r in report.values())
+    assert {n: r["digest"] for n, r in report.items()} == solo
+    assert any(r["requeues"] for r in report.values())
+    insts = [b for bs in sched.buckets.values() for b in bs]
+    assert len(insts) == 1 and insts[0].capacity <= 4
+
+
+def test_no_resume_purges_stale_stems(tmp_path):
+    """``resume=False`` is a from-scratch contract: a workdir holding
+    a previous run's stems is purged at admission — otherwise the
+    first trip/requeue would ``_load_newest`` the stale higher-step
+    state (and the per-save GC would keep those stale files over this
+    run's fresh step-0 keyframe)."""
+    FleetScheduler(tmp_path, _specs(count=2, steps=8), quantum=4).run()
+    assert glob.glob(os.path.join(str(tmp_path), "j000_*"))
+    solo = _solo_digests(_specs(count=2, steps=8))
+    # rerun no-resume with a NaN trip: rollback must land on THIS
+    # run's step-0 keyframe, not the old run's final state
+    plan = FaultPlan(seed=7)
+    plan.nan_poison("rho", step=5, job="j000")
+    with plan:
+        report = FleetScheduler(tmp_path, _specs(count=2, steps=8),
+                                quantum=4, resume=False).run()
+    assert all(r["status"] == "done" for r in report.values())
+    assert report["j000"]["trips"] == 1
+    assert {n: r["digest"] for n, r in report.items()} == solo
+
+
+def test_batch_oom_with_one_job_surfaces(tmp_path, monkeypatch):
+    """Halving converges: when even a one-job bucket still OOMs, the
+    failure surfaces as ResilienceExhaustedError instead of looping."""
+    monkeypatch.setattr(
+        GridBatch, "step",
+        lambda self, budget: (_ for _ in ()).throw(
+            RuntimeError("RESOURCE_EXHAUSTED: out of memory")))
+    sched = FleetScheduler(tmp_path, _specs(count=4, steps=6),
+                           quantum=4)
+    with pytest.raises(resilience.ResilienceExhaustedError):
+        sched.run()
+
+
+def test_per_slot_roundtrip_resumes_into_different_slot(tmp_path):
+    """Save a job from a LIVE batch, kill the fleet, resume into a
+    different bucket position — final digest bit-identical to an
+    uninterrupted solo run."""
+    mk = lambda prios: [  # noqa: E731
+        FleetJob(n, length=(8, 8, 8), n_steps=20, params=(0.03,),
+                 seed=i, checkpoint_every=4, priority=p)
+        for i, (n, p) in enumerate(zip("abcd", prios))]
+    solo = _solo_digests(mk((0, 0, 0, 0)))
+    sched = FleetScheduler(tmp_path, mk((0, 0, 0, 0)), quantum=4)
+    sched.run(max_ticks=2)  # mid-run: per-job checkpoints exist
+    slots1 = {j.name: s for _b, s, j in sched.active_jobs()}
+    assert slots1 == {"a": 0, "b": 1, "c": 2, "d": 3}
+    del sched  # the 'kill': live batch state is abandoned
+
+    # resume with REVERSED admission priorities: every job restores
+    # from its own stem into a different slot
+    sched2 = FleetScheduler(tmp_path, mk((0, 1, 2, 3)), quantum=4)
+    sched2._admit_pending()
+    slots2 = {j.name: s for _b, s, j in sched2.active_jobs()}
+    assert slots2 == {"d": 0, "c": 1, "b": 2, "a": 3}
+    resumed = {j.name: j.steps_done for _b, _s, j in sched2.active_jobs()}
+    assert all(0 < v < 20 for v in resumed.values()), resumed
+    report = sched2.run()
+    assert {n: r["digest"] for n, r in report.items()} == solo
+
+
+def test_backfill_drains_past_capacity(tmp_path):
+    """More jobs than slots: finishing jobs free slots the queue
+    backfills; every job completes with its solo digest."""
+    specs = _specs(count=10, steps=8)
+    solo = _solo_digests(_specs(count=10, steps=8))
+    sched = FleetScheduler(tmp_path, specs, max_batch=4, quantum=3)
+    report = sched.run()
+    assert {n: r["digest"] for n, r in report.items()} == solo
+    insts = [b for bs in sched.buckets.values() for b in bs]
+    assert len(insts) == 1 and insts[0].capacity == 4
+
+
+def test_same_shape_jobs_share_one_program(tmp_path):
+    """Two batches with the same bucket key (a drained + recreated
+    bucket) reuse ONE compiled program pair."""
+    proto = FleetJob("p", length=(8, 8, 8), params=(0.1,))
+    b1 = GridBatch(proto, 16)
+    b1._programs()
+    n_before = len(_FLEET_PROGRAMS)
+    b2 = GridBatch(FleetJob("q", length=(8, 8, 8), params=(0.2,)), 16)
+    b2._programs()
+    assert len(_FLEET_PROGRAMS) == n_before
+    # a different shape is a different bucket -> its own program
+    b3 = GridBatch(FleetJob("r", length=(4, 4, 4), params=(0.2,)), 16)
+    b3._programs()
+    assert len(_FLEET_PROGRAMS) == n_before + 1
+
+
+def test_batch_digest_matches_state_digest():
+    """GridBatch.digest over a slot equals checkpoint.state_digest of
+    a grid holding the same bytes — the bridge every bitwise assertion
+    in this file crosses."""
+    job = FleetJob("d", length=(6, 6, 6), seed=9)
+    batch = GridBatch(job, 4)
+    job.apply_init(batch.grid)
+    g_digest = checkpoint_mod.state_digest(batch.grid)
+    slot = batch.admit(job, from_grid=True)
+    assert batch.digest(slot) == g_digest
+
+
+def test_job_scoped_rules_do_not_leak():
+    """A job= rule never fires for another job, nor at the plain
+    per-grid poison site."""
+    plan = FaultPlan(seed=0)
+    plan.nan_poison("rho", step=3, job="right")
+    plan.resource_exhausted(job="right")
+    with plan:
+        # plain grid-site poison carries no job -> no match
+        g = template_grid(FleetJob("x", length=(4, 4, 4)))
+        assert faults.poison_step(g, 3) == []
+        # wrong job -> no match; right job -> fires
+        assert faults.poison_fleet("wrong", 0, 10) == []
+        hits = faults.poison_fleet("right", 0, 10)
+        assert [(h[0], h[3]) for h in hits] == [("rho", 3)]
+        faults.fire("step.dispatch", mode="fleet", job="wrong", step=0)
+        with pytest.raises(faults.SimulatedResourceExhausted):
+            faults.fire("step.dispatch", mode="fleet", job="right",
+                        step=0)
+
+
+def test_transient_dispatch_error_retries_in_place(tmp_path):
+    """An UNAVAILABLE-class dispatch error for one job retries with
+    backoff — no trip, no rollback, bitwise solo parity."""
+    specs = _specs(count=4, steps=10)
+    solo = _solo_digests(_specs(count=4, steps=10))
+    plan = FaultPlan(seed=3)
+    plan.dispatch_error(job="j002")
+    with plan:
+        report = FleetScheduler(tmp_path, specs, quantum=4).run()
+    assert plan.fired("supervise.dispatch") == 1
+    assert report["j002"]["transient_retries"] == 1
+    assert all(r["trips"] == 0 for r in report.values())
+    assert {n: r["digest"] for n, r in report.items()} == solo
+
+
+def test_unrecoverable_nan_fails_only_that_job(tmp_path):
+    """A poison that re-lands on every replay exhausts the victim's
+    bounded retries -> FAILED; every other job still finishes with
+    its solo digest."""
+    specs = _specs(count=6, steps=12)
+    for j in specs:
+        j.max_retries = 2
+    solo = _solo_digests(_specs(count=6, steps=12))
+    plan = FaultPlan(seed=4)
+    plan.nan_poison("rho", step=7, job="j001", times=faults.EVERY)
+    with plan:
+        report = FleetScheduler(tmp_path, specs, quantum=4).run()
+    assert report["j001"]["status"] == "failed"
+    assert report["j001"]["trips"] == 3  # initial + 2 bounded retries
+    for n, r in report.items():
+        if n != "j001":
+            assert r["status"] == "done" and r["digest"] == solo[n]
+
+
+def test_preempt_emergency_saves_and_resumes_bitwise(tmp_path):
+    """A preemption signal at a quantum boundary: every admitted job
+    emergency-checkpoints into its own stem, the fleet exits with the
+    resumable code 75, and a rerun over the same directory finishes
+    every job bitwise equal to an uninterrupted fleet."""
+    solo = _solo_digests(_specs(count=6, steps=16))
+    plan = FaultPlan(seed=5)
+    plan.preempt_signal(step=1)  # the second scheduler tick
+    sched = FleetScheduler(tmp_path, _specs(count=6, steps=16),
+                           quantum=3)
+    with plan:
+        with pytest.raises(FleetPreemptedError) as ei:
+            sched.run()
+    assert ei.value.exit_code == supervise.RESUMABLE_EXIT == 75
+    assert len(ei.value.requeued) == 6
+    # every stem has a verifying emergency checkpoint
+    for i in range(6):
+        entries = supervise.list_checkpoints(tmp_path, f"j{i:03d}")
+        assert entries
+        resilience.verify_chain(entries[0][1])
+    report = FleetScheduler(tmp_path, _specs(count=6, steps=16),
+                            quantum=3).run()
+    assert {n: r["digest"] for n, r in report.items()} == solo
+
+
+def test_delta_chains_and_retention_per_stem(tmp_path):
+    """Multi-field jobs save dirty-field DELTAS per stem (the step
+    dirties only rho; aux is static), chains verify end to end, and
+    per-stem retention GC leaves whole chains only."""
+    specs = [FleetJob(f"m{i}", length=(6, 6, 6), n_steps=30,
+                      params=(0.02,), seed=i, checkpoint_every=3,
+                      cell_data={"rho": jnp.float32,
+                                 "aux": ((4,), jnp.int32)})
+             for i in range(3)]
+    report = FleetScheduler(tmp_path, specs, quantum=3,
+                            keep_last=2).run()
+    assert all(r["status"] == "done" for r in report.values())
+    assert glob.glob(os.path.join(tmp_path, "m0_*.dcd")), \
+        "no delta saves landed"
+    for i in range(3):
+        chains = supervise.chain_report(tmp_path, stem=f"m{i}")
+        assert chains
+        for _stem, links in chains:
+            assert all(status == "OK" for _s, _p, _k, status in links)
+        # retention ran per stem: far fewer steps kept than the ~10
+        # periodic saves each job made
+        steps = {s for s, _p in supervise.list_checkpoints(
+            tmp_path, f"m{i}")}
+        assert len(steps) <= 4
+
+
+def test_fleet_fuzz_isolation_scenario():
+    """The fuzz-oracle wiring: seeded randomized fleets with one
+    poisoned slot; every job must match its solo digest and only the
+    victim may trip (fuzz.fleet_isolation_case)."""
+    for seed in (0, 1):
+        out = fleet_isolation_case(seed)
+        assert out["trips"] >= 1
+
+
+@pytest.mark.fuzz
+def test_fleet_fuzz_more_seeds():
+    for seed in (2, 3):
+        fleet_isolation_case(seed)
+
+
+def test_cli_runs_a_job_file(tmp_path, capsys):
+    """python -m dccrg_tpu.fleet smoke: a job file runs to completion
+    and reports one JSON row per job plus a summary."""
+    from dccrg_tpu.fleet import _main
+
+    spec = {"jobs": [
+        {"name": "a", "n": 6, "kernel": "diffuse", "steps": 6,
+         "dt": 0.05, "seed": 1},
+        {"name": "b", "n": 6, "kernel": "advect_x", "steps": 8,
+         "params": [0.4], "priority": 2},
+    ]}
+    jf = tmp_path / "jobs.json"
+    jf.write_text(json.dumps(spec))
+    rc = _main([str(jf), "--workdir", str(tmp_path / "wd"),
+                "--quantum", "3"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    rows = [json.loads(line) for line in out]
+    byname = {r["name"]: r for r in rows if "name" in r}
+    assert byname["a"]["status"] == "done" and byname["a"]["steps"] == 6
+    assert byname["b"]["status"] == "done" and byname["b"]["steps"] == 8
+    summary = rows[-1]["summary"]
+    assert summary["jobs"] == 2 and summary["done"] == 2
+
+
+def test_registry_and_demo_cli(tmp_path, capsys):
+    assert {"diffuse", "advect_x"} <= set(FLEET_KERNELS)
+    from dccrg_tpu.fleet import _main
+
+    rc = _main(["--demo", "3", "--n", "6", "--steps", "5",
+                "--workdir", str(tmp_path)])
+    assert rc == 0
+    rows = [json.loads(x) for x in
+            capsys.readouterr().out.strip().splitlines()]
+    assert rows[-1]["summary"]["done"] == 3
+
+
+def test_nan_confined_mid_run_not_just_at_the_end():
+    """Stronger than final digests: with NaN RESIDENT in one slot
+    while the batch steps, the neighbor slots' bytes match a batch
+    that never saw the NaN — the vmapped program has no cross-batch
+    ops and per-slot selects preserve bits exactly."""
+    import numpy as np
+
+    def mk_batch():
+        b = GridBatch(FleetJob("p", length=(6, 6, 6), params=(0.05,)),
+                      4)
+        for slot, seed in enumerate((10, 11, 12)):
+            j = FleetJob(f"s{slot}", length=(6, 6, 6), params=(0.05,),
+                         seed=seed)
+            j.apply_init(b.grid)
+            b.admit(j, from_grid=True)
+        return b
+
+    poisoned, clean = mk_batch(), mk_batch()
+    poisoned.poison(1, "rho", [5], float("nan"))
+    budget = np.array([3, 3, 3, 0], np.int32)
+    poisoned.step(budget)
+    clean.step(budget)
+    ok = poisoned.finite_slots()
+    assert list(ok[:3]) == [True, False, True]
+    assert poisoned.digest(0) == clean.digest(0)
+    assert poisoned.digest(2) == clean.digest(2)
+    assert poisoned.digest(1) != clean.digest(1)
+
+
+def test_run_solo_matches_batch_of_one(tmp_path):
+    """run_solo (the Grid.run_steps baseline) == a fleet of ONE job:
+    the batch axis itself never perturbs a job's bytes."""
+    job = FleetJob("one", length=(8, 8, 8), n_steps=9, params=(0.07,),
+                   seed=42, kernel="advect_x")
+    solo = run_solo(FleetJob("one", length=(8, 8, 8), n_steps=9,
+                             params=(0.07,), seed=42,
+                             kernel="advect_x"))
+    report = FleetScheduler(tmp_path, [job], quantum=4).run()
+    assert report["one"]["digest"] == solo
