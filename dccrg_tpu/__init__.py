@@ -26,7 +26,13 @@ reference dccrg library (header-only C++/MPI/Zoltan; see SURVEY.md):
   same-shape scenario runs stacked along a batch axis into one
   jitted device program, fronted by a priority job queue with
   per-job checkpoint stems, per-slot NaN/OOM isolation and
-  preemption-requeue — ``python -m dccrg_tpu.fleet``).
+  preemption-requeue — ``python -m dccrg_tpu.fleet``),
+- a silent-data-corruption defense (``integrity``: in-program
+  fingerprint/conservation invariants fused into the fleet quantum
+  program, sampled shadow-execution audits, DMR job replication, a
+  CORRUPT trip class with per-victim rollback and consensus, device
+  quarantine with bit-exact survivor migration, and offline at-rest
+  fingerprint audits — ``python -m dccrg_tpu.resilience audit``).
 
 Reference: /root/reference (dccrg.hpp and friends). This package is a
 re-design for TPU, not a translation: structure (cell lists, neighbor
@@ -59,6 +65,7 @@ from .supervise import (RESUMABLE_EXIT, CheckpointStore, PreemptedError,
                         gc_checkpoints, resume_latest)
 from .fleet import FleetJob, GridBatch
 from .scheduler import FleetPreemptedError, FleetScheduler
+from .integrity import IntegrityError, register_conserved
 
 __version__ = "0.1.0"
 
@@ -110,4 +117,6 @@ __all__ = [
     "GridBatch",
     "FleetPreemptedError",
     "FleetScheduler",
+    "IntegrityError",
+    "register_conserved",
 ]

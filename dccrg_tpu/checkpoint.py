@@ -189,6 +189,34 @@ def parse_metadata(data, header_size: int = 0):
     return mapping, hood_len, topology, geometry, cells, offsets, pos + 16 * n_cells
 
 
+def payload_columns(raw, meta, fields, variable=None) -> dict:
+    """Per-field fixed-column bytes of a parsed .dc buffer:
+    ``{name: uint8[n_cells, nbytes]}`` gathered from each cell's
+    offset-table position — the read-side mirror of
+    :func:`_chunk_payload`'s interleave, used by the offline
+    integrity audit (:func:`dccrg_tpu.integrity.file_fingerprint`) to
+    re-derive a payload fingerprint without reconstructing a grid.
+    Ragged (variable) fields are skipped: their per-cell extents sit
+    between the fixed blocks and a corrupted count would make the
+    walk ambiguous."""
+    fixed_spec, _fixed_bytes, _var = _payload_spec_of(fields, variable)
+    offs = meta[5].astype(np.int64)
+    n = len(offs)
+    out = {}
+    col = 0
+    buf = np.asarray(raw, dtype=np.uint8)
+    for name, _shape, _dtype, nbytes in fixed_spec:
+        span = np.arange(nbytes, dtype=np.int64)[None, :]
+        idx = offs[:, None] + col + span
+        if n and int(idx.max()) >= buf.size:
+            raise ValueError(
+                f"payload column {name!r} extends past the end of the "
+                "buffer (truncated file?)")
+        out[name] = buf[idx]
+        col += nbytes
+    return out
+
+
 def _chunk_payload(grid, ids, fixed_spec, cell_bytes, reader=None):
     """The interleaved fixed-field payload for one chunk of cells,
     gathered on device so only the chunk crosses to the host.
@@ -424,7 +452,13 @@ def state_digest(grid, fields=None) -> str:
     so the fleet isolation tests (and bench parity checks) compare
     'final field bytes identical' without writing checkpoint files.
     Process-local on multi-process meshes: each rank digests its own
-    addressable shards (compare per rank, or gather host-side)."""
+    addressable shards (compare per rank, or gather host-side).
+
+    Gather-mode independent BY CONSTRUCTION: the digest reads only the
+    owned payload rows, which every gather mode (roll, tables,
+    overlap) leaves in the same layout — pinned by the SDC suite
+    (tests/test_integrity.py), because the shadow-audit comparator
+    assumes a mode-dependent digest can never raise a false alarm."""
     import hashlib
 
     h = hashlib.sha256()

@@ -51,6 +51,11 @@ active plan through the module hooks:
   disk corruption the CRC sidecar must catch.
 - :func:`poison_step` — write NaN into a field after a given step,
   the silent numerics failure the watchdog must trip on.
+- :func:`flip_step` / :func:`flip_fleet` — land a FINITE bit-flip
+  (:meth:`~FaultPlan.silent_flip`) in a field / a fleet batch slot:
+  the silent-data-corruption class, deliberately invisible to the
+  finiteness watchdog — only the integrity layer
+  (:mod:`dccrg_tpu.integrity`) can convict it.
 
 When no plan is installed every hook is a no-op, so the hooks cost one
 ``is None`` check on hot paths. All randomness (which byte to flip)
@@ -281,6 +286,24 @@ class FaultPlan:
         step deadline models a slow-but-alive step that completes."""
         return self._add("supervise.hang", "hang", times, step=step,
                          hang_s=hang_s)
+
+    def silent_flip(self, fld, step, cells=None, bit=23, times=1,
+                    job=None):
+        """Land a FINITE bit-flip in ``fld`` after step ``step`` — the
+        silent-data-corruption fault class. Unlike
+        :meth:`nan_poison`, the corrupted value stays finite and
+        plausible by construction (``bit`` defaults to the float32
+        exponent LSB: the value halves or doubles; a flip that would
+        land non-finite falls back to a finite wrong value instead),
+        so ``comm.all_finite`` / ``GridBatch.finite_slots`` pass and
+        only the integrity layer (:mod:`dccrg_tpu.integrity`:
+        in-program fingerprints, conservation drift, shadow audits)
+        can see it. ``cells=None`` picks one seeded local cell.
+        With ``job`` the flip targets ONE fleet batch slot (consumed
+        via :func:`flip_fleet`; job-scoped rules never fire at the
+        per-grid :func:`flip_step` site)."""
+        return self._add("step.flip", "flip", times, field=fld,
+                         step=step, cells=cells, bit=bit, job=job)
 
     def dispatch_error(self, times=1, step=None, job=None):
         """Transient dispatch failure (:class:`InjectedDispatchError`,
@@ -530,6 +553,91 @@ def poison_step(grid, step: int) -> list:
                           "cells": cells.tolist()}))
         applied.append((name, cells))
     return applied
+
+
+def flip_values(vals: np.ndarray, bit: int) -> np.ndarray:
+    """XOR ``bit`` into each element's raw bits, guaranteed FINITE:
+    an element whose flip would land inf/NaN (exponent saturation)
+    takes a finite wrong value (``1.5 * v + 1``) instead — silent
+    corruption must stay invisible to the finiteness watchdog, that
+    is the entire point of the fault class."""
+    vals = np.ascontiguousarray(vals)
+    kind = vals.dtype.kind
+    u = vals.view(f"u{vals.dtype.itemsize}")
+    flipped = (u ^ (np.array(1, dtype=u.dtype) << int(bit))).view(
+        vals.dtype)
+    if kind == "f":
+        bad = ~np.isfinite(flipped)
+        if bad.any():
+            # the fallback must itself be finite for EVERY finite
+            # input: halving never overflows (unlike 1.5*v + 1, which
+            # is inf for |v| > ~2.26e38 float32), and the +1 branch
+            # below |v| < 2 dodges the map's only fixed point at 0
+            with np.errstate(over="ignore", invalid="ignore"):
+                safe = np.where(np.abs(vals) >= 2.0, vals * 0.5,
+                                vals * 0.5 + 1.0).astype(vals.dtype)
+            flipped = np.where(bad, safe, flipped)
+    return flipped
+
+
+def flip_step(grid, step: int) -> list:
+    """Apply scheduled silent bit-flips for ``step`` to ``grid``'s
+    fields (the per-grid site, mirroring :func:`poison_step`); returns
+    the flipped ``(field, cells)`` pairs. Job-scoped rules (fleet
+    slots) never fire here."""
+    plan = _active
+    applied = []
+    if plan is None:
+        return applied
+    ctx = {"step": step}
+    for rule in [r for r in plan.rules
+                 if r.site == "step.flip" and r.matches("step.flip", ctx)
+                 and r.params.get("job") is None]:
+        rule.fired += 1
+        name = rule.params["field"]
+        cells = rule.params["cells"]
+        if cells is None:
+            local = np.asarray(grid.get_cells())
+            pick = int(plan.rng.integers(0, len(local)))
+            cells = np.asarray([local[pick]], dtype=np.uint64)
+        cells = np.atleast_1d(np.asarray(cells, dtype=np.uint64))
+        vals = np.asarray(grid.get(name, cells))
+        grid.set(name, cells, flip_values(vals, rule.params["bit"]))
+        plan.log.append(("step.flip", "flip",
+                         {"step": step, "field": name,
+                          "cells": cells.tolist(),
+                          "bit": int(rule.params["bit"])}))
+        applied.append((name, cells))
+    return applied
+
+
+def flip_fleet(job: str, after_step: int, through_step: int) -> list:
+    """Consume scheduled silent bit-flips targeting fleet job ``job``
+    whose step falls in ``(after_step, through_step]`` — same window
+    discipline as :func:`poison_fleet`. Returns ``[(field, cells,
+    bit, step)]``; the fleet layer lands the flip in the job's batch
+    slot itself (:meth:`dccrg_tpu.fleet.GridBatch.flip`)."""
+    plan = _active
+    out = []
+    if plan is None:
+        return out
+    for rule in plan.rules:
+        if rule.site != "step.flip" or rule.fired >= rule.times:
+            continue
+        want_job = rule.params.get("job")
+        if want_job is not None and want_job != job:
+            continue
+        step = rule.params.get("step")
+        if step is None or not after_step < step <= through_step:
+            continue
+        rule.fired += 1
+        plan.log.append(("step.flip", "flip",
+                         {"step": step, "job": job,
+                          "field": rule.params["field"],
+                          "bit": int(rule.params["bit"])}))
+        out.append((rule.params["field"], rule.params["cells"],
+                    int(rule.params["bit"]), int(step)))
+    return out
 
 
 def poison_fleet(job: str, after_step: int, through_step: int) -> list:

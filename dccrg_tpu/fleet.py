@@ -57,9 +57,15 @@ import jax
 import jax.numpy as jnp
 
 from . import checkpoint as checkpoint_mod
+from . import faults, integrity
 from .grid import DEFAULT_NEIGHBORHOOD_ID, Grid, default_mesh
 
 logger = logging.getLogger("dccrg_tpu.fleet")
+
+#: slot sentinel: a DMR shadow replica of the job in
+#: ``GridBatch.shadow_of[slot]`` — occupies a slot (so admission
+#: cannot reuse it) without being a schedulable job itself
+SHADOW = type("_ShadowSlot", (), {"__repr__": lambda s: "<shadow>"})()
 
 
 def max_batch_default(default: int = 128) -> int:
@@ -142,7 +148,8 @@ class FleetJob:
                  n_steps=10, cell_data=None, fields_in=("rho",),
                  fields_out=("rho",), params=(0.1,), priority=0,
                  periodic=(True, True, True), hood_len=1,
-                 checkpoint_every=8, max_retries=3, seed=0, init=None):
+                 checkpoint_every=8, max_retries=3, seed=0, init=None,
+                 redundancy=1):
         self.name = str(name)
         self.length = tuple(int(v) for v in length)
         self.kernel = kernel
@@ -166,6 +173,11 @@ class FleetJob:
         self.max_retries = int(max_retries)
         self.seed = int(seed)
         self.init = init
+        # redundancy=2: dual modular redundancy (DMR) — the scheduler
+        # steps the job in TWO slots and bitwise-compares their
+        # digests at every quantum boundary; a mismatch is a CORRUPT
+        # trip (see dccrg_tpu.integrity)
+        self.redundancy = max(1, int(redundancy))
         # scheduler-owned runtime state
         self.steps_done = 0
         self.retries = 0
@@ -176,6 +188,10 @@ class FleetJob:
         self.digest = None
         self.last_save_step = None
         self._last_trip_step = -1
+        # integrity runtime state: the slot fingerprint recorded at
+        # the end of the last quantum ({field: uint32[2]}), reset by
+        # every sanctioned slot rewrite (admission, restore)
+        self._fp = None
 
     def resolved_kernel(self):
         if callable(self.kernel):
@@ -299,6 +315,20 @@ class GridBatch:
         self.kernel = proto.resolved_kernel()
         self.n_extra = len(proto.params)
         self.schema = dict(self.grid.fields)
+        # the SDC invariant sets: fields the device fingerprints
+        # (32-bit element types bitcast losslessly) and fields the
+        # kernel provably conserves under this bucket's periodicity
+        self.fp_fields = tuple(
+            n for n in sorted(self.schema)
+            if jnp.dtype(self.schema[n][1]).itemsize == 4)
+        self.conserved = integrity.conserved_fields(
+            proto.kernel, proto.periodic, proto.fields_out)
+        # DMR shadow replicas: shadow slot -> primary slot
+        self.shadow_of: dict = {}
+        #: host invariants of the last integrity-on dispatch
+        #: ({"fp_in"/"fp_out": {field: [B, 2]}, "cs_in"/"cs_out":
+        #: {field: [B]}}), None with DCCRG_INTEGRITY=0
+        self.last_inv = None
         self.slots: list = [None] * self.capacity
         self._extras = np.zeros((self.capacity, self.n_extra),
                                 dtype=np.float32)
@@ -313,7 +343,12 @@ class GridBatch:
     # -- program construction (shared per bucket key) -----------------
 
     def _programs(self):
-        key = (self.key, self.capacity)
+        # the integrity flag is part of the cache key: with
+        # DCCRG_INTEGRITY=0 the quantum program is BIT-IDENTICAL to
+        # the pre-SDC one (no fingerprint ops, no extra outputs) —
+        # the negative pin of the SDC defense, not a cheaper check
+        int_on = integrity.integrity_enabled()
+        key = (self.key, self.capacity, int_on)
         hit = _FLEET_PROGRAMS.get(key)
         if hit is not None:
             return hit
@@ -335,7 +370,7 @@ class GridBatch:
 
         vstep = jax.vmap(step_one, in_axes=(0, 0))
 
-        def run_quantum(state, extras, budget, q):
+        def loop(state, extras, budget, q):
             def body(i, st):
                 new = vstep(st, extras)
                 live = i < budget  # [B]: per-slot step budget
@@ -352,6 +387,7 @@ class GridBatch:
 
         watched = [n for n in sorted(self.schema)
                    if jnp.issubdtype(self.schema[n][1], jnp.inexact)]
+        fp_fields, conserved = self.fp_fields, self.conserved
         # locals only: a `self` capture would pin every batch (its
         # [capacity, R, ...] device arrays included) in the
         # module-global program cache for the process lifetime
@@ -364,7 +400,37 @@ class GridBatch:
                 ok = ok & jnp.isfinite(v).reshape(v.shape[0], -1).all(axis=1)
             return ok
 
-        hit = (jax.jit(run_quantum), jax.jit(finite))
+        def measure(state):
+            # per-slot invariants over the OWNED rows, PACKED into two
+            # stacked arrays (one device->host transfer each instead
+            # of one per field): exact uint32 fingerprint pairs
+            # [F, B, 2] in fp_fields order, float conservation sums
+            # [C, B] in conserved order
+            fp = (jnp.stack([
+                jax.vmap(lambda a: integrity.device_fingerprint(a, L))(
+                    state[n]) for n in fp_fields])
+                if fp_fields else jnp.zeros((0, cap, 2), jnp.uint32))
+            cs = (jnp.stack([
+                jnp.sum(state[n][:, :L].reshape(state[n].shape[0], -1),
+                        axis=1, dtype=jnp.float32) for n in conserved])
+                if conserved else jnp.zeros((0, cap), jnp.float32))
+            return fp, cs
+
+        if int_on:
+            def run_quantum(state, extras, budget, q):
+                # the device computes its own fingerprint of the input
+                # AND output state in the same dispatch/HBM residency
+                # pass as the step — the in-program invariant
+                fp_in, cs_in = measure(state)
+                out = loop(state, extras, budget, q)
+                fp_out, cs_out = measure(out)
+                return out, (fp_in, fp_out, cs_in, cs_out)
+
+            fp_now = jax.jit(lambda state: measure(state)[0])
+        else:
+            run_quantum, fp_now = loop, None
+
+        hit = (jax.jit(run_quantum), jax.jit(finite), fp_now)
         if len(_FLEET_PROGRAMS) >= _FLEET_PROGRAMS_MAX:
             _FLEET_PROGRAMS.pop(next(iter(_FLEET_PROGRAMS)))
         _FLEET_PROGRAMS[key] = hit
@@ -381,8 +447,10 @@ class GridBatch:
 
     @property
     def jobs(self):
-        """``[(slot, job)]`` of the occupied slots."""
-        return [(i, j) for i, j in enumerate(self.slots) if j is not None]
+        """``[(slot, job)]`` of the occupied slots (DMR shadow
+        replicas excluded — they are not schedulable jobs)."""
+        return [(i, j) for i, j in enumerate(self.slots)
+                if j is not None and j is not SHADOW]
 
     def admit(self, job: FleetJob, from_grid: bool = True):
         """Place ``job`` into the lowest free slot. With ``from_grid``
@@ -399,10 +467,47 @@ class GridBatch:
         return slot
 
     def clear(self, slot: int) -> None:
-        """Free a slot (job finished/failed/requeued). The slot's
-        bytes stay as they are — budget 0 freezes them and the next
-        occupant overwrites every row."""
+        """Free a slot (job finished/failed/requeued) together with
+        any DMR shadow replicas attached to it. The bytes stay as
+        they are — budget 0 freezes them and the next occupant
+        overwrites every row."""
         self.slots[slot] = None
+        for sh, primary in list(self.shadow_of.items()):
+            if primary == slot:
+                self.slots[sh] = None
+                del self.shadow_of[sh]
+
+    # -- DMR shadow replicas ------------------------------------------
+
+    def admit_shadow(self, primary: int):
+        """Occupy a free slot with a SHADOW replica of ``primary``:
+        same state bytes, same extras, same budgets every quantum —
+        the dual-modular-redundancy pair whose digests the scheduler
+        compares at every quantum boundary. Returns the shadow slot,
+        or None when the batch has no room (the job then runs
+        unreplicated)."""
+        slot = self.free_slot()
+        if slot is None:
+            return None
+        self.slots[slot] = SHADOW
+        self.shadow_of[slot] = primary
+        self._extras[slot] = self._extras[primary]
+        self.sync_shadow(primary)
+        return slot
+
+    def shadows(self, primary: int) -> list:
+        """The shadow slots replicating ``primary``."""
+        return [sh for sh, pr in self.shadow_of.items() if pr == primary]
+
+    def sync_shadow(self, primary: int) -> None:
+        """Re-copy ``primary``'s rows into its shadow slots bit-exactly
+        (admission, and after any sanctioned primary rewrite — a
+        rollback or migration — so the replicas re-diverge only
+        through real corruption)."""
+        for sh in self.shadows(primary):
+            for n in self.schema:
+                self.state[n] = self.state[n].at[sh].set(
+                    self.state[n][primary])
 
     def read_grid(self, slot: int) -> None:
         """Scatter the scratch grid's field data into ``slot``
@@ -423,20 +528,45 @@ class GridBatch:
         """Host copies of ``slot``'s field arrays (``[R, *shape]``)."""
         return {n: np.asarray(self.state[n][slot]) for n in self.schema}
 
+    def insert(self, slot: int, host_state: dict) -> None:
+        """Write :meth:`extract`-shaped host arrays into ``slot``
+        bit-exactly — the migration/audit primitive (bucket rebuilds,
+        shadow re-execution). Only the target slot's rows change."""
+        for n, arr in host_state.items():
+            self.state[n] = self.state[n].at[slot].set(arr)
+
     # -- the batched dispatch -----------------------------------------
 
     def step(self, budget) -> int:
         """Advance slot ``k`` by ``budget[k]`` steps in ONE jitted
         batched dispatch; returns the quantum length (max budget).
         Slots with budget 0 (empty, finished, tripped-and-masked) are
-        frozen bit-exactly."""
+        frozen bit-exactly. With integrity on, the dispatch also
+        returns the fused per-slot invariants (entry/exit
+        fingerprints + conservation sums), published on
+        :attr:`last_inv` as host arrays."""
         budget = np.asarray(budget, dtype=np.int32)
         q = int(budget.max()) if len(budget) else 0
         if q <= 0:
             return 0
-        fn, _finite = self._programs()
-        self.state = fn(self.state, jnp.asarray(self._extras),
-                        jnp.asarray(budget), jnp.int32(q))
+        fn, _finite, fp_now = self._programs()
+        out = fn(self.state, jnp.asarray(self._extras),
+                 jnp.asarray(budget), jnp.int32(q))
+        if fp_now is None:  # DCCRG_INTEGRITY=0: the pre-SDC program
+            self.state, self.last_inv = out, None
+        else:
+            self.state, inv = out
+            fp_in, fp_out, cs_in, cs_out = jax.device_get(inv)
+            self.last_inv = {
+                "fp_in": {n: fp_in[i]
+                          for i, n in enumerate(self.fp_fields)},
+                "fp_out": {n: fp_out[i]
+                           for i, n in enumerate(self.fp_fields)},
+                "cs_in": {n: cs_in[i]
+                          for i, n in enumerate(self.conserved)},
+                "cs_out": {n: cs_out[i]
+                           for i, n in enumerate(self.conserved)},
+            }
         self.dispatches += 1
         return q
 
@@ -445,8 +575,29 @@ class GridBatch:
         every watched (inexact) field element of the slot is finite.
         One device round-trip for the whole fleet; a poisoned slot
         cannot hide behind its neighbors."""
-        _fn, finite = self._programs()
+        _fn, finite, _fp = self._programs()
         return np.asarray(finite(self.state))
+
+    def fingerprint_slots(self) -> dict:
+        """Per-slot integrity fingerprints of the CURRENT state:
+        ``{field: uint32[capacity, 2]}``. The pairs are exact
+        order-independent sums, so they compare bitwise against the
+        fused in-dispatch fingerprints (:attr:`last_inv`) — any
+        difference means the slot's bytes changed outside a sanctioned
+        path. Raises RuntimeError with integrity off (there is no
+        fingerprint program then, by design)."""
+        _fn, _finite, fp_now = self._programs()
+        if fp_now is None:
+            raise RuntimeError(
+                "fingerprint_slots needs DCCRG_INTEGRITY enabled")
+        stack = np.asarray(fp_now(self.state))
+        return {n: stack[i] for i, n in enumerate(self.fp_fields)}
+
+    def slot_fingerprint(self, slot: int) -> dict:
+        """One slot's ``{field: (s1, s2)}`` from
+        :meth:`fingerprint_slots`."""
+        return {n: (int(v[slot, 0]), int(v[slot, 1]))
+                for n, v in self.fingerprint_slots().items()}
 
     def poison(self, slot: int, fld: str, cells, value) -> None:
         """Write ``value`` into ``fld`` at ``cells`` of ONE slot — the
@@ -454,6 +605,17 @@ class GridBatch:
         (:func:`dccrg_tpu.faults.poison_fleet`)."""
         _dev, rows = self.grid._host_rows(cells)
         self.state[fld] = self.state[fld].at[slot, rows].set(value)
+
+    def flip(self, slot: int, fld: str, cells, bit: int) -> None:
+        """Land a FINITE bit-flip in ``fld`` at ``cells`` of ONE slot
+        — the silent-corruption landing pad
+        (:func:`dccrg_tpu.faults.flip_fleet`). Invisible to
+        :meth:`finite_slots` by construction; only the integrity
+        layer can see it."""
+        _dev, rows = self.grid._host_rows(cells)
+        vals = np.asarray(self.state[fld][slot, rows])
+        self.state[fld] = self.state[fld].at[slot, rows].set(
+            faults.flip_values(vals, bit))
 
     def digest(self, slot: int) -> str:
         """SHA-256 over the slot's OWNED cell bytes — matches
@@ -478,7 +640,9 @@ def _jobs_from_spec(spec: dict) -> list:
     unique), ``n`` (cube edge) or ``length`` [x, y, z], ``kernel``
     (registry name), ``steps``, ``params`` (list of floats; ``dt`` is
     shorthand for one), ``priority``, ``seed``, ``checkpoint_every``,
-    ``periodic`` [bool, bool, bool]."""
+    ``periodic`` [bool, bool, bool], ``redundancy`` (2 = DMR: two
+    slots step the job and their digests are compared every
+    quantum)."""
     jobs = []
     for row in spec.get("jobs", []):
         if "name" not in row:
@@ -496,6 +660,7 @@ def _jobs_from_spec(spec: dict) -> list:
             seed=int(row.get("seed", 0)),
             periodic=tuple(row.get("periodic", (True, True, True))),
             checkpoint_every=int(row.get("checkpoint_every", 8)),
+            redundancy=int(row.get("redundancy", 1)),
         ))
     return jobs
 

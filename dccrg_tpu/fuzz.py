@@ -487,18 +487,23 @@ class GridFuzzer:
 # -- fleet-isolation scenario (the fleet layer's oracle) --------------
 
 def fleet_isolation_case(seed: int, jobs: int = 8, n: int = 8,
-                         quantum: int = 4) -> dict:
+                         quantum: int = 4, fault: str = "nan") -> dict:
     """One seeded fleet-isolation scenario: ``jobs`` randomized
     same-shape scenario runs (random kernels, dt, seeds, step counts,
     priorities) are multiplexed through one
     :class:`~dccrg_tpu.scheduler.FleetScheduler` batch while a
-    :class:`~dccrg_tpu.faults.FaultPlan` poisons ONE random victim
-    job's field with NaN at a random step. The oracle is the
-    one-grid-at-a-time path: every job — the victim included, whose
-    trip must roll back and replay clean — must finish with a final-
-    state digest bitwise equal to its solo ``Grid.run_steps`` run,
-    and ONLY the victim may trip. Raises :class:`FuzzFailure`;
-    returns ``{victim, trips, report}`` on success."""
+    :class:`~dccrg_tpu.faults.FaultPlan` corrupts ONE random victim
+    job's field at a random step — ``fault="nan"`` poisons it with
+    NaN (the numerics-watchdog class), ``fault="flip"`` lands a
+    FINITE silent bit-flip (the SDC class, invisible to the
+    finiteness watchdog: only the integrity invariants can convict).
+    The oracle is the one-grid-at-a-time path: every job — the victim
+    included, whose trip must roll back and replay clean — must
+    finish with a final-state digest bitwise equal to its solo
+    ``Grid.run_steps`` run, ONLY the victim may trip, and for the SDC
+    case the victim's trip must be a CORRUPT verdict. Raises
+    :class:`FuzzFailure`; returns ``{victim, trips, report}`` on
+    success."""
     import tempfile
 
     from .fleet import FleetJob, run_solo
@@ -524,13 +529,18 @@ def fleet_isolation_case(seed: int, jobs: int = 8, n: int = 8,
     victim = specs[int(rng.integers(0, jobs))]
     poison_step = int(rng.integers(1, victim.n_steps + 1))
     plan = FaultPlan(seed=seed)
-    plan.nan_poison("rho", step=poison_step, job=victim.name)
+    if fault == "flip":
+        plan.silent_flip("rho", step=poison_step, job=victim.name)
+        site = "step.flip"
+    else:
+        plan.nan_poison("rho", step=poison_step, job=victim.name)
+        site = "step.poison"
     with tempfile.TemporaryDirectory(prefix="dccrg_fleet_fuzz_") as wd:
         with plan:
             report = FleetScheduler(wd, specs, quantum=quantum).run()
-    if plan.fired("step.poison") != 1:
+    if plan.fired(site) != 1:
         raise FuzzFailure(
-            f"fleet poison for {victim.name} at step {poison_step} "
+            f"fleet {fault} for {victim.name} at step {poison_step} "
             f"never landed", seed=seed)
     for j in specs:
         row = report.get(j.name)
@@ -540,16 +550,21 @@ def fleet_isolation_case(seed: int, jobs: int = 8, n: int = 8,
         if row["digest"] != solo[j.name]:
             raise FuzzFailure(
                 f"fleet job {j.name} final digest differs from its "
-                f"solo run (victim was {victim.name}, poisoned after "
+                f"solo run (victim was {victim.name}, {fault} after "
                 f"step {poison_step})", seed=seed)
         if j.name != victim.name and row["trips"]:
             raise FuzzFailure(
                 f"non-victim job {j.name} tripped {row['trips']} "
-                f"time(s); only {victim.name} was poisoned", seed=seed)
+                f"time(s); only {victim.name} was corrupted",
+                seed=seed)
     if report[victim.name]["trips"] < 1:
         raise FuzzFailure(
-            f"victim {victim.name} (poisoned after step {poison_step} "
+            f"victim {victim.name} ({fault} after step {poison_step} "
             f"of {victim.n_steps}) never tripped", seed=seed)
+    if fault == "flip" and report[victim.name]["sdc_trips"] < 1:
+        raise FuzzFailure(
+            f"victim {victim.name}'s silent flip tripped, but not as "
+            "a CORRUPT verdict", seed=seed)
     return {"victim": victim.name,
             "trips": report[victim.name]["trips"], "report": report}
 
@@ -585,13 +600,16 @@ def _main(argv=None) -> int:
 
         t0 = time_mod.time()
         for s in range(args.fleet):
+            # even seeds exercise the NaN class, odd seeds the silent
+            # (finite bit-flip) SDC class — same isolation oracle
+            fault = "flip" if s % 2 else "nan"
             try:
-                out = fleet_isolation_case(s)
+                out = fleet_isolation_case(s, fault=fault)
             except FuzzFailure as e:
                 print(f"FAIL {e}")
                 return 1
-            print(f"fleet seed {s}: victim {out['victim']} tripped "
-                  f"{out['trips']}x, all digests match solo")
+            print(f"fleet seed {s} ({fault}): victim {out['victim']} "
+                  f"tripped {out['trips']}x, all digests match solo")
         print(f"OK {args.fleet} fleet seed(s), "
               f"{time_mod.time() - t0:.1f}s")
         return 0

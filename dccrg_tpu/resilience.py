@@ -306,6 +306,18 @@ def read_sidecar(filename: str):
                     and isinstance(p.get("step"), int)
                     and isinstance(p.get("digest"), int)):
                 raise ValueError("implausible delta record")
+        # SDC-audit saves extend the record with a payload fingerprint
+        # ({field: [s1, s2, nbytes]}, see resilience.audit_checkpoint);
+        # reject a mangled one like the rest of the geometry
+        integ = rec.get("integrity")
+        if integ is not None and not (
+                isinstance(integ, dict)
+                and all(isinstance(k, str) and isinstance(v, list)
+                        and len(v) == 3
+                        and all(isinstance(x, int) for x in v)
+                        and v[2] > 0
+                        for k, v in integ.items())):
+            raise ValueError("implausible integrity record")
         return rec
     except (ValueError, KeyError, TypeError) as e:
         raise CheckpointCorruptionError(
@@ -651,6 +663,9 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
                                       chunk_bytes=chunk_bytes)
                 if sidecar_extra:
                     rec.update(sidecar_extra)
+                integ = _integrity_record(grid, fields, variable)
+                if integ:
+                    rec["integrity"] = integ
             # drop any previous sidecar BEFORE the rename: a crash in
             # this window leaves the new file with no sidecar — which
             # strict load refuses conservatively — never a new file
@@ -729,6 +744,81 @@ def save_delta_checkpoint(grid, filename: str, *, parent_path: str,
                            variable=variable, retries=retries,
                            backoff=backoff, chunk_bytes=chunk_bytes,
                            fields=fields, sidecar_extra=extra)
+
+
+def _integrity_record(grid, fields, variable) -> dict:
+    """The sidecar ``integrity`` record: a payload fingerprint
+    ``{field: [s1, s2, nbytes]}`` computed from the grid's LIVE
+    device state (not the written bytes) via
+    :func:`dccrg_tpu.integrity.grid_fingerprint`. Because the
+    fingerprint is order-independent and exact, ``audit_checkpoint``
+    can later re-derive it from the file's payload columns alone:
+    bytes that rotted between device memory and the published file —
+    or at rest afterwards, even under a plausible-looking CRC epoch —
+    no longer match. Ragged (variable) fields are excluded (the file
+    stores them truncated to their counts; the live rows differ).
+    Empty when ``DCCRG_INTEGRITY=0`` or on multi-process grids (the
+    two-phase commit path owns those sidecars)."""
+    from . import integrity
+
+    if not integrity.integrity_enabled():
+        return {}
+    var = variable or {}
+    names = [n for n in sorted(fields if fields is not None
+                               else grid.fields) if n not in var]
+    if not names:
+        return {}
+    out = {}
+    fp = integrity.grid_fingerprint(grid, names)
+    for n in names:
+        shape, dtype = grid.fields[n]
+        nbytes = int(np.prod(shape, dtype=np.int64) or 1) * \
+            np.dtype(dtype).itemsize
+        out[n] = [int(fp[n][0]), int(fp[n][1]), nbytes]
+    return out
+
+
+def audit_checkpoint(filename: str) -> "dict | None":
+    """Offline at-rest SDC audit: re-derive the payload fingerprint of
+    ``filename`` from its bytes and compare against the ``integrity``
+    record its sidecar captured from live device state at save time.
+    Returns ``{field: (ok, got_pair, want_pair)}``, or None when the
+    sidecar carries no integrity record (pre-SDC save, or
+    ``DCCRG_INTEGRITY=0``). Complements the CRC chunk pass: CRCs
+    verify the file matches what was WRITTEN; the fingerprint verifies
+    what was written matches what the simulation actually HELD —
+    corruption on the serialization path, or bit rot under a
+    regenerated/intact-looking CRC epoch, fails here and only here.
+    The ``python -m dccrg_tpu.resilience audit`` subcommand prints
+    this."""
+    from . import checkpoint as checkpoint_mod
+    from . import integrity
+
+    rec = read_sidecar(filename)
+    if rec is None:
+        raise CheckpointCorruptionError(
+            f"{filename}: no checksum sidecar; nothing to audit "
+            "against")
+    integ = rec.get("integrity")
+    if not integ:
+        return None
+    # synthesize a bytes-only schema: the column walk needs each
+    # fixed field's serialized width and the sorted-name order, both
+    # of which the record carries — the audit needs no grid schema
+    fields = {n: ((int(v[2]),), np.uint8) for n, v in integ.items()}
+    raw = np.memmap(filename, dtype=np.uint8, mode="r")
+    try:
+        meta = checkpoint_mod.parse_metadata(
+            raw, int(rec.get("header_size", 0)))
+        cols = checkpoint_mod.payload_columns(raw, meta, fields)
+        out = {}
+        for n, v in integ.items():
+            got = integrity.fingerprint_rows(cols[n])
+            want = (int(v[0]) & 0xFFFFFFFF, int(v[1]) & 0xFFFFFFFF)
+            out[n] = (got == want, got, want)
+        return out
+    finally:
+        del raw
 
 
 def _restore_sidecar(side: str, old_side) -> None:
@@ -1149,14 +1239,18 @@ def guarded_step(grid, kernel, fields_in, fields_out, n_steps=1, *,
 # any REAL trip outranks — a rank that tripped rolls everyone back
 # first and the still-set preempt flag is re-polled at the next
 # boundary; _TRIP_ROLLBACK.._TRIP_OOM are recoverable (mutation /
-# numerics / OOM -> every rank rolls back together); >= _TRIP_FATAL
-# means a rank hit a non-recoverable error and every OTHER rank raises
-# in sync instead of hanging in the dead rank's abandoned collectives
+# numerics / silent corruption / OOM -> every rank rolls back
+# together; _TRIP_CORRUPT is an integrity-invariant verdict, see
+# dccrg_tpu.integrity — finite wrong bits the numerics code cannot
+# see); >= _TRIP_FATAL means a rank hit a non-recoverable error and
+# every OTHER rank raises in sync instead of hanging in the dead
+# rank's abandoned collectives
 _TRIP_INTERRUPT = 1
 _TRIP_ROLLBACK = 2   # MutationAbortedError
 _TRIP_NUMERICS = 3
-_TRIP_OOM = 4
-_TRIP_FATAL = 5
+_TRIP_CORRUPT = 4    # integrity invariant (SDC) verdict
+_TRIP_OOM = 5
+_TRIP_FATAL = 6
 
 
 def watchdog_interval(default: int = 0) -> int:
@@ -1193,9 +1287,22 @@ class ResilientRunner:
                  check_every=None, checkpoint_every=10,
                  checkpoint_seconds=0.0, max_retries=3,
                  backoff=0.05, header=b"", variable=None,
-                 diagnostics_dir=None, interrupt_poll=None):
+                 diagnostics_dir=None, interrupt_poll=None,
+                 conserved_fields=None):
         self.grid = grid
         self.step_fn = step_fn
+        # SDC defense (dccrg_tpu.integrity): fields whose global sum
+        # the caller's step kernel provably conserves. At every
+        # watchdog boundary the runner recomputes the device-side
+        # collective sums and compares them against the values
+        # recorded at the last checkpoint; a drift beyond
+        # integrity.sum_tolerance — finite, plausible bits the
+        # numerics watchdog cannot see — is a _TRIP_CORRUPT verdict
+        # put through coord.trip_consensus so EVERY rank rolls back
+        # together. Off (None/empty, or DCCRG_INTEGRITY=0): zero
+        # overhead, no extra program.
+        self.conserved_fields = tuple(conserved_fields or ())
+        self._integrity_base = None  # sums at the rollback target
         # optional step-boundary interrupt hook (the supervision
         # layer's preemption poll): truthy -> the _TRIP_INTERRUPT code
         # joins this step's trip consensus, and when it wins on every
@@ -1247,6 +1354,52 @@ class ResilientRunner:
         self._ckpt_step = self.step
         self._last_save_t = time.monotonic()
         self.checkpoints += 1
+        if self._integrity_on():
+            # the conservation baseline the boundary drift check
+            # compares against — recorded at the rollback target, so
+            # a corrupt verdict always rolls back to state whose
+            # invariants were verified clean
+            self._integrity_base = self._conservation_sums()
+
+    def _integrity_on(self) -> bool:
+        from . import integrity
+
+        return bool(self.conserved_fields) and integrity.integrity_enabled()
+
+    def _conservation_sums(self):
+        from . import integrity
+
+        return integrity.conservation_sums(self.grid,
+                                           self.conserved_fields)
+
+    def _integrity_drift(self):
+        """The boundary SDC check: None when clean, else a details
+        dict naming each conserved field whose device-side global sum
+        drifted beyond tolerance since the last checkpoint. The sums
+        are a replicated collective (comm.field_sums), so every rank
+        computes the identical verdict."""
+        from . import integrity
+
+        if not self._integrity_on() or self._integrity_base is None:
+            return None
+        now = self._conservation_sums()
+        steps = max(1, self.step - (self._ckpt_step or 0))
+        details = {}
+        for i, name in enumerate(self.conserved_fields):
+            shape, _dt = self.grid.fields[name]
+            n_el = len(self.grid.plan.cells) * int(
+                np.prod(shape, dtype=int) or 1)
+            tol = integrity.sum_tolerance(self._integrity_base[i],
+                                          n_el, steps)
+            drift = abs(float(now[i]) - float(self._integrity_base[i]))
+            if drift > tol:
+                details[name] = np.empty(0, np.uint64)
+                logger.warning(
+                    "integrity drift in %r: conservation sum moved "
+                    "%g (tolerance %g) since the step-%s checkpoint "
+                    "— silent corruption", name, drift, tol,
+                    self._ckpt_step)
+        return details or None
 
     def _rollback(self) -> None:
         # chain-aware when the target is a delta: the shared primitive
@@ -1282,7 +1435,7 @@ class ResilientRunner:
         self.trips.append(bundle)
         return bundle
 
-    def _trip(self, details=None) -> None:
+    def _trip(self, details=None, kind="numerics") -> None:
         from . import verify
 
         if details is None:
@@ -1293,15 +1446,26 @@ class ResilientRunner:
         self._retry_streak += 1
         bundle = self._dump_diagnostics(details)
         logger.warning(
-            "watchdog trip at step %d (fields %s); rolling back to "
-            "step %s (retry %d/%d)", self.step,
+            "watchdog trip (%s) at step %d (fields %s); rolling back "
+            "to step %s (retry %d/%d)", kind, self.step,
             list(details) or "<ghost rows>", self._ckpt_step,
             self._retry_streak, self.max_retries)
         if self._retry_streak > self.max_retries:
-            raise ResilienceExhaustedError(
-                f"watchdog tripped {self._retry_streak} times at step "
-                f"{self.step} without progress; diagnostics: "
-                f"{bundle.get('path', '<unwritten>')}")
+            msg = (f"watchdog tripped {self._retry_streak} times at "
+                   f"step {self.step} without progress; diagnostics: "
+                   f"{bundle.get('path', '<unwritten>')}")
+            if kind == "corrupt":
+                # persistent SDC: the typed subclass names the class
+                # of failure (likely a defective device, not a
+                # transient upset) while generic handlers catching
+                # ResilienceExhaustedError keep working
+                from . import integrity
+
+                raise integrity.IntegrityError(
+                    "integrity invariants failed on every retry — "
+                    "persistent silent corruption; " + msg,
+                    details={n: "invariant drift" for n in details})
+            raise ResilienceExhaustedError(msg)
         if self.backoff:
             time.sleep(self.backoff * (2 ** (self._retry_streak - 1)))
         self._rollback()
@@ -1408,6 +1572,7 @@ class ResilientRunner:
                 raise RunInterrupted(self.step)
             self.step += 1
             faults.poison_step(self.grid, self.step)
+            faults.flip_step(self.grid, self.step)
             ckpt_due = (bool(self.checkpoint_every)
                         and self.step % self.checkpoint_every == 0)
             if not ckpt_due and self.checkpoint_seconds > 0:
@@ -1418,13 +1583,29 @@ class ResilientRunner:
                 # all save) before entering the collective save path
                 ckpt_due = bool(coord.trip_consensus(self.grid, int(due)))
             # a checkpoint step ALWAYS checks first — the rollback
-            # target must never capture unverified (poisoned) state,
-            # whatever the check/checkpoint cadence ratio
+            # target must never capture unverified (poisoned OR
+            # silently corrupted) state, whatever the check/checkpoint
+            # cadence ratio
             if (ckpt_due or self.step % self.check_every == 0
-                    or self.step == n_steps) \
-                    and not check_finite(self.grid, self.fields):
-                self._trip()
-                continue
+                    or self.step == n_steps):
+                if not check_finite(self.grid, self.fields):
+                    self._trip()
+                    continue
+                # SDC boundary check (conserved_fields opt-in): the
+                # drift verdict is computed from a replicated
+                # collective, but the trip still goes through the
+                # consensus all-reduce — any rank's CORRUPT verdict
+                # (however asymmetric a future detector might be)
+                # rolls every rank back together, and the mp harness
+                # pins that all ranks agree on the verdict
+                drift = self._integrity_drift()
+                if self._integrity_on() and int(coord.trip_consensus(
+                        self.grid,
+                        _TRIP_CORRUPT if drift else 0)) >= _TRIP_CORRUPT:
+                    self._trip(details=drift or {
+                        "remote_rank_corrupt": np.empty(0, np.uint64)},
+                        kind="corrupt")
+                    continue
             if ckpt_due:
                 self._save()
         return self
@@ -1516,6 +1697,16 @@ def _tool_main(argv) -> int:
     c.add_argument("dir")
     c.add_argument("--stem", default=None,
                    help="only checkpoints named <stem>_<step>.dc[d]")
+    a = sub.add_parser("audit", help="at-rest SDC audit: recompute a "
+                                     "checkpoint's payload integrity "
+                                     "fingerprint and compare against "
+                                     "the record its sidecar captured "
+                                     "from live device state at save "
+                                     "time (catches corruption the "
+                                     "CRC pass cannot: serialization-"
+                                     "path damage, rot under an "
+                                     "intact-looking CRC epoch)")
+    a.add_argument("file")
     g = sub.add_parser("gc", help="prune a checkpoint directory by the "
                                   "keep-last-K / keep-every-N retention "
                                   "policy — chain-aware: whole chains "
@@ -1529,6 +1720,43 @@ def _tool_main(argv) -> int:
     g.add_argument("--apply", action="store_true",
                    help="actually delete (default: report only)")
     args = ap.parse_args(argv)
+
+    if args.cmd == "audit":
+        # CRC pass first: a file that fails its chunk CRCs is plain
+        # detectable corruption, not the silent class
+        try:
+            bad = verify_checkpoint(args.file)
+        except CheckpointCorruptionError as e:
+            print(f"CORRUPT {args.file}: {e}")
+            return 1
+        if bad:
+            print(f"CORRUPT {args.file}: chunk CRC mismatch "
+                  f"(chunks {bad}) — detectable corruption, not SDC")
+            return 1
+        try:
+            rep = audit_checkpoint(args.file)
+        except CheckpointCorruptionError as e:
+            print(f"CORRUPT {args.file}: {e}")
+            return 1
+        if rep is None:
+            print(f"NO-RECORD {args.file}: sidecar carries no "
+                  "integrity fingerprint (pre-SDC save or "
+                  "DCCRG_INTEGRITY=0)")
+            return 2
+        rc = 0
+        for name in sorted(rep):
+            ok, got, want = rep[name]
+            if ok:
+                print(f"OK {args.file}: field {name} fingerprint "
+                      f"({got[0]:#010x}, {got[1]:#010x})")
+            else:
+                rc = 1
+                print(f"SDC {args.file}: field {name} payload "
+                      f"fingerprint ({got[0]:#010x}, {got[1]:#010x}) "
+                      f"!= device-state record ({want[0]:#010x}, "
+                      f"{want[1]:#010x}) — the CRCs sealed corrupted "
+                      "bytes")
+        return rc
 
     if args.cmd == "verify":
         if is_delta_checkpoint(args.file):
@@ -1598,13 +1826,15 @@ def _main(argv=None) -> int:
     """CLI probe for shell scripts: ``python -m dccrg_tpu.resilience
     [--timeout S] [--retries N] [--platform P]`` exits 0 and prints the
     devices when the backend answers, 1 otherwise — never hangs. The
-    checkpoint-maintenance subcommands ``verify <file>`` and ``gc
-    <dir> [--keep-last K] [--keep-every N] [--apply]`` run without
-    touching the accelerator at all (see :func:`_tool_main`)."""
+    checkpoint-maintenance subcommands ``verify <file>``, ``audit
+    <file>`` (at-rest SDC fingerprint audit), ``chain <dir>`` and
+    ``gc <dir> [--keep-last K] [--keep-every N] [--apply]`` run
+    without touching the accelerator at all (see
+    :func:`_tool_main`)."""
     import argparse
 
     argv = list(sys.argv[1:] if argv is None else argv)
-    if argv and argv[0] in ("verify", "gc", "chain"):
+    if argv and argv[0] in ("verify", "gc", "chain", "audit"):
         return _tool_main(argv)
     ap = argparse.ArgumentParser(description=_main.__doc__)
     ap.add_argument("--timeout", type=float, default=90.0)

@@ -30,6 +30,21 @@ per-run lifecycle machinery of :mod:`dccrg_tpu.supervise` PER JOB:
   (unattributed) ``RESOURCE_EXHAUSTED`` from the batched dispatch
   requeues the lower-priority half of the bucket's jobs to shrink
   the working set;
+- **SDC defense** (:mod:`dccrg_tpu.integrity`): every batched
+  dispatch returns fused per-slot entry/exit fingerprints and
+  conservation sums (``DCCRG_INTEGRITY``, on by default); the
+  scheduler compares them exactly (integer fingerprints) or against
+  the expected drift (conservation sums) every quantum, runs a
+  sampled **shadow-execution audit** every ``DCCRG_AUDIT_EVERY``
+  ticks (re-execute one slot's last quantum from its pre-quantum
+  state in a spare slot or the solo path, compare bitwise), and
+  bitwise-compares **DMR** replicas (``FleetJob(redundancy=2)``) at
+  every quantum boundary. A CORRUPT verdict rolls back ONLY the
+  victim from its own checkpoint chain (the NaN discipline, bounded
+  retries) and marks the batch's device lane suspect; a lane
+  exceeding ``DCCRG_QUARANTINE_AFTER`` verdicts is **quarantined** —
+  its buckets rebuild on surviving lanes with every admitted job
+  migrated bit-exactly;
 - **preemption**: the loop polls the supervision layer's preempt
   flag (SIGTERM/SIGINT handlers, :func:`~dccrg_tpu.supervise
   .request_preempt`, or a faked
@@ -53,8 +68,8 @@ from contextlib import nullcontext
 
 import numpy as np
 
-from . import faults, resilience, supervise
-from .fleet import (FleetJob, GridBatch, max_batch_default,
+from . import faults, integrity, resilience, supervise
+from .fleet import (SHADOW, FleetJob, GridBatch, max_batch_default,
                     quantum_default)
 from .grid import bucket_capacity
 
@@ -93,7 +108,8 @@ class FleetScheduler:
     def __init__(self, checkpoint_dir, jobs=(), *, max_batch=None,
                  quantum=None, keep_last=None, keep_every=0,
                  resume=True, devices=None,
-                 install_signal_handlers=False):
+                 install_signal_handlers=False, audit_every=None,
+                 quarantine_after=None):
         self.dir = str(checkpoint_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.max_batch = (max_batch_default() if max_batch is None
@@ -106,6 +122,25 @@ class FleetScheduler:
         self.resume = bool(resume)
         self.devices = list(devices) if devices else [None]
         self._install = bool(install_signal_handlers)
+        # SDC defense knobs: shadow-audit cadence in scheduler ticks
+        # (DCCRG_AUDIT_EVERY, 0 = off) and the per-device corrupt-
+        # verdict count that quarantines a lane
+        # (DCCRG_QUARANTINE_AFTER, 0 = never)
+        self.audit_every = (integrity.audit_every_default()
+                            if audit_every is None
+                            else max(0, int(audit_every)))
+        self.quarantine_after = (integrity.quarantine_after_default()
+                                 if quarantine_after is None
+                                 else max(0, int(quarantine_after)))
+        # per-lane suspect accounting: corrupt verdicts attributed to
+        # each entry of `devices` (fingerprint/conservation trips,
+        # audit mismatches, DMR divergences)
+        self.suspects = [0] * len(self.devices)
+        self.quarantined: set = set()  # lane indices taken out
+        self.audits = 0
+        self.audit_failures = 0
+        self._audit_rr = 0
+        self._pending_quarantine: set = set()
         self._queue: list = []  # heap of (-priority, seq, job)
         self._seq = itertools.count()
         self._by_name: dict = {}
@@ -141,24 +176,35 @@ class FleetScheduler:
 
     # -- admission + backfill -----------------------------------------
 
+    def live_lanes(self) -> list:
+        """Device-lane indices not quarantined by the SDC layer."""
+        return [i for i in range(len(self.devices))
+                if i not in self.quarantined]
+
     def _bucket_for(self, job: FleetJob) -> GridBatch:
         """A bucket instance with a free slot for ``job``'s key, or
-        None. Creates a new instance (round-robin over ``devices``)
-        sized to the demand visible NOW — bucket_capacity-rounded so
-        later fluctuations reuse the compile — when every existing
-        one is full and the device list allows another."""
+        None. Creates a new instance (round-robin over the live,
+        non-quarantined ``devices`` lanes) sized to the demand visible
+        NOW — bucket_capacity-rounded so later fluctuations reuse the
+        compile — when every existing one is full and the lane list
+        allows another."""
         key = job.bucket_key()
         insts = self.buckets.setdefault(key, [])
         for b in insts:
             if b.free_slot() is not None:
                 return b
-        if len(insts) >= len(self.devices):
+        lanes = self.live_lanes()
+        if len(insts) >= len(lanes):
             return None
-        same_key = 1 + sum(1 for _p, _s, j in self._queue
-                           if j.bucket_key() == key)
+        # DMR jobs occupy redundancy slots each (primary + shadows):
+        # size the bucket for the SLOT demand, not the job count
+        same_key = job.redundancy + sum(
+            j.redundancy for _p, _s, j in self._queue
+            if j.bucket_key() == key)
         cap = min(self.max_batch, bucket_capacity(same_key))
-        b = GridBatch(job, cap,
-                      device=self.devices[self._next_dev % len(self.devices)])
+        lane = lanes[self._next_dev % len(lanes)]
+        b = GridBatch(job, cap, device=self.devices[lane])
+        b.lane = lane
         self._next_dev += 1
         insts.append(b)
         return b
@@ -203,6 +249,13 @@ class FleetScheduler:
             job.last_save_step = restored
         slot = batch.admit(job, from_grid=True)
         job.status = "running"
+        # the slot was just (re)written through a sanctioned path:
+        # the integrity fingerprint baseline restarts here
+        job._fp = None
+        if job.redundancy >= 2 and batch.admit_shadow(slot) is None:
+            logger.warning(
+                "DMR job %s: no free slot for its shadow replica; "
+                "running unreplicated", job.name)
         logger.debug("admitted %s at step %d into slot %d", job.name,
                      job.steps_done, slot)
         if restored is None:
@@ -258,9 +311,11 @@ class FleetScheduler:
     # -- trips: per-slot isolation ------------------------------------
 
     def _trip(self, batch, slot, job, kind) -> None:
-        """One job tripped (NaN in its slot, or a job-scoped OOM).
-        Neighbors are untouched by construction; this job rolls back
-        from its own checkpoint — in place for numerics trips, via
+        """One job tripped (NaN in its slot, a CORRUPT integrity
+        verdict, or a job-scoped OOM). Neighbors are untouched by
+        construction; this job rolls back from its own checkpoint —
+        in place for numerics/corrupt trips (the same recovery: the
+        checkpoint chain predates the bad bytes either way), via
         requeue for OOMs (the slot is freed so the working set
         shrinks; re-admission restores from the same stem, possibly
         into a different slot or bucket)."""
@@ -292,6 +347,11 @@ class FleetScheduler:
             self._finish(batch, slot, job, status="failed")
             return
         batch.read_grid(slot)
+        # sanctioned rewrite: fingerprint baseline resets, and any DMR
+        # shadow re-syncs to the restored bytes (the replicas must
+        # re-diverge only through real corruption)
+        job._fp = None
+        batch.sync_shadow(slot)
         job.steps_done = restored
         # re-baseline the cadence like _admit_into: a fallback to an
         # OLDER checkpoint would otherwise leave steps_done -
@@ -307,6 +367,8 @@ class FleetScheduler:
         self.report[job.name] = {
             "status": status, "steps": job.steps_done,
             "digest": job.digest, "trips": len(job.trips),
+            "sdc_trips": sum(1 for k, _s in job.trips
+                             if k == "corrupt"),
             "retries_final": job.retries, "requeues": job.requeues,
             "transient_retries": job.transient_retries,
         }
@@ -362,6 +424,13 @@ class FleetScheduler:
             budget[slot] = min(self.quantum,
                                max(0, job.n_steps - job.steps_done))
             prev[slot] = job.steps_done
+        # DMR shadow replicas step in lockstep with their primary
+        for sh, primary in batch.shadow_of.items():
+            budget[sh] = budget[primary]
+        # shadow-execution audit: snapshot ONE slot's pre-quantum
+        # state at the sampled cadence; after the dispatch the same
+        # quantum is re-executed from it and compared bitwise
+        audit_slot, audit_pre = self._pick_audit(batch, active, budget)
         try:
             batch.step(budget)
         except Exception as e:  # noqa: BLE001 - filtered below
@@ -369,20 +438,22 @@ class FleetScheduler:
                 raise
             self._batch_oom(batch, e)
             return
+        inv = batch.last_inv  # fused invariants (None: integrity off)
         for slot, job in active:
             job.steps_done += int(budget[slot])
-        # fleet-scoped NaN poison (chaos tests): land scheduled
-        # poisons for the steps this quantum advanced each job through
+        # fleet-scoped fault landing pads (chaos tests): NaN poisons
+        # and FINITE silent flips for the steps this quantum advanced
+        # each job through
         if faults.active() is not None:
             for slot, job in active:
                 for fld, cells, value, _ps in faults.poison_fleet(
                         job.name, prev[slot], job.steps_done):
-                    if cells is None:
-                        local = batch.grid.plan.cells
-                        pick = int(faults.active().rng.integers(
-                            0, len(local)))
-                        cells = [int(local[pick])]
-                    batch.poison(slot, fld, cells, value)
+                    batch.poison(slot, fld,
+                                 self._fault_cells(batch, cells), value)
+                for fld, cells, bit, _ps in faults.flip_fleet(
+                        job.name, prev[slot], job.steps_done):
+                    batch.flip(slot, fld,
+                               self._fault_cells(batch, cells), bit)
         # per-slot watchdog: a tripped slot rolls back alone
         ok = batch.finite_slots()
         tripped = set()
@@ -390,6 +461,17 @@ class FleetScheduler:
             if batch.slots[slot] is job and not ok[slot]:
                 tripped.add(slot)
                 self._trip(batch, slot, job, "nan")
+        # in-program integrity invariants: entry/exit fingerprints +
+        # conservation drift, then the current-state fingerprint pass
+        # (exact integer sums — bit-comparable across programs)
+        if inv is not None:
+            self._check_integrity(batch, active, budget, inv, tripped)
+        # sampled shadow-execution audit + always-on DMR comparison
+        if audit_slot is not None and audit_slot not in tripped:
+            self._run_audit(batch, audit_slot, audit_pre,
+                            int(budget[audit_slot]), tripped)
+        if batch.shadow_of:
+            self._check_dmr(batch, tripped)
         # periodic per-job checkpoints + completion (never checkpoint
         # a slot that tripped this quantum: its state just rolled
         # back — the cadence restarts from the restored step)
@@ -402,6 +484,259 @@ class FleetScheduler:
                   is not None and job.steps_done - job.last_save_step
                   >= job.checkpoint_every):
                 self._save_job(batch, slot, job)
+
+    def _fault_cells(self, batch, cells):
+        """Resolve a fault rule's ``cells=None`` to one seeded local
+        cell (shared by the poison and flip landing pads)."""
+        if cells is not None:
+            return cells
+        local = batch.grid.plan.cells
+        pick = int(faults.active().rng.integers(0, len(local)))
+        return [int(local[pick])]
+
+    # -- SDC detection: invariants, audits, DMR, quarantine -----------
+
+    def _check_integrity(self, batch, active, budget, inv,
+                         tripped) -> None:
+        """Compare the dispatch's fused invariants per slot:
+
+        - ``fp_in`` vs the exit fingerprint of the PREVIOUS dispatch —
+          EXACT: any corruption of the slot's resident bytes between
+          the two dispatches (HBM rot, a stray write, an injected
+          flip), convicted at the next quantum boundary;
+        - conservation-sum drift across the quantum for fields the
+          kernel provably conserves — tolerance-bounded: in-compute
+          corruption;
+        - for slots about to CHECKPOINT or FINISH this tick only, one
+          extra current-state fingerprint pass vs ``fp_out`` — EXACT:
+          corruption since the dispatch is convicted before the bytes
+          can be sealed into a checkpoint or reported as an answer.
+          (Steady-state quanta skip this pass: the next quantum's
+          ``fp_in`` covers them, and the save/finish guards are what
+          make the one-quantum detection window airtight.)
+
+        Any mismatch is a CORRUPT verdict: the victim rolls back
+        alone (the NaN discipline) and the batch's device lane takes
+        a suspect mark."""
+        need_now = set()
+        for slot, job in active:
+            if slot in tripped or batch.slots[slot] is not job:
+                continue
+            if (job.steps_done >= job.n_steps
+                    or (job.checkpoint_every > 0
+                        and job.last_save_step is not None
+                        and job.steps_done - job.last_save_step
+                        >= job.checkpoint_every)):
+                need_now.add(slot)
+        fp_now = batch.fingerprint_slots() if need_now else None
+        for slot, job in active:
+            if slot in tripped or batch.slots[slot] is not job:
+                continue
+            why = None
+            if job._fp is not None:
+                for n, pair in job._fp.items():
+                    got = inv["fp_in"][n][slot]
+                    if int(got[0]) != pair[0] or int(got[1]) != pair[1]:
+                        why = (f"fingerprint of field {n!r} changed "
+                               "between dispatches (state corrupted "
+                               "at rest)")
+                        break
+            if why is None and slot in need_now:
+                for n in batch.fp_fields:
+                    if not np.array_equal(fp_now[n][slot],
+                                          inv["fp_out"][n][slot]):
+                        why = (f"fingerprint of field {n!r} no longer "
+                               "matches the dispatch output (state "
+                               "corrupted after the step)")
+                        break
+            if why is None:
+                steps = int(budget[slot])
+                for n in batch.conserved:
+                    s_in = float(inv["cs_in"][n][slot])
+                    s_out = float(inv["cs_out"][n][slot])
+                    shape, _dt = batch.schema[n]
+                    n_el = batch.n_own * int(np.prod(shape, dtype=int)
+                                             or 1)
+                    tol = integrity.sum_tolerance(s_in, n_el,
+                                                  max(1, steps))
+                    if abs(s_out - s_in) > tol:
+                        why = (f"conservation sum of field {n!r} "
+                               f"drifted {abs(s_out - s_in):g} "
+                               f"(tolerance {tol:g}) across the "
+                               "quantum (in-compute corruption)")
+                        break
+            if why is not None:
+                tripped.add(slot)
+                self._sdc_trip(batch, slot, job, why)
+            else:
+                # the exit fingerprint is the next quantum's expected
+                # entry fingerprint (exact, order-independent sums
+                # compare bitwise across programs)
+                job._fp = {n: (int(inv["fp_out"][n][slot, 0]),
+                               int(inv["fp_out"][n][slot, 1]))
+                           for n in batch.fp_fields}
+
+    def _pick_audit(self, batch, active, budget):
+        """The slot to shadow-audit this tick (round-robin over slots
+        actually stepping) and its pre-quantum host state, or
+        ``(None, None)`` off-cadence / when nothing steps."""
+        if (self.audit_every <= 0
+                or self.ticks % self.audit_every != 0):
+            return None, None
+        stepping = [slot for slot, _j in active if budget[slot] > 0]
+        if not stepping:
+            return None, None
+        slot = stepping[self._audit_rr % len(stepping)]
+        self._audit_rr += 1
+        return slot, batch.extract(slot)
+
+    def _run_audit(self, batch, slot, pre, steps, tripped) -> None:
+        """Re-execute ``slot``'s last quantum from its pre-quantum
+        state — in a spare slot of the SAME batch when one is free
+        (the same compiled program; every other slot is frozen
+        bit-exact by its zero budget), else through the solo
+        ``Grid.run_steps`` path on the bucket's scratch grid — and
+        compare the results bitwise. A divergence is a CORRUPT verdict
+        attributed to this slot and its device lane: either the
+        original execution or the state since (an injected flip, HBM
+        rot) is wrong, and the checkpoint chain predates both."""
+        import jax
+        import jax.numpy as jnp
+
+        job = batch.slots[slot]
+        if job is None or job is SHADOW or steps <= 0:
+            return
+        self.audits += 1
+        try:
+            live = batch.digest(slot)
+            spare = batch.free_slot()
+            if spare is not None:
+                saved_extras = batch._extras[spare].copy()
+                batch.insert(spare, pre)
+                batch._extras[spare] = batch._extras[slot]
+                bud = np.zeros(batch.capacity, dtype=np.int32)
+                bud[spare] = steps
+                batch.step(bud)
+                shadow = batch.digest(spare)
+                batch._extras[spare] = saved_extras
+            else:
+                # solo re-execution: the unbatched path recomputes the
+                # same quantum (bitwise identical by the fleet parity
+                # contract), diversifying the program the audit trusts
+                sh = batch.grid._sharding()
+                for n, arr in pre.items():
+                    batch.grid.data[n] = jax.device_put(arr[None], sh)
+                batch.grid.run_steps(
+                    batch.kernel, batch.fields_in, batch.fields_out,
+                    steps, extra_args=tuple(
+                        jnp.float32(p) for p in job.params))
+                from . import checkpoint as checkpoint_mod
+
+                shadow = checkpoint_mod.state_digest(batch.grid)
+        except Exception as e:  # noqa: BLE001 - filtered just below
+            if not resilience._is_resource_exhausted(e):
+                raise
+            # an OOM during the EXTRA audit dispatch must never kill
+            # the fleet the audit exists to protect: skip this window
+            # (no verdict either way); if the pressure is real, the
+            # next MAIN dispatch OOMs into _batch_oom's half-capacity
+            # rebuild as usual
+            logger.warning(
+                "shadow audit of job %s skipped: the audit dispatch "
+                "itself hit RESOURCE_EXHAUSTED (%s)", job.name, e)
+            return
+        if shadow != live:
+            self.audit_failures += 1
+            tripped.add(slot)
+            self._sdc_trip(
+                batch, slot, job,
+                f"shadow re-execution of the last {steps}-step "
+                "quantum diverged from the live slot")
+
+    def _check_dmr(self, batch, tripped) -> None:
+        """Dual-modular-redundancy comparison: every
+        ``redundancy>=2`` job's shadow replica must digest bitwise
+        equal to its primary at every quantum boundary. A divergence
+        is a CORRUPT verdict for the job (we cannot know which
+        replica is wrong — the checkpoint chain predates the split,
+        so the rollback repairs either case) and a suspect mark for
+        the lane."""
+        for sh, primary in list(batch.shadow_of.items()):
+            job = batch.slots[primary]
+            if job is None or primary in tripped:
+                continue
+            if batch.digest(primary) != batch.digest(sh):
+                tripped.add(primary)
+                self._sdc_trip(
+                    batch, primary, job,
+                    "DMR replicas diverged at the quantum boundary")
+
+    def _sdc_trip(self, batch, slot, job, why) -> None:
+        """A CORRUPT verdict: contain (per-slot rollback, the NaN
+        discipline) and attribute (suspect accounting on the batch's
+        device lane, quarantine after ``quarantine_after`` strikes)."""
+        lane = getattr(batch, "lane", 0)
+        logger.warning(
+            "SDC verdict for fleet job %s (slot %d, device lane %d): "
+            "%s", job.name, slot, lane, why)
+        self._trip(batch, slot, job, "corrupt")
+        if lane < len(self.suspects):
+            self.suspects[lane] += 1
+            if (self.quarantine_after > 0
+                    and lane not in self.quarantined
+                    and self.suspects[lane] >= self.quarantine_after):
+                # DEFERRED to the tick boundary: quarantine replaces
+                # bucket instances, and this quantum is still
+                # iterating the one that tripped
+                self._pending_quarantine.add(lane)
+
+    def _quarantine(self, lane: int) -> None:
+        """Take device lane ``lane`` out of service: every bucket
+        instance on it is rebuilt on a surviving lane with its
+        admitted jobs migrated BIT-EXACTLY (the
+        :meth:`~dccrg_tpu.fleet.GridBatch.extract`/``insert`` path the
+        batch-OOM rebuild uses), and admission never places new
+        buckets there again. With no surviving lane the quarantine is
+        recorded but the lane keeps serving — failing the whole fleet
+        would be worse than suspect answers, and the operator sees
+        the log either way."""
+        survivors = [i for i in self.live_lanes() if i != lane]
+        if not survivors:
+            logger.error(
+                "device lane %d exceeded the corruption threshold "
+                "(%d verdict(s)) but is the ONLY lane; continuing to "
+                "serve on suspect hardware", lane, self.suspects[lane])
+            return
+        self.quarantined.add(lane)
+        moved = 0
+        for key, insts in self.buckets.items():
+            for i, batch in enumerate(insts):
+                if getattr(batch, "lane", 0) != lane:
+                    continue
+                jobs = batch.jobs
+                if not jobs:
+                    insts[i] = None
+                    continue
+                new_lane = survivors[self._next_dev % len(survivors)]
+                self._next_dev += 1
+                fresh = GridBatch(jobs[0][1], batch.capacity,
+                                  device=self.devices[new_lane])
+                fresh.lane = new_lane
+                for slot, job in jobs:
+                    state = batch.extract(slot)
+                    new_slot = fresh.admit(job, from_grid=False)
+                    fresh.insert(new_slot, state)
+                    # the bytes moved bit-exactly, so the fingerprint
+                    # baseline survives the migration unchanged
+                    if job.redundancy >= 2:
+                        fresh.admit_shadow(new_slot)
+                    moved += 1
+                insts[i] = fresh
+            self.buckets[key] = [b for b in insts if b is not None]
+        logger.warning(
+            "quarantined device lane %d after %d corrupt verdict(s); "
+            "migrated %d job(s) bit-exactly to surviving lane(s) %s",
+            lane, self.suspects[lane], moved, survivors)
 
     def _batch_oom(self, batch, err) -> None:
         """A REAL (unattributed) RESOURCE_EXHAUSTED from the batched
@@ -431,11 +766,17 @@ class FleetScheduler:
         survivors = batch.jobs
         new_cap = max(len(survivors), batch.capacity // 2)
         small = GridBatch(survivors[0][1], new_cap, device=batch.device)
+        small.lane = getattr(batch, "lane", 0)
         for slot, job in survivors:
             state = batch.extract(slot)
             new_slot = small.admit(job, from_grid=False)
-            for name, arr in state.items():
-                small.state[name] = small.state[name].at[new_slot].set(arr)
+            small.insert(new_slot, state)
+            if job.redundancy >= 2 and small.admit_shadow(new_slot) \
+                    is None:
+                logger.warning(
+                    "DMR job %s lost its shadow replica in the "
+                    "half-size rebuild; running unreplicated",
+                    job.name)
         insts = self.buckets[batch.key]
         insts[insts.index(batch)] = small
         logger.warning(
@@ -489,6 +830,12 @@ class FleetScheduler:
                     break
                 for batch in active:
                     self._quantum(batch)
+                # quarantine at the tick boundary (never mid-quantum:
+                # it replaces bucket instances under migration)
+                for lane in sorted(self._pending_quarantine):
+                    if lane not in self.quarantined:
+                        self._quarantine(lane)
+                self._pending_quarantine.clear()
                 self.ticks += 1
                 if max_ticks is not None and self.ticks >= int(max_ticks):
                     break

@@ -19,6 +19,13 @@
 # back alone and every job must finish bitwise equal to its solo run
 # (the fleet-isolation fuzz scenario plus the CLI round trip).
 #
+# Also runs an SDC smoke leg: an 8-job fleet with one silent_flip
+# victim (finite corruption, invisible to the finiteness watchdog)
+# convicted by the in-program integrity invariants within one
+# quantum, plus the quarantine-after-2 path — a repeat-offender
+# device lane is taken out of service with its survivors migrated
+# bit-exactly (all digests still equal the solo runs).
+#
 # Usage: tests/ci_debug_leg.sh [extra pytest args]
 set -e
 cd "$(dirname "$0")/.."
@@ -28,6 +35,11 @@ env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_fleet.py::test_fleet_fuzz_isolation_scenario" \
     "tests/test_fleet.py::test_cli_runs_a_job_file" \
+    -p no:cacheprovider "$@"
+env JAX_PLATFORMS=cpu python -m pytest -q \
+    "tests/test_integrity.py::test_silent_flip_detected_within_one_quantum" \
+    "tests/test_integrity.py::test_repeat_offender_lane_quarantined_and_migrated" \
+    "tests/test_integrity.py::test_fleet_fuzz_flip_scenario" \
     -p no:cacheprovider "$@"
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_recommit.py::test_native_numpy_plans_bitwise_identical" \

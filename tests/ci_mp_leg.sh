@@ -3,7 +3,10 @@
 # harness (tests/mp_harness.py) — save/restore through the two-phase
 # commit with REAL barriers and the REAL cross-rank CRC all-gather,
 # _replicated_pull psum consistency, barrier-timeout, rank-kill
-# recovery, distributed trip consensus, the SIGTERM round trip
+# recovery, distributed trip consensus, the sdc_rank scenario (a
+# FINITE bit-flip on one real rank -> consensus CORRUPT trip on all
+# ranks, collective rollback, bitwise reconvergence), the SIGTERM
+# round trip
 # (a REAL kill -TERM of one rank mid-run: every rank must take the
 # collective emergency checkpoint, exit with the resumable code 75,
 # and supervise.resume_latest must reconverge bitwise), and the

@@ -26,6 +26,12 @@ drives the scenarios the faked splits cannot truthfully exercise:
 - ``consensus``     — ResilientRunner's distributed trip consensus: a
   MutationAbortedError raised on ONE rank makes every rank roll back
   to the same checkpoint and the final states agree bit-for-bit.
+- ``sdc_rank``      — silent-data-corruption consensus: a FINITE
+  bit-flip lands in ONE real rank's shard (invisible to the numerics
+  watchdog and CRCs); the integrity layer's conservation invariant
+  convicts it as a CORRUPT trip on EVERY rank, all ranks roll back
+  together, and the recovered run reconverges bitwise with an
+  uncorrupted reference.
 - ``preempt``       — the SIGTERM round trip, in three phases: (ref)
   an uninterrupted supervised run records its final-state digest;
   (kill) the parent delivers a REAL ``kill -TERM`` to rank 1 mid-run
@@ -81,7 +87,7 @@ SKIP_RC = 77
 DEATH_RC = 17
 RESUMABLE_RC = 75  # supervise.RESUMABLE_EXIT (EX_TEMPFAIL)
 SCENARIOS = ("save_restore", "psum", "barrier_timeout", "rank_kill",
-             "consensus", "preempt", "delta_rank_kill")
+             "consensus", "sdc_rank", "preempt", "delta_rank_kill")
 # child-side phase names of the parent-orchestrated preempt scenario
 PREEMPT_PHASES = ("preempt_ref", "preempt_kill", "preempt_resume")
 PREEMPT_STEPS = 8
@@ -406,6 +412,93 @@ def scenario_consensus(args):
     assert len(set(hs)) == 1, hs
 
 
+def scenario_sdc_rank(args):
+    """Silent-data-corruption consensus: a FINITE bit-flip lands in
+    ONE real rank's shard mid-run — invisible to the numerics
+    watchdog (everything stays finite) and to checkpoint CRCs. The
+    integrity layer's conservation-sum invariant (a device-side
+    collective, replicated result) must convict it as a CORRUPT trip
+    on EVERY rank together, roll all ranks back to the same pre-flip
+    checkpoint, and the recovered run must reconverge bitwise with an
+    uncorrupted reference."""
+    import zlib
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from dccrg_tpu import checkpoint as checkpoint_mod
+    from dccrg_tpu.faults import FaultPlan
+    from dccrg_tpu.resilience import ResilientRunner
+
+    def kern(c, n, o, m):
+        # a genuinely conservative relaxation: the symmetric neighbor
+        # redistribution keeps sum(v) exact in real arithmetic, which
+        # is what gives the integrity invariant its teeth
+        s = jnp.sum(jnp.where(m, n["v"], 0.0), axis=1)
+        deg = jnp.sum(m, axis=1).astype(c["v"].dtype)
+        return {"v": c["v"] + 0.02 * (s - deg * c["v"])}
+
+    cells = None
+
+    def make_runner(name):
+        nonlocal cells
+        g = _mk_grid(args.seed)
+        cells = g.plan.cells
+
+        def step_fn(grid, i):
+            grid.run_steps(kern, ["v"], ["v"], 1)
+
+        return ResilientRunner(
+            g, step_fn, os.path.join(args.tmp, f"{name}.dc"),
+            check_every=2, checkpoint_every=2, backoff=0.0,
+            conserved_fields=("v",), diagnostics_dir=args.tmp), g
+
+    # reference: the undisturbed run (aligned on every rank)
+    ref_runner, ref_g = make_runner("sdc_ref")
+    ref_runner.run(6)
+    assert not ref_runner.trips, (
+        f"rank {args.rank}: false SDC alarm {ref_runner.trips}")
+    ref_bytes = checkpoint_mod._replicated_pull(
+        ref_g, "v", cells).tobytes()
+
+    runner, g = make_runner("sdc")
+    plan = None
+    if args.rank == 1:
+        # the flip lands on rank 1 ONLY, in a locally-owned cell with
+        # a non-trivial value (a near-zero cell would corrupt below
+        # the conservation tolerance — plausible bits, tiny sum move)
+        mine = cells[g._proc_local_dev[g.plan.owner]]
+        vals = np.asarray(g.get("v", mine)).reshape(len(mine), -1)
+        victim = mine[int(np.argmax(vals[:, 0]))]
+        plan = FaultPlan(seed=args.seed)
+        plan.silent_flip("v", step=3, cells=[int(victim)], bit=23)
+        plan.__enter__()
+    try:
+        runner.run(6)
+    finally:
+        if plan is not None:
+            plan.__exit__(None, None, None)
+    if args.rank == 1:
+        assert plan.fired("step.flip") == 1, plan.log
+    assert runner.step == 6
+    # EVERY rank took the CORRUPT verdict and rolled back — including
+    # rank 0, whose local bytes never changed; that is the consensus
+    # working on a fault only the integrity layer can see
+    assert runner.rollbacks == 1, (
+        f"rank {args.rank}: rollbacks={runner.rollbacks}")
+    assert runner.trips, "no CORRUPT trip recorded"
+    assert "v" in runner.trips[0]["fields"] \
+        or "remote_rank_corrupt" in runner.trips[0]["fields"], \
+        runner.trips[0]["fields"]
+    got = checkpoint_mod._replicated_pull(g, "v", cells).tobytes()
+    assert got == ref_bytes, "recovered state diverged from reference"
+    hs = _kv_allgather(
+        "sdc_state", f"{zlib.crc32(got):08x}", args.rank, args.procs)
+    assert len(set(hs)) == 1, hs
+    print(f"[rank {args.rank}] DIGEST sdc {hs[0]}", flush=True)
+
+
 def _sup_kernel(c, nbr, offs, mask):
     import jax.numpy as jnp
 
@@ -640,6 +733,7 @@ CHILD_SCENARIOS = {
     "barrier_timeout": scenario_barrier_timeout,
     "rank_kill": scenario_rank_kill,
     "consensus": scenario_consensus,
+    "sdc_rank": scenario_sdc_rank,
     "preempt_ref": scenario_preempt_ref,
     "preempt_kill": scenario_preempt_kill,
     "preempt_resume": scenario_preempt_resume,

@@ -109,6 +109,34 @@ def test_nan_trip_isolates_one_job(tmp_path, big_solo, big_nofault):
     assert report[victim]["digest"] == big_solo[victim]
 
 
+@pytest.mark.sdc
+def test_silent_flip_isolates_one_job(tmp_path, big_solo, big_nofault):
+    """The SDC acceptance pin: a FINITE bit-flip in one slot of the
+    >= 32-job batch — invisible to the finiteness watchdog by
+    construction — is convicted by the in-program integrity
+    invariants within one quantum, ONLY the victim trips/rolls
+    back/replays, and every other job's final bytes equal both the
+    no-fault fleet run and its solo digest bitwise."""
+    victim = "j011"
+    plan = FaultPlan(seed=4)
+    plan.silent_flip("rho", step=9, job=victim)
+    with plan:
+        sched = FleetScheduler(tmp_path, _specs(), quantum=4)
+        report = sched.run()
+    assert plan.fired("step.flip") == 1
+    assert all(r["status"] == "done" for r in report.values())
+    # only the victim was convicted, exactly once, as CORRUPT
+    assert {n for n, r in report.items() if r["trips"]} == {victim}
+    assert report[victim]["sdc_trips"] == 1
+    assert sched.suspects[0] == 1
+    for n, r in report.items():
+        if n != victim:
+            assert r["digest"] == big_nofault[n], n
+            assert r["digest"] == big_solo[n], n
+    # the victim rolled back and replayed clean
+    assert report[victim]["digest"] == big_solo[victim]
+
+
 def test_oom_isolates_one_job(tmp_path, big_solo, big_nofault):
     """Separately: a job-scoped injected RESOURCE_EXHAUSTED requeues
     only that job (it re-admits from its own checkpoint stem);

@@ -1,0 +1,534 @@
+"""Silent-data-corruption (SDC) defense tests.
+
+The acceptance pins of the integrity layer (dccrg_tpu/integrity.py):
+
+- the fingerprint primitive is EXACT and order-independent, and the
+  host, device and file-payload computations agree bit-for-bit;
+- an injected FINITE bit-flip (invisible to the numerics watchdog by
+  construction) is convicted as a CORRUPT trip — by the in-program
+  invariants within one quantum, by the shadow-execution audit even
+  with the invariants off, and by DMR replica comparison — with only
+  the victim rolled back and every job reconverging bitwise to its
+  solo digest;
+- the NEGATIVE pin: with ``DCCRG_INTEGRITY=0`` and audits off the
+  same flip goes undetected and the quantum program is the bitwise
+  pre-SDC one (no fingerprint ops at all) — proving the defense, not
+  luck, catches it;
+- a repeat-offender device lane is quarantined and its survivors
+  migrate bit-exactly;
+- ``checkpoint.state_digest`` is gather-mode independent and stable
+  across extract/insert round trips (the audit comparator assumes a
+  mode-dependent digest can never raise a false alarm);
+- ``python -m dccrg_tpu.resilience audit`` catches at-rest corruption
+  sealed under an intact-looking CRC epoch.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dccrg_tpu import checkpoint as checkpoint_mod
+from dccrg_tpu import faults, integrity, resilience
+from dccrg_tpu.faults import FaultPlan
+from dccrg_tpu.fleet import FleetJob, GridBatch, run_solo, template_grid
+from dccrg_tpu.grid import Grid
+from dccrg_tpu.scheduler import FleetScheduler
+
+pytestmark = pytest.mark.sdc
+
+
+# ---------------------------------------------------------------------
+# fingerprint primitives
+# ---------------------------------------------------------------------
+
+def test_fingerprint_host_device_parity_and_order_independence():
+    rng = np.random.default_rng(0)
+    x = (rng.random((40, 3)) * 100).astype(np.float32)
+    host = integrity.fingerprint_rows(x)
+    dev = np.asarray(jax.jit(
+        lambda a: integrity.device_fingerprint(a, 40))(jnp.asarray(x)))
+    assert host == (int(dev[0]), int(dev[1]))
+    # order-independent: any row permutation fingerprints equal
+    perm = rng.permutation(40)
+    assert integrity.fingerprint_rows(x[perm]) == host
+    # sensitive: one flipped bit changes it
+    y = x.copy()
+    y[7, 1] = faults.flip_values(y[7:8, 1], 23)[0]
+    assert integrity.fingerprint_rows(y) != host
+
+
+def test_fingerprint_row_padding_non_word_dtypes():
+    # 3-byte rows pad per ROW to word size, so cell alignment (and
+    # with it order independence) survives odd dtypes
+    x = np.arange(30, dtype=np.uint8).reshape(10, 3)
+    a = integrity.fingerprint_rows(x)
+    b = integrity.fingerprint_rows(x[::-1])
+    assert a == b
+    y = x.copy()
+    y[4, 2] ^= 1
+    assert integrity.fingerprint_rows(y) != a
+
+
+def test_second_sum_sees_compensating_changes():
+    # +d / -d on two words preserves the linear sum; the nonlinear
+    # half-word product must still move (the reason s2 exists)
+    x = np.array([[10.0], [20.0], [30.0]], dtype=np.float32)
+    u = x.view(np.uint32)
+    y = u.copy()
+    y[0, 0] += 4096
+    y[1, 0] -= 4096
+    y = y.view(np.float32)
+    s_x = integrity.fingerprint_rows(x)
+    s_y = integrity.fingerprint_rows(y)
+    assert s_x[0] == s_y[0]  # linear sum compensated
+    assert s_x[1] != s_y[1]  # product sum convicts
+
+
+def test_flip_values_always_finite():
+    # includes values past the 1.5*v+1 overflow point (3e38) and the
+    # affine-map fixed-point neighborhood, for EVERY exponent bit:
+    # the finite guarantee is the fault class's defining contract
+    v = np.array([0.0, 1.0, -2.0, -3.5, 3.0e38, -3.4e38, 1e-38],
+                 dtype=np.float32)
+    for bit in (0, 11, 22, 23, 27, 30):
+        f = faults.flip_values(v, bit)
+        assert np.isfinite(f).all(), (bit, f)
+        assert (f != v).all(), (bit, f)
+
+
+def test_conserved_registry_respects_periodicity():
+    assert integrity.conserved_fields(
+        "diffuse", (True, True, True), ("rho",)) == ("rho",)
+    assert integrity.conserved_fields(
+        "diffuse", (False, False, False), ("rho",)) == ("rho",)
+    assert integrity.conserved_fields(
+        "advect_x", (True, True, True), ("rho",)) == ("rho",)
+    # upwind advection loses mass over a non-wrapping inflow boundary
+    assert integrity.conserved_fields(
+        "advect_x", (False, True, True), ("rho",)) == ()
+    # callable kernels conserve nothing we can assume
+    assert integrity.conserved_fields(
+        lambda *a: None, (True, True, True), ("rho",)) == ()
+
+
+# ---------------------------------------------------------------------
+# fleet: in-program invariants, audits, DMR, quarantine
+# ---------------------------------------------------------------------
+
+def _jobs(count, steps=12, **kw):
+    return [FleetJob(f"s{i:02d}", length=(8, 8, 8), n_steps=steps,
+                     params=(0.02 + 0.004 * (i % 4),), seed=i,
+                     checkpoint_every=4, **kw)
+            for i in range(count)]
+
+
+def _solo(specs):
+    return {j.name: run_solo(FleetJob(
+        j.name, length=j.length, kernel=j.kernel, n_steps=j.n_steps,
+        params=j.params, seed=j.seed)) for j in specs}
+
+
+def test_silent_flip_detected_within_one_quantum(tmp_path):
+    """The flip lands after a dispatch; the post-dispatch fingerprint
+    pass convicts it in the SAME quantum — before any checkpoint can
+    seal the corrupt bytes — and only the victim replays."""
+    specs = _jobs(6)
+    solo = _solo(specs)
+    plan = FaultPlan(seed=1)
+    plan.silent_flip("rho", step=6, job="s03")
+    with plan:
+        sched = FleetScheduler(tmp_path, _jobs(6), quantum=4)
+        report = sched.run()
+    assert plan.fired("step.flip") == 1
+    assert {n for n, r in report.items() if r["trips"]} == {"s03"}
+    assert report["s03"]["sdc_trips"] == 1
+    assert all(r["digest"] == solo[n] for n, r in report.items())
+    assert sched.suspects[0] == 1
+
+
+def test_corruption_between_quanta_detected(tmp_path):
+    """Manually rotting a slot between dispatches (no FaultPlan, no
+    finite violation) trips the entry-fingerprint continuity check at
+    the next quantum."""
+    specs = _jobs(3, steps=8)
+    solo = _solo(specs)
+    sched = FleetScheduler(tmp_path, _jobs(3, steps=8), quantum=2)
+    # run one tick, corrupt a slot out-of-band, then drain
+    sched._admit_pending()
+    batch = next(b for bs in sched.buckets.values() for b in bs)
+    sched._quantum(batch)
+    sched.ticks += 1
+    victim_slot, victim = batch.jobs[1]
+    cell = int(batch.grid.plan.cells[5])
+    batch.flip(victim_slot, "rho", [cell], 23)
+    report = sched.run()
+    assert report[victim.name]["sdc_trips"] >= 1
+    assert {n for n, r in report.items() if r["trips"]} == {victim.name}
+    assert all(r["digest"] == solo[n] for n, r in report.items())
+
+
+def test_negative_pin_integrity_off_flip_undetected(tmp_path,
+                                                    monkeypatch):
+    """With DCCRG_INTEGRITY=0 and audits off the SAME flip sails
+    through: no trips, a silently wrong answer, and the quantum
+    program carries no fingerprint stage at all (no program change —
+    the defense is the only thing that catches it)."""
+    monkeypatch.setenv("DCCRG_INTEGRITY", "0")
+    specs = _jobs(4)
+    solo = _solo(specs)
+    plan = FaultPlan(seed=2)
+    plan.silent_flip("rho", step=6, job="s02")
+    with plan:
+        report = FleetScheduler(tmp_path, _jobs(4), quantum=4).run()
+    assert plan.fired("step.flip") == 1
+    assert all(r["status"] == "done" for r in report.values())
+    assert all(r["trips"] == 0 for r in report.values())
+    assert report["s02"]["digest"] != solo["s02"]  # silently wrong
+    assert all(report[n]["digest"] == solo[n]
+               for n in solo if n != "s02")
+    # and the compiled program really has no integrity stage: the
+    # batch publishes no invariants and refuses to fingerprint
+    batch = GridBatch(specs[0], 4)
+    batch.step(np.array([1, 0, 0, 0], dtype=np.int32))
+    assert batch.last_inv is None
+    with pytest.raises(RuntimeError, match="DCCRG_INTEGRITY"):
+        batch.fingerprint_slots()
+
+
+def test_shadow_audit_detects_with_invariants_off(tmp_path,
+                                                  monkeypatch):
+    """The sampled shadow re-execution is an independent detector: it
+    convicts the flip even with the in-program invariants disabled
+    (audits work by bitwise digest comparison, not fingerprints)."""
+    monkeypatch.setenv("DCCRG_INTEGRITY", "0")
+    specs = _jobs(4)
+    solo = _solo(specs)
+    # the audit SAMPLES: it convicts corruption that lands in the
+    # audited slot's own window. Round-robin starts at slot 0 on tick
+    # 0, so a flip in s00's first quantum is exactly what it sees.
+    plan = FaultPlan(seed=3)
+    plan.silent_flip("rho", step=2, job="s00")
+    with plan:
+        sched = FleetScheduler(tmp_path, _jobs(4), quantum=2,
+                               audit_every=1)
+        report = sched.run()
+    assert plan.fired("step.flip") == 1
+    assert sched.audits > 0
+    assert sched.audit_failures >= 1
+    assert report["s00"]["sdc_trips"] >= 1
+    assert {n for n, r in report.items() if r["trips"]} == {"s00"}
+    assert all(r["digest"] == solo[n] for n, r in report.items())
+
+
+def test_shadow_audit_clean_run_no_false_alarms(tmp_path):
+    specs = _jobs(5, steps=10)
+    solo = _solo(specs)
+    sched = FleetScheduler(tmp_path, _jobs(5, steps=10), quantum=2,
+                           audit_every=1)
+    report = sched.run()
+    assert sched.audits > 0 and sched.audit_failures == 0
+    assert all(r["trips"] == 0 for r in report.values())
+    assert all(r["digest"] == solo[n] for n, r in report.items())
+
+
+def test_audit_solo_path_when_batch_is_full(tmp_path):
+    """With every slot occupied the audit re-executes through the solo
+    Grid.run_steps path instead of a spare slot — and still agrees
+    bitwise on a clean run (the fleet parity contract)."""
+    specs = _jobs(4, steps=8)
+    solo = _solo(specs)
+    sched = FleetScheduler(tmp_path, _jobs(4, steps=8), quantum=2,
+                           max_batch=4, audit_every=1)
+    report = sched.run()
+    assert sched.audits > 0 and sched.audit_failures == 0
+    assert all(r["digest"] == solo[n] for n, r in report.items())
+
+
+def test_dmr_redundancy_runs_clean_and_detects_flip(tmp_path):
+    """redundancy=2: the replicas digest-compare every quantum. A
+    clean run finishes with the solo digest (replication must not
+    perturb the primary); a flip on the primary diverges the pair and
+    convicts even with the in-program invariants off."""
+    solo = _solo(_jobs(2, steps=8))
+    report = FleetScheduler(
+        tmp_path / "clean", _jobs(2, steps=8, redundancy=2),
+        quantum=2).run()
+    assert all(r["trips"] == 0 and r["digest"] == solo[n]
+               for n, r in report.items())
+
+    os.environ["DCCRG_INTEGRITY"] = "0"
+    try:
+        plan = FaultPlan(seed=4)
+        plan.silent_flip("rho", step=3, job="s00")
+        with plan:
+            rep2 = FleetScheduler(
+                tmp_path / "flip", _jobs(2, steps=8, redundancy=2),
+                quantum=2).run()
+    finally:
+        del os.environ["DCCRG_INTEGRITY"]
+    assert plan.fired("step.flip") == 1
+    assert rep2["s00"]["sdc_trips"] >= 1
+    assert rep2["s01"]["trips"] == 0
+    assert all(rep2[n]["digest"] == solo[n] for n in solo)
+
+
+def test_repeat_offender_lane_quarantined_and_migrated(tmp_path):
+    """Two CORRUPT verdicts on one device lane quarantine it: every
+    bucket instance rebuilds on the surviving lane with its admitted
+    jobs migrated bit-exactly (final digests equal solo), and
+    admission never returns to the quarantined lane."""
+    dev = jax.devices()[0]
+    specs = _jobs(8, steps=16)
+    solo = _solo(specs)
+    plan = FaultPlan(seed=5)
+    plan.silent_flip("rho", step=5, job="s02")
+    plan.silent_flip("rho", step=9, job="s04")
+    with plan:
+        sched = FleetScheduler(
+            tmp_path, _jobs(8, steps=16), quantum=4,
+            devices=[dev, dev], quarantine_after=2)
+        report = sched.run()
+    assert plan.fired("step.flip") == 2
+    assert sched.quarantined == {0}
+    assert sched.suspects[0] == 2
+    # the survivors migrated mid-run and still reconverged bitwise
+    assert all(r["status"] == "done" for r in report.values())
+    assert all(r["digest"] == solo[n] for n, r in report.items())
+    assert {n for n, r in report.items() if r["trips"]} == \
+        {"s02", "s04"}
+    # every live bucket now sits on the surviving lane
+    for insts in sched.buckets.values():
+        for b in insts:
+            assert getattr(b, "lane", 0) == 1
+
+
+def test_single_lane_cannot_be_quarantined(tmp_path):
+    """With one device lane the threshold logs instead of quarantining
+    — suspect answers beat failing the whole fleet."""
+    plan = FaultPlan(seed=6)
+    plan.silent_flip("rho", step=3, job="s00")
+    plan.silent_flip("rho", step=7, job="s01")
+    with plan:
+        sched = FleetScheduler(tmp_path, _jobs(3, steps=12), quantum=4,
+                               quarantine_after=2)
+        report = sched.run()
+    assert sched.quarantined == set()
+    assert sched.suspects[0] == 2
+    assert all(r["status"] == "done" for r in report.values())
+
+
+# ---------------------------------------------------------------------
+# state_digest determinism (the audit comparator's assumption)
+# ---------------------------------------------------------------------
+
+def _digest_under(monkeypatch, job, **env):
+    for k in ("DCCRG_ROLL_STENCIL", "DCCRG_FORCE_TABLES"):
+        monkeypatch.delenv(k, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    g = template_grid(job)
+    job.apply_init(g)
+    g.run_steps(job.resolved_kernel(), job.fields_in, job.fields_out,
+                job.n_steps,
+                extra_args=tuple(jnp.float32(p) for p in job.params))
+    return checkpoint_mod.state_digest(g)
+
+
+def test_state_digest_gather_mode_independent(monkeypatch):
+    """roll-decomposed and dense-table gathers must produce the same
+    digest for the same simulation — a mode-dependent digest would be
+    a false SDC alarm in the audit comparator."""
+    job = FleetJob("dig", length=(8, 8, 8), n_steps=6, params=(0.03,),
+                   seed=9)
+    roll = _digest_under(monkeypatch, job, DCCRG_ROLL_STENCIL="1")
+    tables = _digest_under(monkeypatch, job, DCCRG_FORCE_TABLES="1",
+                           DCCRG_ROLL_STENCIL="0")
+    assert roll == tables
+
+
+def test_state_digest_extract_insert_round_trip():
+    """Slot bytes survive extract -> insert into a DIFFERENT slot (and
+    the write_grid path) digest-identically."""
+    job = FleetJob("rt", length=(8, 8, 8), n_steps=4, params=(0.03,),
+                   seed=11)
+    batch = GridBatch(job, 4)
+    slot = batch.admit(job)
+    batch.step(np.array([4, 0, 0, 0], dtype=np.int32))
+    d0 = batch.digest(slot)
+    moved = batch.extract(slot)
+    batch.insert(2, moved)
+    assert batch.digest(2) == d0
+    g = batch.write_grid(slot)
+    assert checkpoint_mod.state_digest(g) == d0
+
+
+# ---------------------------------------------------------------------
+# the solo runner + the at-rest audit
+# ---------------------------------------------------------------------
+
+def _mk_solo_grid(seed=0):
+    g = (Grid(cell_data={"v": jnp.float32})
+         .set_initial_length((8, 8, 4))
+         .set_periodic(True, True, True)
+         .set_maximum_refinement_level(0)
+         .set_neighborhood_length(1)
+         .initialize())
+    cells = g.plan.cells
+    rng = np.random.default_rng(seed)
+    g.set("v", cells, (rng.random(len(cells)) * 100).astype(np.float32))
+    g.update_copies_of_remote_neighbors()
+    return g
+
+
+def _conserving_step(grid, i):
+    grid.run_steps(
+        lambda c, n, o, m: {"v": c["v"] + 0.02 * (
+            jnp.sum(jnp.where(m, n["v"], 0.0), axis=1)
+            - jnp.sum(m, axis=1).astype(c["v"].dtype) * c["v"])},
+        ["v"], ["v"], 1)
+
+
+def test_runner_convicts_silent_flip_and_reconverges(tmp_path):
+    g_ref = _mk_solo_grid()
+    ref = resilience.ResilientRunner(
+        g_ref, _conserving_step, str(tmp_path / "ref.dc"),
+        check_every=2, checkpoint_every=4, backoff=0.0,
+        conserved_fields=("v",)).run(10)
+    assert not ref.trips  # no false alarms across 10 steps
+    ref_digest = checkpoint_mod.state_digest(g_ref)
+
+    g = _mk_solo_grid()
+    plan = FaultPlan(seed=7)
+    plan.silent_flip("v", step=6)
+    with plan:
+        r = resilience.ResilientRunner(
+            g, _conserving_step, str(tmp_path / "x.dc"),
+            check_every=2, checkpoint_every=4, backoff=0.0,
+            conserved_fields=("v",)).run(10)
+    assert plan.fired("step.flip") == 1
+    assert r.rollbacks >= 1
+    assert "v" in r.trips[0]["fields"]
+    assert checkpoint_mod.state_digest(g) == ref_digest
+
+
+def test_runner_persistent_corruption_raises_integrity_error(tmp_path):
+    """A flip that re-lands on every replay (a defective device, not
+    a transient upset) exhausts the bounded retries as the typed
+    IntegrityError — a ResilienceExhaustedError subclass, so generic
+    handlers keep working."""
+    g = _mk_solo_grid()
+    plan = FaultPlan(seed=8)
+    plan.silent_flip("v", step=6, times=10)
+    with plan, pytest.raises(integrity.IntegrityError) as ei:
+        resilience.ResilientRunner(
+            g, _conserving_step, str(tmp_path / "p.dc"),
+            check_every=2, checkpoint_every=4, backoff=0.0,
+            max_retries=2, conserved_fields=("v",)).run(10)
+    assert isinstance(ei.value, resilience.ResilienceExhaustedError)
+    assert "v" in ei.value.details
+
+
+def test_runner_without_conserved_fields_misses_the_flip(tmp_path):
+    """The runner-level negative pin: no conserved_fields (or
+    integrity off) means the finite flip goes unconvicted."""
+    g_ref = _mk_solo_grid()
+    resilience.ResilientRunner(
+        g_ref, _conserving_step, str(tmp_path / "r.dc"),
+        check_every=2, checkpoint_every=4, backoff=0.0).run(10)
+    g = _mk_solo_grid()
+    plan = FaultPlan(seed=7)
+    plan.silent_flip("v", step=6)
+    with plan:
+        r = resilience.ResilientRunner(
+            g, _conserving_step, str(tmp_path / "x.dc"),
+            check_every=2, checkpoint_every=4, backoff=0.0).run(10)
+    assert not r.trips
+    assert checkpoint_mod.state_digest(g) != \
+        checkpoint_mod.state_digest(g_ref)
+
+
+def test_audit_record_written_and_clean(tmp_path):
+    g = _mk_solo_grid()
+    p = str(tmp_path / "a.dc")
+    resilience.save_checkpoint(g, p)
+    rec = resilience.read_sidecar(p)
+    assert "integrity" in rec and "v" in rec["integrity"]
+    rep = resilience.audit_checkpoint(p)
+    assert rep is not None and rep["v"][0]
+    assert resilience._main(["audit", p]) == 0
+
+
+def test_audit_catches_sealed_at_rest_corruption(tmp_path, capsys):
+    """A payload bit rots AND the chunk CRCs get regenerated (an
+    intact-looking CRC epoch). verify passes; only the fingerprint —
+    recorded from live device state at save time — convicts."""
+    g = _mk_solo_grid()
+    p = str(tmp_path / "a.dc")
+    resilience.save_checkpoint(g, p)
+    rec = resilience.read_sidecar(p)
+    with open(p, "r+b") as f:
+        f.seek(int(rec["payload_start"]) + 9)
+        b = f.read(1)
+        f.seek(int(rec["payload_start"]) + 9)
+        f.write(bytes([b[0] ^ 8]))
+    fresh = resilience._sidecar_record(p)
+    fresh["integrity"] = rec["integrity"]
+    resilience._write_sidecar_record(resilience.sidecar_path(p), fresh)
+    assert resilience.verify_checkpoint(p) == []  # CRCs look intact
+    rep = resilience.audit_checkpoint(p)
+    assert not rep["v"][0]
+    assert resilience._main(["audit", p]) == 1
+    assert "SDC" in capsys.readouterr().out
+
+
+def test_audit_no_record_reports_distinctly(tmp_path, monkeypatch):
+    monkeypatch.setenv("DCCRG_INTEGRITY", "0")
+    g = _mk_solo_grid()
+    p = str(tmp_path / "n.dc")
+    resilience.save_checkpoint(g, p)
+    assert resilience.audit_checkpoint(p) is None
+    assert resilience._main(["audit", p]) == 2
+
+
+def test_delta_save_records_subset_fingerprint(tmp_path):
+    """A dirty-field delta's sidecar fingerprints exactly the fields
+    it stores, and audits clean."""
+    from dccrg_tpu import supervise
+
+    g = (Grid(cell_data={"v": jnp.float32,
+                         "aux": ((4,), jnp.float32)})
+         .set_initial_length((6, 6, 4))
+         .set_periodic(True, True, True)
+         .set_maximum_refinement_level(0)
+         .set_neighborhood_length(1)
+         .initialize())
+    cells = g.plan.cells
+    rng = np.random.default_rng(3)
+    g.set("v", cells, (rng.random(len(cells)) * 10).astype(np.float32))
+    g.set("aux", cells,
+          (rng.random((len(cells), 4)) * 10).astype(np.float32))
+    g.update_copies_of_remote_neighbors()
+    store = supervise.CheckpointStore(tmp_path, stem="d")
+    store.save(g, 0)
+    _conserving_step(g, 0)
+    g._ckpt_dirty = {"v"}
+    path = store.save(g, 1)
+    assert path.endswith(resilience.DELTA_SUFFIX)
+    rec = resilience.read_sidecar(path)
+    assert set(rec["integrity"]) == {"v"}
+    rep = resilience.audit_checkpoint(path)
+    assert rep["v"][0]
+
+
+def test_fleet_fuzz_flip_scenario():
+    """The fuzz oracle's SDC case (tier-1 seed): silent flip on a
+    random victim, only-victim-convicted, all digests solo-bitwise."""
+    from dccrg_tpu.fuzz import fleet_isolation_case
+
+    out = fleet_isolation_case(1, fault="flip")
+    assert out["trips"] >= 1
+    assert out["report"][out["victim"]]["sdc_trips"] >= 1
