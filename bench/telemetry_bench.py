@@ -1,0 +1,139 @@
+"""Telemetry overhead on the hot step path: tracing must be ~free.
+
+The 64^3 advection loop (the bench.py workhorse shape) is dispatched
+repeatedly through ``Grid.run_steps`` — the exact boundary the
+``grid.step`` span instruments — in two interleaved legs:
+
+- ``trace_off`` — ``DCCRG_TRACE=0`` semantics: ``telemetry.span`` is
+  the shared no-op singleton, so the step path is the pre-telemetry
+  path plus ONE dict lookup;
+- ``trace_on``  — spans recorded into the ring every dispatch (the
+  ring is sized to hold the whole run; no flush inside the window).
+
+Legs alternate (best-of pairs on the same warm state) so host noise
+hits both equally. The bench ASSERTS the acceptance bounds: traced
+overhead <= 2% of the untraced dispatch, untraced overhead
+indistinguishable from noise (the no-op leg is compared against
+itself across reps, and its spread bounds what "0%" means on this
+host) — exit 1 on violation.
+
+Run:  timeout -k 10 600 python bench/telemetry_bench.py
+      [--n 64] [--steps 4] [--reps 7] [--dispatches 6]
+
+JSON rows to stdout like the other bench emitters; PERF.md quotes the
+summary row.
+"""
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _mk_grid(n):
+    from dccrg_tpu.grid import Grid, default_mesh
+    from dccrg_tpu.resilience import probed_devices
+
+    dev = probed_devices(platform="cpu")[0]
+    g = (Grid(cell_data={"rho": jnp.float32})
+         .set_initial_length((n, n, n))
+         .set_periodic(True, True, True)
+         .set_maximum_refinement_level(0)
+         .set_neighborhood_length(1)
+         .initialize(default_mesh([dev])))
+    cells = g.plan.cells
+    rng = np.random.default_rng(0)
+    g.set("rho", cells,
+          (rng.random(len(cells)) * 100.0).astype(np.float32))
+    g.update_copies_of_remote_neighbors()
+    return g
+
+
+def _measure(g, kernel, steps, dispatches):
+    """Seconds per dispatch (k fused steps each), device-synced."""
+    t0 = time.perf_counter()
+    for _ in range(dispatches):
+        g.run_steps(kernel, ("rho",), ("rho",), steps,
+                    extra_args=(jnp.float32(0.2),))
+    jax.block_until_ready(g.data["rho"])
+    return (time.perf_counter() - t0) / dispatches
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=4,
+                    help="fused steps per dispatch")
+    ap.add_argument("--reps", type=int, default=15)
+    ap.add_argument("--dispatches", type=int, default=4,
+                    help="dispatches per timed window")
+    args = ap.parse_args(argv)
+
+    from dccrg_tpu import telemetry
+    from dccrg_tpu.fleet import FLEET_KERNELS
+
+    kernel = FLEET_KERNELS["advect_x"]
+    g = _mk_grid(args.n)
+    telemetry.configure(trace=False)
+    _measure(g, kernel, args.steps, 2)  # compile + warm
+    telemetry.configure(trace=True, ring=1 << 18)
+    _measure(g, kernel, args.steps, 2)  # warm the traced path too
+    telemetry.clear_trace()
+
+    off, on = [], []
+    for rep in range(args.reps):
+        # interleaved AND order-alternated: host noise and any
+        # monotonic drift (thermal, cache) hit both legs equally
+        legs = [(False, off), (True, on)]
+        if rep % 2:
+            legs.reverse()
+        for trace, acc in legs:
+            telemetry.configure(trace=trace)
+            acc.append(_measure(g, kernel, args.steps,
+                                args.dispatches))
+    n_events = len(telemetry.events())
+    telemetry.configure(trace=False)
+    telemetry.clear_trace()
+
+    best_off, best_on = min(off), min(on)
+    overhead_on = (best_on - best_off) / best_off
+    # the no-op leg's own rep-to-rep spread is the noise floor this
+    # host can resolve — "~0%" for the untraced path means within it
+    noise = (max(off) - best_off) / best_off
+    for name, leg in (("trace_off", off), ("trace_on", on)):
+        print(json.dumps({
+            "bench": "telemetry", "leg": name, "n": args.n,
+            "steps_per_dispatch": args.steps,
+            "best_s_per_dispatch": round(min(leg), 6),
+            "reps_s": [round(v, 6) for v in leg]}), flush=True)
+    print(json.dumps({"summary": {
+        "n": args.n,
+        "traced_overhead_pct": round(100 * overhead_on, 3),
+        "noise_floor_pct": round(100 * noise, 3),
+        "span_events_recorded": n_events,
+        "bound_pct": 2.0}}), flush=True)
+
+    ok = True
+    if n_events < args.reps * args.dispatches:
+        print(f"FAIL: tracing-on leg recorded {n_events} events "
+              f"(expected >= {args.reps * args.dispatches})")
+        ok = False
+    if overhead_on > 0.02:
+        print(f"FAIL: traced overhead {100 * overhead_on:.2f}% "
+              "exceeds the 2% bound")
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

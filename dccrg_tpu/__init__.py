@@ -32,7 +32,16 @@ reference dccrg library (header-only C++/MPI/Zoltan; see SURVEY.md):
   program, sampled shadow-execution audits, DMR job replication, a
   CORRUPT trip class with per-victim rollback and consensus, device
   quarantine with bit-exact survivor migration, and offline at-rest
-  fingerprint audits — ``python -m dccrg_tpu.resilience audit``).
+  fingerprint audits — ``python -m dccrg_tpu.resilience audit``),
+- a telemetry subsystem (``telemetry``: process-wide counter/gauge/
+  histogram registry with Prometheus text exposition, a low-overhead
+  ring-buffered span tracer over every hot boundary — step dispatch,
+  halo exchange, adapt/recommit, checkpoint phases, fleet quanta —
+  with rank-tagged JSONL traces that merge across processes, and
+  strictly best-effort exporters; ``DCCRG_TRACE=1``, ``python -m
+  dccrg_tpu.telemetry``) feeding latency-SLO fleet admission
+  (``scheduler.SLOPolicy``: per-job ``slo_ms`` deadlines, EWMA
+  quantum-latency projection, over-latency bucket shedding).
 
 Reference: /root/reference (dccrg.hpp and friends). This package is a
 re-design for TPU, not a translation: structure (cell lists, neighbor
@@ -64,8 +73,10 @@ from .supervise import (RESUMABLE_EXIT, CheckpointStore, PreemptedError,
                         StepTimeoutError, SupervisedRunner,
                         gc_checkpoints, resume_latest)
 from .fleet import FleetJob, GridBatch
-from .scheduler import FleetPreemptedError, FleetScheduler
+from .scheduler import FleetPreemptedError, FleetScheduler, SLOPolicy
 from .integrity import IntegrityError, register_conserved
+from . import telemetry
+from .telemetry import LogHistogram
 
 __version__ = "0.1.0"
 
@@ -119,4 +130,7 @@ __all__ = [
     "FleetScheduler",
     "IntegrityError",
     "register_conserved",
+    "SLOPolicy",
+    "LogHistogram",
+    "telemetry",
 ]

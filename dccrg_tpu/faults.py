@@ -325,6 +325,14 @@ class FaultPlan:
         prefix."""
         return self._add("checkpoint.delta", "parent_corrupt", times)
 
+    def telemetry_io_error(self, times=1):
+        """I/O error at a telemetry exporter write (``telemetry.export``
+        — trace JSONL flushes and metrics-file exposition dumps).
+        Telemetry is strictly best-effort: the write is dropped and
+        counted, and the observed run must proceed with ZERO trips or
+        rollbacks (pinned by tests/test_telemetry.py)."""
+        return self._add("telemetry.export", "io", times)
+
     def gc_error(self, times=1):
         """I/O error mid retention-GC prune (``checkpoint.gc``, fired
         before an unlink). The chain-aware deletion order — deltas

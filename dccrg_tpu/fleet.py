@@ -149,7 +149,7 @@ class FleetJob:
                  fields_out=("rho",), params=(0.1,), priority=0,
                  periodic=(True, True, True), hood_len=1,
                  checkpoint_every=8, max_retries=3, seed=0, init=None,
-                 redundancy=1):
+                 redundancy=1, slo_ms=None):
         self.name = str(name)
         self.length = tuple(int(v) for v in length)
         self.kernel = kernel
@@ -178,10 +178,20 @@ class FleetJob:
         # digests at every quantum boundary; a mismatch is a CORRUPT
         # trip (see dccrg_tpu.integrity)
         self.redundancy = max(1, int(redundancy))
+        # latency SLO: a completion deadline in milliseconds, measured
+        # from the job's first admission to the scheduler queue. The
+        # scheduler's SLOPolicy prefers jobs whose PROJECTED completion
+        # (telemetry quantum-latency EWMA x remaining quanta) would
+        # blow the deadline, and sheds best-effort neighbors out of a
+        # bucket whose measured quantum latency blows the tightest
+        # admitted SLO. None = best-effort (pure priority admission).
+        self.slo_ms = None if slo_ms is None else float(slo_ms)
+        self.slo_t0 = None  # policy-clock time of the first add()
         # scheduler-owned runtime state
         self.steps_done = 0
         self.retries = 0
         self.requeues = 0
+        self.rollbacks = 0
         self.transient_retries = 0
         self.trips = []  # [(kind, at_step)]
         self.status = "queued"
@@ -642,7 +652,8 @@ def _jobs_from_spec(spec: dict) -> list:
     shorthand for one), ``priority``, ``seed``, ``checkpoint_every``,
     ``periodic`` [bool, bool, bool], ``redundancy`` (2 = DMR: two
     slots step the job and their digests are compared every
-    quantum)."""
+    quantum), ``slo_ms`` (completion-deadline milliseconds for the
+    scheduler's latency-SLO admission; absent = best-effort)."""
     jobs = []
     for row in spec.get("jobs", []):
         if "name" not in row:
@@ -661,6 +672,7 @@ def _jobs_from_spec(spec: dict) -> list:
             periodic=tuple(row.get("periodic", (True, True, True))),
             checkpoint_every=int(row.get("checkpoint_every", 8)),
             redundancy=int(row.get("redundancy", 1)),
+            slo_ms=row.get("slo_ms"),
         ))
     return jobs
 
@@ -725,9 +737,29 @@ def _main(argv=None) -> int:
                           "workdir": workdir}), flush=True)
         return e.exit_code
     wall = time.perf_counter() - t0
+    from . import telemetry
+
+    reg = telemetry.registry()
     done = failed = steps = 0
     for name in sorted(report):
         row = dict(report[name], name=name)
+        # the per-job end-of-run summary comes from the telemetry
+        # registry (the same series dump_prometheus exposes), not
+        # ad-hoc prints: quantum-latency quantiles, trip/rollback
+        # counters, and throughput over the fleet wall
+        h = reg.histogram("dccrg_fleet_quantum_seconds", job=name)
+        row.update({
+            "quantum_p50_ms": (round(h.quantile(0.5) * 1e3, 3)
+                               if h is not None and h.total else None),
+            "quantum_p99_ms": (round(h.quantile(0.99) * 1e3, 3)
+                               if h is not None and h.total else None),
+            "trips_total": int(reg.counter_total(
+                "dccrg_fleet_trips_total", job=name)),
+            "rollbacks_total": int(reg.counter_total(
+                "dccrg_fleet_rollbacks_total", job=name)),
+            "steps_per_s": (round(row["steps"] / wall, 3)
+                            if wall > 0 else None),
+        })
         print(json.dumps(row), flush=True)
         done += row["status"] == "done"
         failed += row["status"] == "failed"

@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .compat import shard_map as _shard_map
 
 from . import faults
+from . import telemetry
 from .geometry import CartesianGeometry, NoGeometry, StretchedCartesianGeometry
 from .mapping import Mapping
 from .neighbors import (
@@ -2262,13 +2263,14 @@ class Grid:
         self._check_not_in_flight(neighborhood_id)
         if self.n_dev == 1:
             return
-        names = tuple(sorted(fields)) if fields is not None else tuple(sorted(self.fields))
-        _start, _finish, fused, _n_t = self._exchange_programs(
-            neighborhood_id, len(names))
-        sends, recvs = self._pair_tables_device(neighborhood_id, names)
-        out = fused(*sends, *recvs, *(self.data[n] for n in names))
-        for n, arr in zip(names, out):
-            self.data[n] = arr
+        with telemetry.span("grid.exchange"):
+            names = tuple(sorted(fields)) if fields is not None else tuple(sorted(self.fields))
+            _start, _finish, fused, _n_t = self._exchange_programs(
+                neighborhood_id, len(names))
+            sends, recvs = self._pair_tables_device(neighborhood_id, names)
+            out = fused(*sends, *recvs, *(self.data[n] for n in names))
+            for n, arr in zip(names, out):
+                self.data[n] = arr
 
     def _check_not_in_flight(self, neighborhood_id):
         entry = self._pending.get(neighborhood_id)
@@ -2294,8 +2296,9 @@ class Grid:
         if self.n_dev == 1:
             self._pending[neighborhood_id] = (self.plan.epoch, names, None, None)
             return
-        start, finish = self._exchange_split_fns(neighborhood_id, names)
-        bufs = start(*(self.data[n] for n in names))
+        with telemetry.span("grid.exchange.start"):
+            start, finish = self._exchange_split_fns(neighborhood_id, names)
+            bufs = start(*(self.data[n] for n in names))
         self._pending[neighborhood_id] = (self.plan.epoch, names, finish, bufs)
 
     def wait_remote_neighbor_copy_updates(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID) -> None:
@@ -2310,9 +2313,10 @@ class Grid:
             )
         if finish is None:  # single-device: nothing was exchanged
             return
-        out = finish(*bufs, *(self.data[n] for n in names))
-        for n, arr in zip(names, out):
-            self.data[n] = arr
+        with telemetry.span("grid.exchange.wait"):
+            out = finish(*bufs, *(self.data[n] for n in names))
+            for n, arr in zip(names, out):
+                self.data[n] = arr
 
     def wait_remote_neighbor_copy_update_receives(self, neighborhood_id=DEFAULT_NEIGHBORHOOD_ID) -> None:
         self.wait_remote_neighbor_copy_updates(neighborhood_id)
@@ -2990,19 +2994,20 @@ class Grid:
         results (see compile_step_loop)."""
         fields_in = tuple(fields_in)
         fields_out = tuple(fields_out)
-        fn, tables, static_in = self.compile_step_loop(
-            kernel, fields_in, fields_out, exchange_fields,
-            neighborhood_id, n_extra=len(extra_args),
-        )
-        out = fn(
-            jnp.int32(n_steps),
-            *tables,
-            *(self.data[n] for n in static_in),
-            *(self.data[n] for n in fields_out),
-            *extra_args,
-        )
-        for n, arr in zip(fields_out, out):
-            self.data[n] = arr
+        with telemetry.span("grid.step"):
+            fn, tables, static_in = self.compile_step_loop(
+                kernel, fields_in, fields_out, exchange_fields,
+                neighborhood_id, n_extra=len(extra_args),
+            )
+            out = fn(
+                jnp.int32(n_steps),
+                *tables,
+                *(self.data[n] for n in static_in),
+                *(self.data[n] for n in fields_out),
+                *extra_args,
+            )
+            for n, arr in zip(fields_out, out):
+                self.data[n] = arr
         self._mark_ckpt_dirty(fields_out)
         # DCCRG_WATCHDOG=N: self-check the stepped fields for NaN/Inf
         # every ~N steps (one device-side scalar; see resilience.py) —
@@ -3053,7 +3058,8 @@ class Grid:
         in any of them rolls the whole balance back
         (:class:`~dccrg_tpu.txn.MutationAbortedError`) and the grid
         keeps its previous partition, data placement and staging."""
-        with grid_transaction(self, op="balance_load"):
+        with telemetry.span("grid.balance"), \
+                grid_transaction(self, op="balance_load"):
             self.initialize_balance_load(use_zoltan)
             self.continue_balance_load()
             self.finish_balance_load()
@@ -3493,7 +3499,8 @@ class Grid:
         (:class:`~dccrg_tpu.txn.GridInvariantError`)."""
         from .amr import resolve_adaptation
 
-        with grid_transaction(self, op="stop_refining"):
+        with telemetry.span("grid.adapt"), \
+                grid_transaction(self, op="stop_refining"):
             faults.fire("adapt.commit", phase="resolve")
             res = resolve_adaptation(
                 self.mapping,
@@ -3544,6 +3551,10 @@ class Grid:
             return res.new_cells.copy()
 
     def _restructure(self, new_cells, new_owner):
+        with telemetry.span("grid.recommit"):
+            return self._restructure_impl(new_cells, new_owner)
+
+    def _restructure_impl(self, new_cells, new_owner):
         """Rebuild the plan for a new cell set, carrying over the data
         of surviving cells (the reference's rebuild at
         dccrg.hpp:10642-10690, with data movement folded in).

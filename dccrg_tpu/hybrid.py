@@ -45,7 +45,7 @@ import weakref
 
 import numpy as np
 
-from . import faults
+from . import faults, telemetry
 
 #: Optional phase-record sink: a list that every build appends
 #: ``(label, seconds)`` tuples to (bench/recommit_bench.py installs
@@ -55,10 +55,15 @@ _PHASE_SINK = None
 
 def _phase_timer():
     """Phase-boundary logger: prints with DCCRG_TIMING=1, records into
-    :data:`_PHASE_SINK` when one is installed."""
+    :data:`_PHASE_SINK` when one is installed, and emits the phases as
+    ``hybrid.<label>`` telemetry spans when tracing is on (so an
+    adapt/recommit epoch's internal cost split — classification, row
+    layout, send/recv lists — lands in the same timeline as the
+    ``grid.recommit`` span wrapping it)."""
     sink = _PHASE_SINK
     echo = os.environ.get("DCCRG_TIMING") == "1"
-    if sink is None and not echo:
+    trace = telemetry.trace_enabled()
+    if sink is None and not echo and not trace:
         return lambda label: None
     state = {"t": time.perf_counter()}
 
@@ -69,6 +74,8 @@ def _phase_timer():
             print(f"[hybrid] {label}: {dt:.3f}s", flush=True)
         if sink is not None:
             sink.append((label, dt))
+        if trace:
+            telemetry.record_span("hybrid." + label.replace(" ", "_"), dt)
         state["t"] = now
 
     return mark
@@ -133,6 +140,12 @@ class PlanArena:
             self._free.setdefault(b.dtype.str, []).append(b)
         pending = []
         self._pending = pending
+        # generation rotation is the arena's hot event: the swap count
+        # plus pool-efficiency gauges make a cold (miss-heavy) epoch
+        # visible in the same exposition as the recommit spans
+        telemetry.inc("dccrg_arena_swaps_total")
+        telemetry.set_gauge("dccrg_arena_pool_hits", self.hits)
+        telemetry.set_gauge("dccrg_arena_pool_misses", self.misses)
         return pending
 
     def take(self, shape, dtype, fill=None, owner=None):

@@ -58,7 +58,7 @@ from dataclasses import dataclass, field as dataclass_field
 import numpy as np
 
 from . import checkpoint as checkpoint_mod
-from . import faults
+from . import faults, telemetry
 
 logger = logging.getLogger("dccrg_tpu.resilience")
 
@@ -611,6 +611,7 @@ def materialize_chain(filename: str, out_path: str, cell_data,
     return [p for p, _r in links]
 
 
+@telemetry.traced("ckpt.save")
 def save_checkpoint(grid, filename: str, header: bytes = b"",
                     variable=None, sidecar: bool = True, retries: int = 2,
                     backoff: float = 0.1, chunk_bytes: int = CRC_CHUNK,
@@ -626,6 +627,9 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
     ``sidecar_extra`` merges extra keys (the delta parent link) into
     the sidecar record — the incremental-save plumbing; use
     :func:`save_delta_checkpoint` rather than passing them directly."""
+    telemetry.inc("dccrg_saves_total",
+                  kind=("delta" if sidecar_extra and "delta"
+                        in sidecar_extra else "keyframe"))
     if grid._multiproc:
         # multi-process meshes take the TWO-PHASE-COMMIT save
         # (checkpoint._save_process_slice): every rank streams its
@@ -704,6 +708,7 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
     return filename
 
 
+@telemetry.traced("ckpt.delta")
 def save_delta_checkpoint(grid, filename: str, *, parent_path: str,
                           parent_step: int, step: int, fields,
                           header: bytes = b"", variable=None,
@@ -875,6 +880,7 @@ class SalvageReport:
         return not self.bad_chunks and not self.sidecar_missing
 
 
+@telemetry.traced("ckpt.load", counter="dccrg_loads_total")
 def load_checkpoint_into(grid, filename: str, *, header_size: int = 0,
                          variable=None, verify: bool = True) -> None:
     """Load a checkpoint's exact bytes into an ALREADY-CONSTRUCTED
@@ -916,6 +922,7 @@ def load_checkpoint_into(grid, filename: str, *, header_size: int = 0,
     grid.update_copies_of_remote_neighbors()
 
 
+@telemetry.traced("ckpt.load", counter="dccrg_loads_total")
 def load_checkpoint(filename: str, cell_data, mesh=None,
                     header_size: int = 0, variable=None, strict: bool = True,
                     load_balancing_method=None):
@@ -1382,7 +1389,9 @@ class ResilientRunner:
 
         if not self._integrity_on() or self._integrity_base is None:
             return None
-        now = self._conservation_sums()
+        telemetry.inc("dccrg_integrity_checks_total", where="runner")
+        with telemetry.span("integrity.check"):
+            now = self._conservation_sums()
         steps = max(1, self.step - (self._ckpt_step or 0))
         details = {}
         for i, name in enumerate(self.conserved_fields):
@@ -1406,11 +1415,13 @@ class ResilientRunner:
         # verifies + materializes the keyframe+delta chain (a broken
         # chain surfaces as DeltaChainError — a corrupt rollback
         # target either way)
-        load_checkpoint_into(self.grid, self.checkpoint_path,
-                             header_size=len(self.header),
-                             variable=self.variable)
+        with telemetry.span("runner.rollback"):
+            load_checkpoint_into(self.grid, self.checkpoint_path,
+                                 header_size=len(self.header),
+                                 variable=self.variable)
         self.step = self._ckpt_step
         self.rollbacks += 1
+        telemetry.inc("dccrg_rollbacks_total")
 
     # -- trip handling ------------------------------------------------
 
@@ -1444,6 +1455,7 @@ class ResilientRunner:
             self._retry_streak = 0  # progress since the last trip
         self._streak_step = self.step
         self._retry_streak += 1
+        telemetry.inc("dccrg_trips_total", kind=kind)
         bundle = self._dump_diagnostics(details)
         logger.warning(
             "watchdog trip (%s) at step %d (fields %s); rolling back "

@@ -68,12 +68,119 @@ from contextlib import nullcontext
 
 import numpy as np
 
-from . import faults, integrity, resilience, supervise
+from . import faults, integrity, resilience, supervise, telemetry
 from .fleet import (SHADOW, FleetJob, GridBatch, max_batch_default,
                     quantum_default)
 from .grid import bucket_capacity
 
 logger = logging.getLogger("dccrg_tpu.scheduler")
+
+
+class SLOPolicy:
+    """Latency-SLO admission + shedding, fed by telemetry.
+
+    The scheduler reports every bucket's measured quantum dispatch
+    latency into :meth:`observe`; the policy keeps a per-bucket-key
+    EWMA and turns it into two decisions:
+
+    - **admission order** (:meth:`admission_key`): a job with a
+      ``slo_ms`` deadline whose PROJECTED completion — remaining
+      quanta x the EWMA latency of its bucket key, measured from its
+      first enqueue — would violate the deadline jumps the priority
+      queue (most-violated first); everything else keeps the plain
+      ``(priority, FIFO)`` order, so a fleet without SLOs (or without
+      latency pressure) admits byte-identically to the priority-only
+      baseline;
+    - **shedding** (:meth:`shed_victims`): when a bucket's measured
+      quantum latency blows the TIGHTEST admitted slot SLO (negative
+      slack), the least-urgent cohabitants — best-effort jobs first,
+      lowest priority first, then the loosest-slack SLO jobs, never
+      the tightest — are requeued so the scheduler can rebuild the
+      bucket smaller (half capacity: fewer slots per dispatch = lower
+      quantum latency for the jobs that stay).
+
+    Deterministic by construction: ``clock`` is injectable (the
+    pinned tests drive a fake clock and hand-fed observations) and
+    the EWMA state is plain floats."""
+
+    def __init__(self, quantum=None, alpha=0.25, clock=time.monotonic,
+                 shed_cooldown=4):
+        self.quantum = (quantum_default() if quantum is None
+                        else max(1, int(quantum)))
+        self.alpha = float(alpha)
+        self.clock = clock
+        #: ticks a bucket is left alone after a shed rebuild (the
+        #: fresh, smaller bucket must re-measure before re-shedding)
+        self.shed_cooldown = int(shed_cooldown)
+        self._ewma: dict = {}  # bucket key -> EWMA quantum seconds
+
+    def observe(self, key, seconds: float) -> None:
+        """Fold one measured quantum dispatch latency into the
+        bucket key's EWMA."""
+        e = self._ewma.get(key)
+        self._ewma[key] = (float(seconds) if e is None
+                           else (1.0 - self.alpha) * e
+                           + self.alpha * float(seconds))
+
+    def quantum_latency(self, key):
+        """The EWMA quantum latency of ``key`` (None: unmeasured)."""
+        return self._ewma.get(key)
+
+    def reset_key(self, key) -> None:
+        """Forget a bucket key's EWMA (after a shed rebuild: the
+        smaller bucket must be measured fresh, not judged by its
+        predecessor's latency)."""
+        self._ewma.pop(key, None)
+
+    def projected_completion_s(self, job) -> float:
+        """Projected seconds to finish ``job``: remaining quanta x
+        the EWMA latency of its bucket key (0 when unmeasured — no
+        data never reorders the queue)."""
+        lat = self._ewma.get(job.bucket_key())
+        if lat is None:
+            return 0.0
+        remaining = max(0, job.n_steps - job.steps_done)
+        quanta = -(-remaining // self.quantum)  # ceil
+        return quanta * lat
+
+    def slack_s(self, job):
+        """Seconds of SLO budget left after the projected completion
+        (None for best-effort jobs; negative = projected violation)."""
+        if job.slo_ms is None or job.slo_t0 is None:
+            return None
+        budget = job.slo_ms / 1e3 - (self.clock() - job.slo_t0)
+        return budget - self.projected_completion_s(job)
+
+    def admission_key(self, job, seq):
+        """Sort key for one admission pass: SLO-violating jobs first
+        (most negative slack first), then the priority-FIFO
+        baseline."""
+        slack = self.slack_s(job)
+        if slack is not None and slack < 0.0:
+            return (0, slack, -job.priority, seq)
+        return (1, 0.0, -job.priority, seq)
+
+    def shed_victims(self, key, jobs) -> list:
+        """The ``[(slot, job)]`` to requeue out of a bucket whose
+        measured quantum latency blows its tightest admitted SLO —
+        empty when the bucket is unmeasured, single-job, SLO-free, or
+        every SLO still has slack. At most half the jobs shed, and
+        the tightest-slack SLO job never does (shedding it would
+        serve nobody)."""
+        if len(jobs) <= 1 or self._ewma.get(key) is None:
+            return []
+        slacks = {j.name: self.slack_s(j) for _s, j in jobs}
+        slo = [(s, j) for s, j in jobs if slacks[j.name] is not None]
+        if not slo or min(slacks[j.name] for _s, j in slo) >= 0.0:
+            return []
+        # least urgent first: best-effort (no SLO) by ascending
+        # priority, then SLO jobs by DESCENDING slack; the tightest
+        # stays, and at most half the bucket sheds
+        order = sorted(
+            jobs, key=lambda e: ((0, e[1].priority, -e[0])
+                                 if slacks[e[1].name] is None
+                                 else (1, -slacks[e[1].name], -e[0])))
+        return order[:min(len(jobs) // 2, len(jobs) - 1)]
 
 
 class FleetPreemptedError(RuntimeError):
@@ -103,13 +210,17 @@ class FleetScheduler:
     ``keep_every`` (per-stem retention). ``resume`` (default) restores
     a job with existing checkpoints from its newest verifying one
     instead of reinitializing. ``devices`` spreads bucket instances
-    round-robin over a device list (default: the default device)."""
+    round-robin over a device list (default: the default device).
+    ``slo_policy`` injects a custom :class:`SLOPolicy` (fake clock /
+    tuned EWMA for the deterministic tests); the default one is fed
+    by the telemetry-measured quantum latencies and drives both the
+    SLO admission reorder and the over-latency bucket shedding."""
 
     def __init__(self, checkpoint_dir, jobs=(), *, max_batch=None,
                  quantum=None, keep_last=None, keep_every=0,
                  resume=True, devices=None,
                  install_signal_handlers=False, audit_every=None,
-                 quarantine_after=None):
+                 quarantine_after=None, slo_policy=None):
         self.dir = str(checkpoint_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.max_batch = (max_batch_default() if max_batch is None
@@ -141,6 +252,11 @@ class FleetScheduler:
         self.audit_failures = 0
         self._audit_rr = 0
         self._pending_quarantine: set = set()
+        # latency-SLO admission: quantum-latency EWMAs measured by the
+        # telemetry-instrumented dispatch feed the policy; a custom
+        # policy (fake clock, tuned alpha) is injectable for tests
+        self.slo = (SLOPolicy(quantum=self.quantum)
+                    if slo_policy is None else slo_policy)
         self._queue: list = []  # heap of (-priority, seq, job)
         self._seq = itertools.count()
         self._by_name: dict = {}
@@ -165,6 +281,10 @@ class FleetScheduler:
                 "checkpoint stem and must be unique per scheduler")
         self._by_name[job.name] = job
         job.status = "queued"
+        if job.slo_ms is not None and job.slo_t0 is None:
+            # the SLO clock starts at the FIRST enqueue (requeues and
+            # re-adds keep the original deadline)
+            job.slo_t0 = self.slo.clock()
         heapq.heappush(self._queue, (-job.priority, next(self._seq), job))
 
     def store_for(self, job: FleetJob) -> supervise.CheckpointStore:
@@ -181,13 +301,15 @@ class FleetScheduler:
         return [i for i in range(len(self.devices))
                 if i not in self.quarantined]
 
-    def _bucket_for(self, job: FleetJob) -> GridBatch:
+    def _bucket_for(self, job: FleetJob, pending=None) -> GridBatch:
         """A bucket instance with a free slot for ``job``'s key, or
         None. Creates a new instance (round-robin over the live,
         non-quarantined ``devices`` lanes) sized to the demand visible
         NOW — bucket_capacity-rounded so later fluctuations reuse the
         compile — when every existing one is full and the lane list
-        allows another."""
+        allows another. ``pending`` is the not-yet-admitted job list
+        the demand sizing counts (default: the queue — the admission
+        pass drains the queue first and passes its remainder)."""
         key = job.bucket_key()
         insts = self.buckets.setdefault(key, [])
         for b in insts:
@@ -196,10 +318,12 @@ class FleetScheduler:
         lanes = self.live_lanes()
         if len(insts) >= len(lanes):
             return None
+        if pending is None:
+            pending = [j for _p, _s, j in self._queue]
         # DMR jobs occupy redundancy slots each (primary + shadows):
         # size the bucket for the SLOT demand, not the job count
         same_key = job.redundancy + sum(
-            j.redundancy for _p, _s, j in self._queue
+            j.redundancy for j in pending
             if j.bucket_key() == key)
         cap = min(self.max_batch, bucket_capacity(same_key))
         lane = lanes[self._next_dev % len(lanes)]
@@ -211,23 +335,39 @@ class FleetScheduler:
 
     def _admit_pending(self) -> int:
         """One admission pass: place every queued job that fits
-        (priority order; non-fitting jobs go back and backfill
-        later). Returns how many were admitted."""
-        deferred, admitted = [], 0
-        while self._queue:
-            item = heapq.heappop(self._queue)
-            job = item[2]
-            batch = self._bucket_for(job)
-            if batch is None:
-                deferred.append(item)
-                continue
-            self._admit_into(batch, job)
-            admitted += 1
-        for item in deferred:
-            heapq.heappush(self._queue, item)
-        return admitted
+        (SLO-urgency order, then priority; non-fitting jobs go back
+        and backfill later). Returns how many were admitted.
+
+        The pass drains the priority heap, re-orders it through
+        :meth:`SLOPolicy.admission_key` — jobs whose projected
+        completion (quantum-latency EWMA x remaining quanta) violates
+        their ``slo_ms`` deadline admit FIRST, most-violated first —
+        and admits in that order. With no SLO jobs (or no violation)
+        the key degrades to the exact ``(-priority, seq)`` heap order,
+        so the priority-only baseline is unchanged (pinned by the
+        deterministic reorder test in tests/test_telemetry.py)."""
+        with telemetry.span("fleet.admit"):
+            items = []
+            while self._queue:
+                items.append(heapq.heappop(self._queue))
+            items.sort(key=lambda it: self.slo.admission_key(
+                it[2], it[1]))
+            deferred, admitted = [], 0
+            for i, item in enumerate(items):
+                job = item[2]
+                batch = self._bucket_for(
+                    job, pending=[it[2] for it in items[i + 1:]])
+                if batch is None:
+                    deferred.append(item)
+                    continue
+                self._admit_into(batch, job)
+                admitted += 1
+            for item in deferred:
+                heapq.heappush(self._queue, item)
+            return admitted
 
     def _admit_into(self, batch: GridBatch, job: FleetJob) -> None:
+        telemetry.inc("dccrg_fleet_admissions_total", job=job.name)
         store = self.store_for(job)
         restored = None
         if self.resume or job.steps_done > 0 or job.requeues:
@@ -295,18 +435,21 @@ class FleetScheduler:
     # -- per-job checkpointing + retention ----------------------------
 
     def _save_job(self, batch, slot, job, force_keyframe=False) -> None:
-        g = batch.write_grid(slot)
-        store = self.store_for(job)
-        store.save(g, job.steps_done, dirty_fields=set(job.fields_out),
-                   force_keyframe=force_keyframe)
-        job.last_save_step = job.steps_done
-        try:
-            supervise.gc_checkpoints(
-                self.dir, keep_last=self.keep_last,
-                keep_every=self.keep_every, stem=job.name, apply=True,
-                assume_ok=job.steps_done)
-        except OSError as e:  # GC must never kill the fleet
-            logger.warning("per-stem GC failed for %s (%s)", job.name, e)
+        with telemetry.tags(job=job.name):
+            g = batch.write_grid(slot)
+            store = self.store_for(job)
+            store.save(g, job.steps_done,
+                       dirty_fields=set(job.fields_out),
+                       force_keyframe=force_keyframe)
+            job.last_save_step = job.steps_done
+            try:
+                supervise.gc_checkpoints(
+                    self.dir, keep_last=self.keep_last,
+                    keep_every=self.keep_every, stem=job.name,
+                    apply=True, assume_ok=job.steps_done)
+            except OSError as e:  # GC must never kill the fleet
+                logger.warning("per-stem GC failed for %s (%s)",
+                               job.name, e)
 
     # -- trips: per-slot isolation ------------------------------------
 
@@ -320,6 +463,7 @@ class FleetScheduler:
         shrinks; re-admission restores from the same stem, possibly
         into a different slot or bucket)."""
         job.trips.append((kind, job.steps_done))
+        telemetry.inc("dccrg_fleet_trips_total", job=job.name, kind=kind)
         if job.steps_done > job._last_trip_step:
             job.retries = 0  # progress since the last trip
         job._last_trip_step = job.steps_done
@@ -352,6 +496,8 @@ class FleetScheduler:
         # re-diverge only through real corruption)
         job._fp = None
         batch.sync_shadow(slot)
+        job.rollbacks += 1
+        telemetry.inc("dccrg_fleet_rollbacks_total", job=job.name)
         job.steps_done = restored
         # re-baseline the cadence like _admit_into: a fallback to an
         # OLDER checkpoint would otherwise leave steps_done -
@@ -364,6 +510,14 @@ class FleetScheduler:
             job.digest = batch.digest(slot)
         job.status = status
         batch.clear(slot)
+        telemetry.inc("dccrg_fleet_finished_total", status=status)
+        slo_met = None
+        if job.slo_ms is not None and job.slo_t0 is not None:
+            took_ms = (self.slo.clock() - job.slo_t0) * 1e3
+            # a failed job never met its SLO, however fast it failed
+            slo_met = bool(status == "done" and took_ms <= job.slo_ms)
+            telemetry.inc("dccrg_fleet_slo_total",
+                          met=("yes" if slo_met else "no"))
         self.report[job.name] = {
             "status": status, "steps": job.steps_done,
             "digest": job.digest, "trips": len(job.trips),
@@ -371,6 +525,8 @@ class FleetScheduler:
                              if k == "corrupt"),
             "retries_final": job.retries, "requeues": job.requeues,
             "transient_retries": job.transient_retries,
+            "rollbacks": job.rollbacks,
+            "slo_ms": job.slo_ms, "slo_met": slo_met,
         }
 
     # -- one bucket quantum -------------------------------------------
@@ -414,6 +570,10 @@ class FleetScheduler:
                 self._trip(batch, slot, job, "oom")
 
     def _quantum(self, batch) -> None:
+        with telemetry.span("fleet.quantum"):
+            self._quantum_inner(batch)
+
+    def _quantum_inner(self, batch) -> None:
         self._fire_dispatch_faults(batch)
         active = batch.jobs
         if not active:
@@ -431,6 +591,7 @@ class FleetScheduler:
         # state at the sampled cadence; after the dispatch the same
         # quantum is re-executed from it and compared bitwise
         audit_slot, audit_pre = self._pick_audit(batch, active, budget)
+        t_dispatch = time.perf_counter()
         try:
             batch.step(budget)
         except Exception as e:  # noqa: BLE001 - filtered below
@@ -456,6 +617,23 @@ class FleetScheduler:
                                self._fault_cells(batch, cells), bit)
         # per-slot watchdog: a tripped slot rolls back alone
         ok = batch.finite_slots()
+        # the finite pull is the quantum's sync point, so the elapsed
+        # time IS the measured dispatch latency — recorded per job in
+        # the registry (the fleet CLI's p50/p99 source) and folded
+        # into the SLO policy's per-bucket EWMA. The EWMA skips a
+        # batch instance's FIRST dispatch: it may carry the XLA
+        # compile (seconds against millisecond quanta), and judging a
+        # healthy bucket by its warmup would shed it spuriously —
+        # each shed rebuild compiles again, re-poisoning the freshly
+        # reset EWMA in a feedback loop of pointless halvings.
+        lat = time.perf_counter() - t_dispatch
+        if batch.dispatches > 1:
+            self.slo.observe(batch.key, lat)
+        telemetry.observe("dccrg_fleet_quantum_seconds", lat)
+        for slot, job in active:
+            if budget[slot] > 0:
+                telemetry.observe("dccrg_fleet_quantum_seconds", lat,
+                                  job=job.name)
         tripped = set()
         for slot, job in active:
             if batch.slots[slot] is job and not ok[slot]:
@@ -518,6 +696,7 @@ class FleetScheduler:
         Any mismatch is a CORRUPT verdict: the victim rolls back
         alone (the NaN discipline) and the batch's device lane takes
         a suspect mark."""
+        telemetry.inc("dccrg_integrity_checks_total", where="fleet")
         need_now = set()
         for slot, job in active:
             if slot in tripped or batch.slots[slot] is not job:
@@ -600,44 +779,20 @@ class FleetScheduler:
         attributed to this slot and its device lane: either the
         original execution or the state since (an injected flip, HBM
         rot) is wrong, and the checkpoint chain predates both."""
-        import jax
-        import jax.numpy as jnp
-
         job = batch.slots[slot]
         if job is None or job is SHADOW or steps <= 0:
             return
         self.audits += 1
+        telemetry.inc("dccrg_audits_total")
         try:
-            live = batch.digest(slot)
-            spare = batch.free_slot()
-            if spare is not None:
-                saved_extras = batch._extras[spare].copy()
-                batch.insert(spare, pre)
-                batch._extras[spare] = batch._extras[slot]
-                bud = np.zeros(batch.capacity, dtype=np.int32)
-                bud[spare] = steps
-                batch.step(bud)
-                shadow = batch.digest(spare)
-                batch._extras[spare] = saved_extras
-            else:
-                # solo re-execution: the unbatched path recomputes the
-                # same quantum (bitwise identical by the fleet parity
-                # contract), diversifying the program the audit trusts
-                sh = batch.grid._sharding()
-                for n, arr in pre.items():
-                    batch.grid.data[n] = jax.device_put(arr[None], sh)
-                batch.grid.run_steps(
-                    batch.kernel, batch.fields_in, batch.fields_out,
-                    steps, extra_args=tuple(
-                        jnp.float32(p) for p in job.params))
-                from . import checkpoint as checkpoint_mod
-
-                shadow = checkpoint_mod.state_digest(batch.grid)
+            with telemetry.span("integrity.audit"):
+                live, shadow = self._audit_digests(batch, slot, pre,
+                                                   steps, job)
         except Exception as e:  # noqa: BLE001 - filtered just below
             if not resilience._is_resource_exhausted(e):
                 raise
             # an OOM during the EXTRA audit dispatch must never kill
-            # the fleet the audit exists to protect: skip this window
+            # the fleet the audit protects: skip this window
             # (no verdict either way); if the pressure is real, the
             # next MAIN dispatch OOMs into _batch_oom's half-capacity
             # rebuild as usual
@@ -645,13 +800,49 @@ class FleetScheduler:
                 "shadow audit of job %s skipped: the audit dispatch "
                 "itself hit RESOURCE_EXHAUSTED (%s)", job.name, e)
             return
+        # the verdict + containment run OUTSIDE the OOM-swallowing
+        # try: only the audit's own extra dispatches may be skipped —
+        # an OOM inside _sdc_trip's rollback must propagate, never
+        # leave a half-applied trip on corrupt state
         if shadow != live:
             self.audit_failures += 1
+            telemetry.inc("dccrg_audit_failures_total")
             tripped.add(slot)
             self._sdc_trip(
                 batch, slot, job,
                 f"shadow re-execution of the last {steps}-step "
                 "quantum diverged from the live slot")
+
+    def _audit_digests(self, batch, slot, pre, steps, job):
+        import jax
+        import jax.numpy as jnp
+
+        live = batch.digest(slot)
+        spare = batch.free_slot()
+        if spare is not None:
+            saved_extras = batch._extras[spare].copy()
+            batch.insert(spare, pre)
+            batch._extras[spare] = batch._extras[slot]
+            bud = np.zeros(batch.capacity, dtype=np.int32)
+            bud[spare] = steps
+            batch.step(bud)
+            shadow = batch.digest(spare)
+            batch._extras[spare] = saved_extras
+        else:
+            # solo re-execution: the unbatched path recomputes the
+            # same quantum (bitwise identical by the fleet parity
+            # contract), diversifying the program the audit trusts
+            sh = batch.grid._sharding()
+            for n, arr in pre.items():
+                batch.grid.data[n] = jax.device_put(arr[None], sh)
+            batch.grid.run_steps(
+                batch.kernel, batch.fields_in, batch.fields_out,
+                steps, extra_args=tuple(
+                    jnp.float32(p) for p in job.params))
+            from . import checkpoint as checkpoint_mod
+
+            shadow = checkpoint_mod.state_digest(batch.grid)
+        return live, shadow
 
     def _check_dmr(self, batch, tripped) -> None:
         """Dual-modular-redundancy comparison: every
@@ -738,31 +929,25 @@ class FleetScheduler:
             "migrated %d job(s) bit-exactly to surviving lane(s) %s",
             lane, self.suspects[lane], moved, survivors)
 
-    def _batch_oom(self, batch, err) -> None:
-        """A REAL (unattributed) RESOURCE_EXHAUSTED from the batched
-        dispatch: the whole working set is too big. Requeue the
-        lower-priority half of the bucket's jobs (their slot state is
-        intact — the dispatch failed wholesale — so each saves a
-        keyframe first) and REBUILD the bucket at a smaller capacity:
-        occupancy alone frees no device memory (the state arrays and
-        the compiled program are both sized ``[capacity, ...]``), and
-        the freed slots would be backfilled from the queue on the very
-        next tick, re-creating the same working set forever. The
-        survivors migrate bit-exactly into the half-size batch;
-        repeated OOMs keep halving until a single job's failure is
-        surfaced."""
-        active = batch.jobs
-        if len(active) <= 1:
-            raise resilience.ResilienceExhaustedError(
-                f"fleet bucket OOMs even with {len(active)} job(s)"
-            ) from err
-        by_prio = sorted(active, key=lambda e: (e[1].priority, -e[0]))
-        drop = len(active) // 2
-        for slot, job in by_prio[:drop]:
+    def _requeue_keyframed(self, batch, victims) -> None:
+        """Requeue ``[(slot, job)]`` out of a live bucket: each slot's
+        intact state saves a keyframe first, so re-admission resumes
+        from here instead of replaying since the last periodic save
+        (shared by the batch-OOM and SLO-shed paths)."""
+        for slot, job in victims:
             self._save_job(batch, slot, job, force_keyframe=True)
             batch.clear(slot)
             job.requeues += 1
             self.add(job)
+
+    def _rebuild_smaller(self, batch) -> GridBatch:
+        """Replace ``batch`` with a half-capacity instance (floored at
+        the survivor count) holding every surviving job migrated
+        BIT-EXACTLY — the shrink primitive the batch-OOM and SLO-shed
+        paths share. Occupancy alone frees neither device memory nor
+        dispatch latency: the state arrays and the compiled program
+        are both sized ``[capacity, ...]``, and freed slots would be
+        backfilled from the queue on the very next tick."""
         survivors = batch.jobs
         new_cap = max(len(survivors), batch.capacity // 2)
         small = GridBatch(survivors[0][1], new_cap, device=batch.device)
@@ -779,23 +964,83 @@ class FleetScheduler:
                     job.name)
         insts = self.buckets[batch.key]
         insts[insts.index(batch)] = small
+        # ANY rebuild changes the bucket's latency characteristics
+        # (half the slots, and a fresh compile on the first dispatch):
+        # reset the key's SLO EWMA and start the shed cooldown, so
+        # the new instance is judged by its own measurements — on the
+        # OOM path exactly as on the shed path
+        self.slo.reset_key(batch.key)
+        small._shed_tick = self.ticks
+        return small
+
+    def _batch_oom(self, batch, err) -> None:
+        """A REAL (unattributed) RESOURCE_EXHAUSTED from the batched
+        dispatch: the whole working set is too big. Requeue the
+        lower-priority half of the bucket's jobs (their slot state is
+        intact — the dispatch failed wholesale — so each saves a
+        keyframe first) and REBUILD the bucket at a smaller capacity
+        (:meth:`_rebuild_smaller`); repeated OOMs keep halving until
+        a single job's failure is surfaced."""
+        active = batch.jobs
+        if len(active) <= 1:
+            raise resilience.ResilienceExhaustedError(
+                f"fleet bucket OOMs even with {len(active)} job(s)"
+            ) from err
+        by_prio = sorted(active, key=lambda e: (e[1].priority, -e[0]))
+        drop = len(active) // 2
+        self._requeue_keyframed(batch, by_prio[:drop])
+        small = self._rebuild_smaller(batch)
         logger.warning(
             "fleet bucket OOM: requeued %d of %d job(s), rebuilt the "
             "bucket at capacity %d (was %d)", drop, len(active),
-            new_cap, batch.capacity)
+            small.capacity, batch.capacity)
+
+    # -- latency-SLO shedding -----------------------------------------
+
+    def _shed_for_slo(self, batch) -> None:
+        """When ``batch``'s measured quantum latency blows the
+        tightest admitted slot SLO (:meth:`SLOPolicy.shed_victims`),
+        requeue the least-urgent cohabitants — keyframe first, so
+        re-admission resumes from here — and REBUILD the bucket at
+        half capacity with the survivors migrated bit-exactly (the
+        ``_batch_oom`` discipline: occupancy alone frees no dispatch
+        latency — the program is sized ``[capacity, ...]`` — and a
+        freed slot would be backfilled next tick). The key's EWMA
+        resets so the smaller bucket is judged by its own
+        measurements, with a ``shed_cooldown``-tick grace."""
+        victims = self.slo.shed_victims(batch.key, batch.jobs)
+        if not victims:
+            return
+        if self.ticks - getattr(batch, "_shed_tick", -10**9) \
+                < self.slo.shed_cooldown:
+            return
+        for _slot, job in victims:
+            telemetry.inc("dccrg_fleet_slo_sheds_total", job=job.name)
+        self._requeue_keyframed(batch, victims)
+        # shed_victims caps at len(jobs)-1, so a survivor always
+        # remains for the rebuild
+        small = self._rebuild_smaller(batch)
+        logger.warning(
+            "SLO shed: requeued %d job(s) and rebuilt the bucket at "
+            "capacity %d (was %d) — measured quantum latency blew "
+            "the tightest admitted SLO", len(victims), small.capacity,
+            batch.capacity)
 
     # -- preemption ---------------------------------------------------
 
     def _preempt(self) -> None:
         requeued = []
-        for insts in self.buckets.values():
-            for batch in insts:
-                for slot, job in batch.jobs:
-                    self._save_job(batch, slot, job, force_keyframe=True)
-                    batch.clear(slot)
-                    job.requeues += 1
-                    self.add(job)
-                    requeued.append(job.name)
+        with telemetry.span("fleet.preempt"):
+            for insts in self.buckets.values():
+                for batch in insts:
+                    for slot, job in batch.jobs:
+                        self._save_job(batch, slot, job,
+                                       force_keyframe=True)
+                        batch.clear(slot)
+                        job.requeues += 1
+                        self.add(job)
+                        requeued.append(job.name)
+        telemetry.inc("dccrg_fleet_preempts_total")
         supervise.clear_preempt()
         raise FleetPreemptedError(requeued)
 
@@ -836,7 +1081,16 @@ class FleetScheduler:
                     if lane not in self.quarantined:
                         self._quarantine(lane)
                 self._pending_quarantine.clear()
+                # latency-SLO shedding, also a tick-boundary act (it
+                # replaces bucket instances); iterate a snapshot of
+                # the CURRENT instances — a _batch_oom mid-tick may
+                # already have swapped one out
+                for insts in list(self.buckets.values()):
+                    for batch in list(insts):
+                        if batch.jobs:
+                            self._shed_for_slo(batch)
                 self.ticks += 1
+                telemetry.maybe_export_metrics()
                 if max_ticks is not None and self.ticks >= int(max_ticks):
                     break
         return self.report

@@ -80,7 +80,7 @@ from contextlib import contextmanager, nullcontext
 from dataclasses import dataclass, field as dataclass_field
 
 from . import checkpoint as checkpoint_mod
-from . import coord, faults, resilience
+from . import coord, faults, resilience, telemetry
 
 logger = logging.getLogger("dccrg_tpu.supervise")
 
@@ -316,61 +316,15 @@ def _grace_env(grace: float):
             os.environ["DCCRG_BARRIER_TIMEOUT"] = old
 
 
-class LatencyHistogram:
-    """Fixed log-spaced step-latency buckets.
-
-    Bucket 0 covers ``[0, BASE)`` seconds and bucket ``i >= 1`` covers
-    ``[BASE * 2**(i-1), BASE * 2**i)`` (the last absorbs the upper
-    tail), so the whole histogram
-    is ~30 ints — cheap enough to update every step forever, yet wide
-    enough (100 us .. ~15 hours) that a slowly degrading interconnect shows
-    up as mass migrating to the right long before a step actually
-    wedges into :class:`StepTimeoutError`."""
-
-    BASE = 1e-4  # seconds; bucket 0 = anything below 200 us
-    N_BUCKETS = 30
-
-    def __init__(self):
-        self.counts = [0] * self.N_BUCKETS
-        self.total = 0
-        self.max_seconds = 0.0
-
-    def record(self, seconds: float) -> None:
-        seconds = max(float(seconds), 0.0)
-        i = 0 if seconds < self.BASE else int(
-            math.log2(seconds / self.BASE)) + 1
-        self.counts[min(max(i, 0), self.N_BUCKETS - 1)] += 1
-        self.total += 1
-        self.max_seconds = max(self.max_seconds, seconds)
-
-    def buckets(self) -> list:
-        """``[(lo_seconds, hi_seconds, count)]`` for every bucket."""
-        out = []
-        for i, c in enumerate(self.counts):
-            lo = 0.0 if i == 0 else self.BASE * (2.0 ** (i - 1))
-            hi = self.BASE * (2.0 ** i)
-            out.append((lo, hi, c))
-        return out
-
-    def quantile(self, q: float) -> float:
-        """Upper edge of the bucket holding the q-quantile (0 when
-        nothing was recorded)."""
-        if self.total == 0:
-            return 0.0
-        target = max(1, math.ceil(q * self.total))
-        seen = 0
-        for lo, hi, c in self.buckets():
-            seen += c
-            if seen >= target:
-                return hi
-        return self.buckets()[-1][1]
-
-    def summary(self) -> str:
-        if self.total == 0:
-            return "no steps recorded"
-        return (f"{self.total} steps, p50<={self.quantile(0.5):.3g}s, "
-                f"p95<={self.quantile(0.95):.3g}s, "
-                f"max={self.max_seconds:.3g}s")
+#: The per-step latency histogram — a thin alias over THE histogram
+#: implementation (:class:`dccrg_tpu.telemetry.LogHistogram`), kept
+#: under its historical name so ``SupervisedRunner.latency_histogram``
+#: callers and subclasses see the identical API (``record`` /
+#: ``buckets`` / ``quantile`` / ``summary`` / ``counts`` / ``total`` /
+#: ``max_seconds``, BASE=1e-4, 30 buckets). There is exactly one
+#: histogram type in the codebase; the telemetry registry's
+#: ``dccrg_step_seconds`` series is fed from the same measurements.
+LatencyHistogram = telemetry.LogHistogram
 
 
 # markers of the transient class of XLA runtime errors (a flaky
@@ -550,6 +504,7 @@ def chain_report(dirpath: str, stem: str | None = None) -> list:
     return out
 
 
+@telemetry.traced("ckpt.gc")
 def gc_checkpoints(dirpath: str, keep_last: int = 3, keep_every: int = 0,
                    stem: str | None = None, apply: bool = False,
                    assume_ok: int | None = None) -> GCReport:
@@ -667,6 +622,8 @@ def gc_checkpoints(dirpath: str, keep_last: int = 3, keep_every: int = 0,
         for path in stale:
             faults.fire("checkpoint.gc", path=path, step=None)
             _unlink(path)
+        telemetry.inc("dccrg_gc_pruned_total",
+                      len(dropped) + len(stale))
     return GCReport(kept=kept, dropped=dropped, stale_temps=stale,
                     rescued=rescued, refused=refused,
                     applied=bool(apply))
@@ -1055,9 +1012,10 @@ class SupervisedRunner:
     def _timed_step(self, grid, i):
         t0 = time.perf_counter()
         try:
-            self._timed_step_inner(grid, i)
+            with telemetry.span("step"):
+                self._timed_step_inner(grid, i)
         except StepTimeoutError:
-            self._latency.record(time.perf_counter() - t0)
+            self._record_latency(time.perf_counter() - t0)
             # the latency trend BEFORE the wedge is the diagnosis: a
             # slowly degrading interconnect shows as mass migrating
             # into the slow buckets over the preceding steps
@@ -1065,7 +1023,13 @@ class SupervisedRunner:
                            i, self._latency.summary())
             raise
         else:
-            self._latency.record(time.perf_counter() - t0)
+            self._record_latency(time.perf_counter() - t0)
+
+    def _record_latency(self, seconds: float) -> None:
+        self._latency.record(seconds)
+        # the same measurement feeds the process-wide registry, so
+        # dump_prometheus carries the step-latency distribution too
+        telemetry.observe("dccrg_step_seconds", seconds)
 
     def _timed_step_inner(self, grid, i):
         timeout = self.step_timeout
@@ -1122,7 +1086,7 @@ class SupervisedRunner:
                     f"verification (chunks {bad})", bad_chunks=bad)
 
         try:
-            with _grace_env(self.grace):
+            with telemetry.span("ckpt.emergency"), _grace_env(self.grace):
                 _under_deadline(_save, self.grace,
                                 f"emergency checkpoint at step {step}",
                                 step=step)
@@ -1158,3 +1122,6 @@ class SupervisedRunner:
                 "retention GC: pruned %d checkpoint(s) and %d stale "
                 "temp file(s); %d kept", len(rep.dropped),
                 len(rep.stale_temps), len(rep.kept))
+        # save boundaries are the supervised loop's natural metrics
+        # cadence (one None check without DCCRG_METRICS_FILE)
+        telemetry.maybe_export_metrics()

@@ -26,6 +26,13 @@
 # device lane is taken out of service with its survivors migrated
 # bit-exactly (all digests still equal the solo runs).
 #
+# Also runs a telemetry smoke leg: the strictly-best-effort exporter
+# contract (EVERY telemetry write failing must leave the supervised
+# run with zero trips/rollbacks), the deterministic latency-SLO
+# admission reorder vs the priority-only baseline, and the
+# trace-coverage acceptance (a traced fleet run's depth-0 spans
+# account for >= 95% of the serving wall-clock).
+#
 # Usage: tests/ci_debug_leg.sh [extra pytest args]
 set -e
 cd "$(dirname "$0")/.."
@@ -40,6 +47,11 @@ env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_integrity.py::test_silent_flip_detected_within_one_quantum" \
     "tests/test_integrity.py::test_repeat_offender_lane_quarantined_and_migrated" \
     "tests/test_integrity.py::test_fleet_fuzz_flip_scenario" \
+    -p no:cacheprovider "$@"
+env JAX_PLATFORMS=cpu python -m pytest -q \
+    "tests/test_telemetry.py::test_exporter_faults_never_trip_a_run" \
+    "tests/test_telemetry.py::test_slo_admission_reorders_vs_priority_baseline" \
+    "tests/test_telemetry.py::test_fleet_trace_covers_step_wall_clock" \
     -p no:cacheprovider "$@"
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_recommit.py::test_native_numpy_plans_bitwise_identical" \

@@ -13,7 +13,10 @@
 # incremental-checkpoint delta_rank_kill scenario (keyframe+delta
 # chains through the real two-phase commit, a REAL rank death at
 # every delta-commit phase, chain-aware resume digest-compared with
-# an uninterrupted run). Complements the faked splits of
+# an uninterrupted run), plus the telemetry trace_merge scenario
+# (rank-tagged span traces from 2 real ranks — steps, halo
+# exchanges, the collective two-phase save — merged into one
+# coherent wall-clock timeline). Complements the faked splits of
 # tests/test_multiprocess.py (which run in tier-1) with actual OS
 # processes.
 #

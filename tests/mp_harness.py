@@ -40,6 +40,12 @@ drives the scenarios the faked splits cannot truthfully exercise:
   timeouts) and exit with the resumable code 75; (resume)
   ``supervise.resume_latest`` picks the emergency checkpoint up, the
   run completes, and its digest must equal ref's bit-for-bit.
+- ``trace_merge``   — telemetry tracing across 2 real ranks: each
+  rank records spans (steps, halo exchanges, the collective two-phase
+  checkpoint save) with ``DCCRG_TRACE`` semantics, flushes its own
+  JSONL trace file, and the rank-tagged files merge into ONE coherent
+  wall-clock-ordered timeline (``telemetry.merge_traces``) whose
+  collective-save spans overlap across ranks.
 - ``delta_rank_kill`` — incremental (delta) checkpoints through the
   REAL two-phase commit, in two parts: (restore) a step loop writes a
   keyframe + dirty-field delta chain through real barriers and the
@@ -87,7 +93,8 @@ SKIP_RC = 77
 DEATH_RC = 17
 RESUMABLE_RC = 75  # supervise.RESUMABLE_EXIT (EX_TEMPFAIL)
 SCENARIOS = ("save_restore", "psum", "barrier_timeout", "rank_kill",
-             "consensus", "sdc_rank", "preempt", "delta_rank_kill")
+             "consensus", "sdc_rank", "preempt", "delta_rank_kill",
+             "trace_merge")
 # child-side phase names of the parent-orchestrated preempt scenario
 PREEMPT_PHASES = ("preempt_ref", "preempt_kill", "preempt_resume")
 PREEMPT_STEPS = 8
@@ -726,6 +733,70 @@ def scenario_preempt_resume(args):
     _write_digest(args, g, "resume")
 
 
+def scenario_trace_merge(args):
+    """Telemetry tracing across 2 REAL ranks: each rank runs the same
+    small loop (fused steps + halo refresh + one collective two-phase
+    checkpoint) with tracing on, flushes its span ring to its own
+    JSONL file, and rank 0 merges the per-rank files into one
+    timeline — the events must carry the correct ``coord`` rank ids,
+    come out wall-clock-ordered, include the step/exchange/save span
+    names from EVERY rank, and the two ranks' collective-save spans
+    must overlap in time (they synchronize on the same commit
+    barriers)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from dccrg_tpu import coord, resilience, telemetry
+
+    telemetry.configure(trace=True)
+    telemetry.clear_trace()
+    g = _mk_grid(args.seed)
+
+    def kern(c, nbr, offs, mask):
+        s = jnp.sum(jnp.where(mask, nbr["v"], jnp.float32(0)), axis=1)
+        return {"v": jnp.float32(0.5) * c["v"] + jnp.float32(0.0625) * s}
+
+    for _ in range(3):
+        g.run_steps(kern, ["v"], ["v"], 1)
+        g.update_copies_of_remote_neighbors()
+    fn = os.path.join(args.tmp, "trace_ckpt.dc")
+    resilience.save_checkpoint(g, fn)  # two-phase: real barriers
+    path = os.path.join(args.tmp, f"trace_r{args.rank}.jsonl")
+    n = telemetry.flush_trace(path)
+    telemetry.configure(trace=False)
+    assert n > 0, "no span events recorded with tracing on"
+    coord.barrier("trace_flush", timeout=60)
+    if args.rank == 0:
+        paths = [os.path.join(args.tmp, f"trace_r{r}.jsonl")
+                 for r in range(args.procs)]
+        evs = telemetry.merge_traces(paths)
+        ranks = {e["rank"] for e in evs}
+        assert ranks == set(range(args.procs)), ranks
+        ts = [e["ts"] for e in evs]
+        assert ts == sorted(ts), "merged timeline not ts-ordered"
+        assert all(float(e["dur"]) >= 0.0 for e in evs)
+        for r in range(args.procs):
+            names_r = {e["name"] for e in evs if e["rank"] == r}
+            assert {"grid.step", "grid.exchange",
+                    "ckpt.save"} <= names_r, (r, names_r)
+        # the collective save really was collective: every rank's
+        # last ckpt.save span STRICTLY overlaps every other's — the
+        # two-phase commit's prepare/commit barriers hold all ranks
+        # inside the save simultaneously (same host, shared
+        # time.time() clock), so serialized saves would fail this
+        last_saves = [
+            [e for e in evs
+             if e["rank"] == r and e["name"] == "ckpt.save"][-1]
+            for r in range(args.procs)]
+        lo = max(s["ts"] for s in last_saves)
+        hi = min(s["ts"] + s["dur"] for s in last_saves)
+        assert hi > lo, f"collective-save spans disjoint: {last_saves}"
+        print(f"[rank 0] TRACE_MERGE {len(evs)} events, "
+              f"ranks {sorted(ranks)}", flush=True)
+    coord.barrier("trace_done", timeout=60)
+
+
 CHILD_SCENARIOS = {
     "probe": scenario_probe,
     "save_restore": scenario_save_restore,
@@ -739,6 +810,7 @@ CHILD_SCENARIOS = {
     "preempt_resume": scenario_preempt_resume,
     "delta_restore": scenario_delta_restore,
     "delta_kill": scenario_delta_kill,
+    "trace_merge": scenario_trace_merge,
 }
 
 

@@ -18,7 +18,8 @@ pytestmark = [pytest.mark.mp, pytest.mark.slow]
 HARNESS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "mp_harness.py")
 SCENARIOS = ("save_restore", "psum", "barrier_timeout", "rank_kill",
-             "consensus", "sdc_rank", "preempt", "delta_rank_kill")
+             "consensus", "sdc_rank", "preempt", "delta_rank_kill",
+             "trace_merge")
 
 
 def _run(scenario, seed=0, timeout=300):
