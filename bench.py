@@ -167,6 +167,12 @@ def bench_grid_path(n=None, steps=None, label="grid path", dtype=None):
 
     n = n if n is not None else GRID_N
     steps = steps if steps is not None else GRID_STEPS
+    if dtype is None and os.environ.get("BENCH_GRID_DTYPE"):
+        # BENCH_GRID_DTYPE=bfloat16: grid-wide narrow storage for the
+        # main leg (chip_session's bulk-executor bf16 point)
+        import jax.numpy as jnp
+
+        dtype = jnp.dtype(os.environ["BENCH_GRID_DTYPE"])
     kw = {} if dtype is None else {"dtype": dtype}
     solver = GridAdvection(n=n, nz=n, **kw)
     dt = 0.5 * solver.max_time_step()
@@ -179,6 +185,12 @@ def bench_grid_path(n=None, steps=None, label="grid path", dtype=None):
     checksum = solver.checksum()
     elapsed = time.perf_counter() - t0
     assert np.isfinite(checksum)
+    # record only the engagement BIT for the pallas-bulk leg —
+    # keeping the whole Grid alive here would pin gigabytes of HBM
+    # (fields + plan tables at 512^3) across the remaining legs
+    global _BULK_ENGAGED
+    _BULK_ENGAGED = any(k[0] == "bulksteploop"
+                        for k in solver.grid._program_cache)
 
     n_cells = n * n * n
     updates_per_sec = n_cells * steps / elapsed
@@ -192,6 +204,52 @@ def bench_grid_path(n=None, steps=None, label="grid path", dtype=None):
 
 
 _GATHER_VARS = ("DCCRG_FORCE_TABLES", "DCCRG_ROLL_STENCIL")
+
+
+_BULK_ENGAGED = False  # did the most recent grid leg compile the bulk program
+
+
+def bench_grid_path_pallas(xla_ups, xla_l2):
+    """The roll-plan Pallas bulk executor (DCCRG_BULK=pallas,
+    ops/roll_executor.py) on the SAME grid-path workload: the
+    framework step loop compiled as tiled, double-buffered Pallas bulk
+    passes with fused fixup epilogues. Reported under its own JSON key
+    (null on failure — the pallas_metric discipline); the leg is
+    VOIDED unless the executor provably engaged (the bulk program in
+    the grid's cache — forced table mode from the A/B would otherwise
+    silently rebrand the XLA table path) and L2 parity against the
+    XLA roll path holds. Skipped when the user exported DCCRG_BULK
+    themselves (the headline leg already ran their mode)."""
+    if os.environ.get("BENCH_SKIP_BULK") == "1" or xla_ups is None:
+        return None, None, None
+    if os.environ.get("DCCRG_BULK", "").lower() == "pallas":
+        return None, None, "user-ran-headline-as-pallas"
+    saved = {v: os.environ.get(v) for v in _GATHER_VARS}
+    # the executor needs the closed-form plan: forced dense tables
+    # (a tables-winning A/B) would disable it at plan build
+    _set_gather_mode("roll")
+    os.environ["DCCRG_BULK"] = "pallas"
+    try:
+        ups, l2 = bench_grid_path(label="grid path pallas-bulk")
+    except Exception as e:
+        print(f"pallas-bulk grid leg failed ({e!r})", file=sys.stderr)
+        return None, None, f"failed: {e!r}"
+    finally:
+        os.environ.pop("DCCRG_BULK", None)
+        for v, val in saved.items():
+            if val is None:
+                os.environ.pop(v, None)
+            else:
+                os.environ[v] = val
+    if not _BULK_ENGAGED:
+        print("pallas-bulk leg: executor did NOT engage (ineligible "
+              "plan?); leg voided", file=sys.stderr)
+        return None, l2, "executor-did-not-engage"
+    if xla_l2 is not None and abs(l2 - xla_l2) > 1e-3 + 0.05 * abs(xla_l2):
+        print(f"pallas-bulk L2 {l2:.3e} vs xla {xla_l2:.3e}: parity "
+              "FAILED; leg voided", file=sys.stderr)
+        return None, l2, "l2-parity-failed"
+    return ups, l2, None
 
 
 def _set_gather_mode(mode):
@@ -350,6 +408,11 @@ def main() -> None:
         except Exception as e2:  # keep the JSON line flowing for the driver
             print(f"grid path bench failed again: {e2!r}", file=sys.stderr)
             grid_ups, grid_l2 = None, None
+    # snapshot the HEADLINE leg's bulk engagement before later legs
+    # overwrite the flag: a DCCRG_BULK=pallas run whose executor
+    # silently fell back (ineligible plan, multi-device mesh) must not
+    # report its XLA numbers as the Pallas executor's
+    headline_bulk_engaged = _BULK_ENGAGED
     # bfloat16 storage leg (float32 compute): halves the stencil's HBM
     # traffic — reported separately, the headline stays float32 (the
     # reference computes in double; f32 is already the recorded
@@ -362,6 +425,10 @@ def main() -> None:
                 label="grid path bf16", dtype=jnp.bfloat16)
         except Exception as e:
             print(f"bf16 leg failed ({e!r})", file=sys.stderr)
+    # the bulk-executor leg rides the same gather mode as the headline
+    # (the executor replaces the whole step program, but its XLA
+    # fallback paths should match the measured configuration)
+    bulk_ups, bulk_l2, bulk_note = bench_grid_path_pallas(grid_ups, grid_l2)
     # restore the caller's gather settings for the Pallas leg
     for v in _GATHER_VARS:
         os.environ.pop(v, None)
@@ -400,6 +467,21 @@ def main() -> None:
                 "ab_overlap_updates_per_sec": ab_ovl,
                 "bf16_updates_per_sec": bf16_ups,
                 "bf16_l2_error": bf16_l2,
+                "grid_path_pallas_updates_per_sec": bulk_ups,
+                "grid_path_pallas_l2_error": bulk_l2,
+                "grid_path_pallas_vs_xla": (bulk_ups / grid_ups
+                                            if bulk_ups is not None
+                                            and grid_ups else None),
+                "grid_path_pallas_note": bulk_note,
+                # the headline leg's ACTUAL mode: "pallas" only when
+                # the bulk program provably compiled; a requested-but-
+                # fallen-back run is labeled so the chip session's
+                # bulk A/B can never rebrand XLA numbers
+                "dccrg_bulk_mode": (
+                    ("pallas" if headline_bulk_engaged
+                     else "pallas-requested-not-engaged")
+                    if os.environ.get("DCCRG_BULK", "").lower() == "pallas"
+                    else "xla"),
                 "pallas_metric": (f"pallas-kernel advection 3D {N}^2x{NZ} "
                                   "cell-updates/sec/chip"),
                 "pallas_updates_per_sec": pallas_ups,
@@ -425,6 +507,7 @@ def main() -> None:
     # diagnostics on stderr only
     print(
         f"baseline {baseline:.3g}/s ({NODE_CORES}-core node equivalent); "
+        f"DCCRG_BULK={os.environ.get('DCCRG_BULK') or 'xla (default)'}; "
         f"devices {jax.devices()}",
         file=sys.stderr,
     )

@@ -40,7 +40,22 @@ run() { # name, timeout_s, cmd...
 }
 
 # 1. the headline: 512^3 grid path + both A/Bs + pallas bound + bf16
+#    + the roll-plan bulk-executor leg (bench.py runs DCCRG_BULK=pallas
+#    as its own leg with L2 parity asserted against the XLA roll path)
 run bench_main 3600 python bench.py
+# 1b. bulk-executor A/B as the HEADLINE mode (native Pallas, plus the
+#     temporally-blocked depth-4 point) — the >=10x grid-path target's
+#     direct measurement; compare grid_path_updates_per_sec across the
+#     bench_main / bulk_spp{1,4} outputs
+run bench_bulk_spp1 3600 env BENCH_SKIP_AB=1 BENCH_SKIP_BF16=1 \
+    BENCH_SKIP_BULK=1 DCCRG_BULK=pallas python bench.py
+run bench_bulk_spp4 3600 env BENCH_SKIP_AB=1 BENCH_SKIP_BF16=1 \
+    BENCH_SKIP_BULK=1 DCCRG_BULK=pallas DCCRG_BULK_SPP=4 python bench.py
+# 1c. bf16 end-to-end state through the bulk executor (narrow HBM
+#     residency x temporal blocking — the compounding legs)
+run bench_bulk_bf16 1800 env BENCH_SKIP_AB=1 BENCH_SKIP_BF16=1 \
+    BENCH_SKIP_BULK=1 DCCRG_BULK=pallas BENCH_GRID_DTYPE=bfloat16 \
+    python bench.py
 # 2. pallas bound, narrow storage
 run bench_pallas_bf16 1800 env BENCH_SKIP_AB=1 BENCH_SKIP_BF16=1 \
     BENCH_PALLAS_DTYPE=bfloat16 python bench.py

@@ -126,6 +126,57 @@ register_kernel("diffuse", _diffuse_kernel)
 register_kernel("advect_x", _advect_x_kernel)
 
 
+# Bulk-executor (DCCRG_BULK=pallas) variants: the roll-plan Pallas
+# executor consumes SlotwiseKernel flux functions (one stencil leg at
+# a time), so registry names that should be bulk-capable register a
+# slot-wise twin here. Slot accumulation re-associates the neighbor
+# sum, so a bulk bucket matches its table-gather twin to float
+# re-association (the parity suite uses allclose, not digests).
+FLEET_BULK_KERNELS: dict = {}
+
+
+def register_bulk_kernel(name: str, slotwise) -> None:
+    """Register the SlotwiseKernel twin of a named step kernel; a
+    GridBatch bucket whose job names this kernel can then select the
+    roll-plan Pallas bulk executor under ``DCCRG_BULK=pallas``."""
+    FLEET_BULK_KERNELS[str(name)] = slotwise
+
+
+def _make_diffuse_slotwise():
+    from .grid import SlotwiseKernel
+
+    def init(c, dt):
+        return jnp.zeros(c["rho"].shape, c["rho"].dtype)
+
+    def slot(acc, c, nbr, offs, mask, dt):
+        return acc + jnp.where(mask, nbr["rho"] - c["rho"], 0.0)
+
+    def finish(acc, c, dt):
+        return {"rho": c["rho"] + dt * acc}
+
+    return SlotwiseKernel(init, slot, finish)
+
+
+def _make_advect_x_slotwise():
+    from .grid import SlotwiseKernel
+
+    def init(c, cfl):
+        return jnp.zeros(c["rho"].shape, c["rho"].dtype)
+
+    def slot(acc, c, nbr, offs, mask, cfl):
+        up = (offs[..., 0] < 0) & (offs[..., 1] == 0) & (offs[..., 2] == 0)
+        return acc + jnp.where(up & mask, nbr["rho"], 0.0)
+
+    def finish(acc, c, cfl):
+        return {"rho": (1.0 - cfl) * c["rho"] + cfl * acc}
+
+    return SlotwiseKernel(init, slot, finish)
+
+
+register_bulk_kernel("diffuse", _make_diffuse_slotwise())
+register_bulk_kernel("advect_x", _make_advect_x_slotwise())
+
+
 # ---------------------------------------------------------------------
 # jobs
 # ---------------------------------------------------------------------
@@ -217,9 +268,12 @@ class FleetJob:
         """The compile-sharing key: jobs with equal keys stack into
         one batched program. Parameters, seeds, priorities and step
         counts are NOT part of it (they ride as batched scalars or
-        scheduler state)."""
+        scheduler state). Every field's dtype IS part of it (via the
+        schema triples): a bfloat16 job can never share a compiled
+        program — or a ``[capacity, R]`` state allocation — with a
+        float32 bucket."""
         schema = tuple(sorted(
-            (n, tuple(shape), str(dtype))
+            (n, tuple(shape), str(jnp.dtype(dtype)))
             for n, (shape, dtype) in self.cell_data.items()))
         # a registry name buckets by that name; a callable buckets by
         # its own identity (two jobs share a program only when they
@@ -323,14 +377,24 @@ class GridBatch:
         self.fields_in = proto.fields_in
         self.fields_out = proto.fields_out
         self.kernel = proto.resolved_kernel()
+        # the DCCRG_BULK=pallas twin (SlotwiseKernel) when the job
+        # names a bulk-capable registry kernel; callables have no twin
+        self.bulk_kernel = (None if callable(proto.kernel)
+                            else FLEET_BULK_KERNELS.get(str(proto.kernel)))
         self.n_extra = len(proto.params)
         self.schema = dict(self.grid.fields)
-        # the SDC invariant sets: fields the device fingerprints
-        # (32-bit element types bitcast losslessly) and fields the
-        # kernel provably conserves under this bucket's periodicity
+        # the SDC invariant sets: fields the device fingerprints (32-
+        # bit element types bitcast losslessly; SCALAR 16-bit fields —
+        # bf16 state — widen each element to its own uint32 word,
+        # which matches the host packer's one-padded-word-per-row
+        # layout only when the row IS one element, so vector 16-bit
+        # fields stay out) and fields the kernel provably conserves
+        # under this bucket's periodicity
         self.fp_fields = tuple(
             n for n in sorted(self.schema)
-            if jnp.dtype(self.schema[n][1]).itemsize == 4)
+            if jnp.dtype(self.schema[n][1]).itemsize == 4
+            or (jnp.dtype(self.schema[n][1]).itemsize == 2
+                and self.schema[n][0] == ()))
         self.conserved = integrity.conserved_fields(
             proto.kernel, proto.periodic, proto.fields_out)
         # DMR shadow replicas: shadow slot -> primary slot
@@ -358,10 +422,24 @@ class GridBatch:
         # the pre-SDC one (no fingerprint ops, no extra outputs) —
         # the negative pin of the SDC defense, not a cheaper check
         int_on = integrity.integrity_enabled()
-        key = (self.key, self.capacity, int_on)
+        # DCCRG_BULK=pallas buckets whose kernel has a registered bulk
+        # twin step through the roll-plan Pallas executor (the fleet
+        # quantum is then a batched bulk pass instead of a vmapped
+        # table gather); the mode is part of the program key so bulk
+        # and table programs never alias
+        from .ops import roll_executor
+
+        want_bulk = (roll_executor.bulk_mode() == "pallas"
+                     and self.bulk_kernel is not None)
+        key = (self.key, self.capacity, int_on, want_bulk)
         hit = _FLEET_PROGRAMS.get(key)
         if hit is not None:
             return hit
+        bulk_step = None
+        if want_bulk:
+            bulk_step = roll_executor.make_fleet_bulk_step(
+                self.grid, self.bulk_kernel, self.fields_in,
+                self.fields_out, self.n_extra, self.capacity)
         rows = jnp.asarray(self._rows)
         mask = jnp.asarray(self._mask)
         offs = jnp.asarray(self._offs)
@@ -378,7 +456,8 @@ class GridBatch:
                 new[n] = state[n].at[:L].set(out[n].astype(state[n].dtype))
             return new
 
-        vstep = jax.vmap(step_one, in_axes=(0, 0))
+        vstep = (bulk_step if bulk_step is not None
+                 else jax.vmap(step_one, in_axes=(0, 0)))
 
         def loop(state, extras, budget, q):
             def body(i, st):
@@ -440,7 +519,12 @@ class GridBatch:
         else:
             run_quantum, fp_now = loop, None
 
-        hit = (jax.jit(run_quantum), jax.jit(finite), fp_now)
+        # the bulk flag rides the cache entry: the solo-path shadow
+        # audit must know whether this program's arithmetic is the
+        # table kernel's (bitwise-comparable to Grid.run_steps) or the
+        # bulk twin's (matches only to float re-association)
+        hit = (jax.jit(run_quantum), jax.jit(finite), fp_now,
+               bulk_step is not None)
         if len(_FLEET_PROGRAMS) >= _FLEET_PROGRAMS_MAX:
             _FLEET_PROGRAMS.pop(next(iter(_FLEET_PROGRAMS)))
         _FLEET_PROGRAMS[key] = hit
@@ -559,7 +643,7 @@ class GridBatch:
         q = int(budget.max()) if len(budget) else 0
         if q <= 0:
             return 0
-        fn, _finite, fp_now = self._programs()
+        fn, _finite, fp_now, _bulk = self._programs()
         out = fn(self.state, jnp.asarray(self._extras),
                  jnp.asarray(budget), jnp.int32(q))
         if fp_now is None:  # DCCRG_INTEGRITY=0: the pre-SDC program
@@ -580,12 +664,21 @@ class GridBatch:
         self.dispatches += 1
         return q
 
+    def bulk_active(self) -> bool:
+        """Whether this bucket's quantum program steps through the
+        roll-plan Pallas bulk executor (DCCRG_BULK=pallas with a
+        registered bulk twin that proved eligible). Bulk arithmetic
+        matches the table kernel only to float re-association, so
+        bitwise cross-program comparisons (the solo-path shadow
+        audit) must not span the two."""
+        return self._programs()[3]
+
     def finite_slots(self) -> np.ndarray:
         """Per-slot numerics watchdog: ``[capacity]`` bool, True where
         every watched (inexact) field element of the slot is finite.
         One device round-trip for the whole fleet; a poisoned slot
         cannot hide behind its neighbors."""
-        _fn, finite, _fp = self._programs()
+        _fn, finite, _fp, _bulk = self._programs()
         return np.asarray(finite(self.state))
 
     def fingerprint_slots(self) -> dict:
@@ -596,7 +689,7 @@ class GridBatch:
         difference means the slot's bytes changed outside a sanctioned
         path. Raises RuntimeError with integrity off (there is no
         fingerprint program then, by design)."""
-        _fn, _finite, fp_now = self._programs()
+        _fn, _finite, fp_now, _bulk = self._programs()
         if fp_now is None:
             raise RuntimeError(
                 "fingerprint_slots needs DCCRG_INTEGRITY enabled")

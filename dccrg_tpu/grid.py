@@ -634,15 +634,25 @@ class Grid:
                 .initialize(mesh))
     """
 
-    def __init__(self, cell_data=None):
-        # field spec: name -> (shape tuple, dtype)
+    def __init__(self, cell_data=None, dtype=None):
+        # field spec: name -> (shape tuple, dtype). ``dtype`` is the
+        # grid-wide storage override: every FLOATING field is re-typed
+        # to it (bfloat16 halves the state's HBM residency and
+        # exchange/checkpoint bytes; the weakly-typed flux kernels keep
+        # computing in float32). float32 stays the default; integer/
+        # bool fields keep their declared types either way.
         self.fields = {}
+        self.state_dtype = None if dtype is None else jnp.dtype(dtype)
         for name, spec in (cell_data or {}).items():
             if isinstance(spec, tuple):
-                shape, dtype = spec
+                shape, fdt = spec
             else:
-                shape, dtype = (), spec
-            self.fields[name] = (tuple(shape), jnp.dtype(dtype))
+                shape, fdt = (), spec
+            fdt = jnp.dtype(fdt)
+            if self.state_dtype is not None and jnp.issubdtype(
+                    fdt, jnp.floating):
+                fdt = self.state_dtype
+            self.fields[name] = (tuple(shape), fdt)
         self._length = (1, 1, 1)
         self._max_ref_lvl = 0
         self._periodic = (False, False, False)
@@ -2401,7 +2411,9 @@ class Grid:
         the reference's solve-inner-while-messages-fly
         (dccrg.hpp:5046-5413, tests/advection/2d.cpp:327-343). Costs a
         surface-sized second kernel pass, so default on for
-        accelerators only; override with DCCRG_OVERLAP=0/1."""
+        accelerators only — the CPU backend has no async
+        collective-permute to hide and the measured CPU A/B is 0.89x
+        (PERF.md); override with DCCRG_OVERLAP=0/1."""
         env = os.environ.get("DCCRG_OVERLAP")
         if env in ("0", "1"):
             return env == "1"
@@ -2705,6 +2717,21 @@ class Grid:
                 "exchange_fields must be a subset of fields_out; static "
                 "fields' ghosts are refreshed once per structure epoch"
             )
+        # DCCRG_BULK=pallas: the roll-plan-driven Pallas bulk executor
+        # (ops/roll_executor.py) replaces the XLA roll path where the
+        # plan is eligible (single-device closed-form, scalar fields,
+        # SlotwiseKernel); anything else falls through. With the env
+        # unset (or =xla) this branch is never entered and the
+        # pre-executor program compiles bit-identically — the negative
+        # pin, same discipline as DCCRG_INTEGRITY=0.
+        if os.environ.get("DCCRG_BULK", "").strip().lower() == "pallas":
+            from .ops import roll_executor
+
+            built = roll_executor.compile_bulk_step_loop(
+                self, kernel, fields_in, fields_out, exchange_fields,
+                neighborhood_id, n_extra)
+            if built is not None:
+                return built
         hood = self.plan.hoods[neighborhood_id]
         L, R = self.plan.L, self.plan.R
         sh = self._sharding()

@@ -231,17 +231,22 @@ def fingerprint_rows(arr) -> tuple:
 def device_fingerprint(x, n_own: int):
     """jnp body computing the ``(s1, s2)`` pair of one field's owned
     rows ``x[:n_own]`` inside a jitted program — the fused in-program
-    invariant. Only 32-bit element types bitcast losslessly on every
-    backend; the fleet layer restricts its device fingerprints to
-    those (the host helpers handle any dtype)."""
+    invariant. 32-bit element types bitcast losslessly on every
+    backend; 16-bit types (bfloat16 state) bitcast to uint16 and widen
+    each element to its OWN uint32 word — which equals the host
+    packer's padded-row words only for one-element rows, so the fleet
+    restricts 16-bit device fingerprints to scalar-shaped fields (the
+    host helpers handle any dtype)."""
     import jax
     import jax.numpy as jnp
 
     v = x[:n_own]
-    if v.dtype.itemsize != 4:
+    if v.dtype.itemsize == 2:
+        v = jax.lax.bitcast_convert_type(v, jnp.uint16).astype(jnp.uint32)
+    elif v.dtype.itemsize != 4:
         raise TypeError(
-            f"device fingerprints need a 32-bit element type, got "
-            f"{v.dtype}")
+            f"device fingerprints need a 16- or 32-bit element type, "
+            f"got {v.dtype}")
     w = jax.lax.bitcast_convert_type(v, jnp.uint32)
     s1 = jnp.sum(w, dtype=jnp.uint32)
     lo = (w & jnp.uint32(0xFFFF)) + jnp.uint32(1)

@@ -149,8 +149,13 @@ class GridAdvection:
         dx = 1.0 / n
         self.dx = dx
         self.grid = (
-            Grid(cell_data={"density": self.dtype, "vx": self.dtype,
-                            "vy": self.dtype})
+            # grid-wide storage dtype: bfloat16 here halves the
+            # state's HBM residency and exchange/checkpoint bytes
+            # end-to-end; the flux kernel computes in float32 either
+            # way (weakly-typed arithmetic, pinned in
+            # tests/test_pallas_kernel.py)
+            Grid(cell_data={"density": jnp.float32, "vx": jnp.float32,
+                            "vy": jnp.float32}, dtype=self.dtype)
             .set_initial_length((n, n, nz))
             .set_periodic(True, True, False)
             .set_maximum_refinement_level(0)

@@ -1129,7 +1129,7 @@ def assert_finite(grid, fields=None, step=None) -> None:
 # OOM-aware step dispatch: the gather-mode fallback chain
 # ---------------------------------------------------------------------
 
-_GATHER_ENV = ("DCCRG_ROLL_STENCIL", "DCCRG_FORCE_TABLES")
+_GATHER_ENV = ("DCCRG_ROLL_STENCIL", "DCCRG_FORCE_TABLES", "DCCRG_BULK")
 FALLBACK_CHAIN = ("current", "roll", "tables")
 
 
@@ -1140,10 +1140,16 @@ def _is_resource_exhausted(e: BaseException) -> bool:
 
 # the env each forced gather mode pins (None = unset). DCCRG_FORCE_TABLES
 # is read at PLAN BUILD time (uniform.py), DCCRG_ROLL_STENCIL at program
-# build — forcing a mode therefore needs a plan rebuild.
+# build — forcing a mode therefore needs a plan rebuild. Both fallback
+# modes also drop out of the DCCRG_BULK=pallas executor: an OOM under
+# the bulk program (its VMEM windows + epilogue tables cost more than
+# the bare roll path) degrades to plain XLA gathers before dense
+# tables are tried.
 _MODE_ENV = {
-    "roll": {"DCCRG_FORCE_TABLES": None, "DCCRG_ROLL_STENCIL": "1"},
-    "tables": {"DCCRG_FORCE_TABLES": "1", "DCCRG_ROLL_STENCIL": "0"},
+    "roll": {"DCCRG_FORCE_TABLES": None, "DCCRG_ROLL_STENCIL": "1",
+             "DCCRG_BULK": None},
+    "tables": {"DCCRG_FORCE_TABLES": "1", "DCCRG_ROLL_STENCIL": "0",
+               "DCCRG_BULK": None},
 }
 
 

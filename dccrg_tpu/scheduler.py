@@ -786,8 +786,11 @@ class FleetScheduler:
         telemetry.inc("dccrg_audits_total")
         try:
             with telemetry.span("integrity.audit"):
-                live, shadow = self._audit_digests(batch, slot, pre,
-                                                   steps, job)
+                digests = self._audit_digests(batch, slot, pre,
+                                              steps, job)
+                if digests is None:  # no comparable re-execution path
+                    return
+                live, shadow = digests
         except Exception as e:  # noqa: BLE001 - filtered just below
             if not resilience._is_resource_exhausted(e):
                 raise
@@ -828,17 +831,41 @@ class FleetScheduler:
             batch.step(bud)
             shadow = batch.digest(spare)
             batch._extras[spare] = saved_extras
+        elif batch.bulk_active():
+            # the bucket stepped through the Pallas bulk executor,
+            # whose slot-wise arithmetic matches the table kernel only
+            # to float re-association — a solo table-path re-execution
+            # would ALWAYS diverge bitwise and convict healthy jobs.
+            # With no spare slot there is no same-program re-execution
+            # to compare against: skip this window (no verdict).
+            logger.info(
+                "shadow audit of job %s skipped: bucket runs the bulk "
+                "executor and no spare slot is free for a same-program "
+                "re-execution", job.name)
+            telemetry.inc("dccrg_audits_skipped_total")
+            return None
         else:
             # solo re-execution: the unbatched path recomputes the
             # same quantum (bitwise identical by the fleet parity
-            # contract), diversifying the program the audit trusts
+            # contract), diversifying the program the audit trusts.
+            # DCCRG_BULK is pinned OFF for the re-execution: the
+            # bucket ran the TABLE program (bulk_active() was False
+            # above), and a callable SlotwiseKernel job would
+            # otherwise let Grid.run_steps compile the bulk executor
+            # here — the exact cross-program bitwise mismatch the
+            # bulk_active() guard exists to prevent, mirrored.
             sh = batch.grid._sharding()
             for n, arr in pre.items():
                 batch.grid.data[n] = jax.device_put(arr[None], sh)
-            batch.grid.run_steps(
-                batch.kernel, batch.fields_in, batch.fields_out,
-                steps, extra_args=tuple(
-                    jnp.float32(p) for p in job.params))
+            saved_bulk = os.environ.pop("DCCRG_BULK", None)
+            try:
+                batch.grid.run_steps(
+                    batch.kernel, batch.fields_in, batch.fields_out,
+                    steps, extra_args=tuple(
+                        jnp.float32(p) for p in job.params))
+            finally:
+                if saved_bulk is not None:
+                    os.environ["DCCRG_BULK"] = saved_bulk
             from . import checkpoint as checkpoint_mod
 
             shadow = checkpoint_mod.state_digest(batch.grid)

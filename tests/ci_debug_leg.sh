@@ -33,6 +33,13 @@
 # trace-coverage acceptance (a traced fleet run's depth-0 spans
 # account for >= 95% of the serving wall-clock).
 #
+# Also runs a bulk-executor smoke leg: the roll-plan Pallas executor
+# (DCCRG_BULK=pallas, interpret mode) against the XLA roll path —
+# fixup-row parity on periodic and non-periodic grids plus one fleet
+# bucket stepping through the registered bulk kernel — and the
+# negative pin that DCCRG_BULK unset compiles the pre-executor
+# program.
+#
 # Usage: tests/ci_debug_leg.sh [extra pytest args]
 set -e
 cd "$(dirname "$0")/.."
@@ -52,6 +59,11 @@ env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_telemetry.py::test_exporter_faults_never_trip_a_run" \
     "tests/test_telemetry.py::test_slo_admission_reorders_vs_priority_baseline" \
     "tests/test_telemetry.py::test_fleet_trace_covers_step_wall_clock" \
+    -p no:cacheprovider "$@"
+env JAX_PLATFORMS=cpu python -m pytest -q \
+    "tests/test_bulk_executor.py::test_bulk_matches_xla_roll_path" \
+    "tests/test_bulk_executor.py::test_bulk_negative_pin" \
+    "tests/test_bulk_executor.py::test_fleet_bulk_bucket_matches_table_path" \
     -p no:cacheprovider "$@"
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_recommit.py::test_native_numpy_plans_bitwise_identical" \

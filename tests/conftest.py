@@ -54,7 +54,9 @@ def _tpu_mode_scope(request):
     """DCCRG_TEST_TPU=1 exists to run the Pallas kernel tests on the
     real (single) chip; everything else is written for the 8-device
     virtual CPU mesh and skips rather than failing on mesh setup."""
-    if _USE_TPU and not any(k in request.node.nodeid for k in ("test_pallas_kernel", "test_poisson_kernel")):
+    if _USE_TPU and not any(k in request.node.nodeid for k in (
+            "test_pallas_kernel", "test_poisson_kernel",
+            "test_bulk_executor")):
         pytest.skip("CPU-mesh test; run without DCCRG_TEST_TPU")
     yield
 
