@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Bench-history trend: merge the checked-in ``BENCH_r*.json`` rounds
+into one metric-keyed trajectory table and flag regressions.
+
+Each round file carries ``{"n": round, "parsed": {metric: value}}``
+(the bench.py JSON summary). This tool lines the rounds up per metric
+and flags the NEWEST round's value when it regresses more than
+``--threshold`` (default 10%) against the best prior round — the
+history was previously only eyeballable file-by-file.
+
+Metric direction is inferred from the name: throughput-style keys
+(``*updates_per_sec``, ``*runs_per_s``, ``value``, ``*vs_baseline``)
+are higher-is-better; error/latency-style keys (``*l2_error*``,
+``*_seconds``, ``*_s``) are lower-is-better; anything else (strings,
+nulls, notes) is skipped.
+
+Usage: python bench/trend.py [BENCH_r*.json ...] [--threshold F]
+       [--json] [--strict]
+(default inputs: every BENCH_r*.json in the repo root; ``--strict``
+exits 1 when any regression is flagged — the CI hook. Pure host-side
+JSON, no jax; no timeout needed.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+_HIGHER_PAT = re.compile(
+    r"(updates_per_sec|runs_per_s|per_sec)$|^value$|vs_baseline$")
+_LOWER_PAT = re.compile(r"l2_error|_seconds$|_ms$|(^|_)wall(_s)?$")
+
+
+def metric_direction(name: str):
+    """+1 = higher is better, -1 = lower is better, None = not a
+    trended metric (notes, modes, sizes)."""
+    if _HIGHER_PAT.search(name):
+        return 1
+    if _LOWER_PAT.search(name):
+        return -1
+    return None
+
+
+def load_rounds(paths) -> list:
+    """``[(round, {metric: value})]`` sorted by round number; files
+    without a parsed payload (failed rounds) contribute an empty
+    metric dict so the round still shows in the table."""
+    rounds = []
+    for p in paths:
+        m = _ROUND_RE.search(os.path.basename(p))
+        try:
+            with open(p) as f:
+                d = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"# skipping {p}: {e}", file=sys.stderr)
+            continue
+        n = int(d.get("n", m.group(1) if m else len(rounds) + 1))
+        parsed = d.get("parsed")
+        metrics = {}
+        if isinstance(parsed, dict):
+            for k, v in parsed.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    metrics[k] = float(v)
+        rounds.append((n, metrics))
+    rounds.sort(key=lambda r: r[0])
+    return rounds
+
+
+def trajectory(rounds) -> dict:
+    """``{metric: [(round, value)]}`` over every trended metric seen
+    in any round (missing rounds simply absent)."""
+    out: dict = {}
+    for n, metrics in rounds:
+        for k, v in metrics.items():
+            if metric_direction(k) is None:
+                continue
+            out.setdefault(k, []).append((n, v))
+    return out
+
+
+def regressions(traj, threshold: float, newest_round=None) -> list:
+    """``[{metric, round, value, best_prior, best_round, change}]``
+    for every metric whose NEWEST value regresses more than
+    ``threshold`` (fraction) against the best prior round. Metrics
+    with fewer than two rounds have no prior to regress against,
+    and a metric absent from ``newest_round`` (a renamed/removed
+    bench leg) is historical — it must not flag a stale regression
+    on every future run."""
+    out = []
+    for metric, points in sorted(traj.items()):
+        if len(points) < 2:
+            continue
+        direction = metric_direction(metric)
+        last_round, last = points[-1]
+        if newest_round is not None and last_round != newest_round:
+            continue
+        prior = points[:-1]
+        if direction > 0:
+            best_round, best = max(prior, key=lambda p: p[1])
+            if best <= 0:
+                continue  # nothing was ever achieved to regress from
+            change = (last - best) / abs(best)
+            bad = change < -threshold
+        else:
+            best_round, best = min(prior, key=lambda p: p[1])
+            if best <= 0:
+                # a perfect (0.0) error baseline: ANY positive value
+                # is an infinite regression — the one case a ratio
+                # threshold cannot express, and exactly the class a
+                # bitwise-parity metric regresses through
+                change, bad = None, last > 0
+            else:
+                change = (last - best) / abs(best)
+                bad = change > threshold
+        if bad:
+            out.append({"metric": metric, "round": last_round,
+                        "value": last, "best_prior": best,
+                        "best_round": best_round,
+                        "change": (None if change is None
+                                   else round(change, 4))})
+    return out
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    a = abs(v)
+    return f"{v:.4g}" if (a >= 1e-3 and a < 1e7) or a == 0 else f"{v:.3e}"
+
+
+def render_table(rounds, traj) -> str:
+    ns = [n for n, _m in rounds]
+    head = ["metric"] + [f"r{n:02d}" for n in ns] + ["dir"]
+    lines = [" | ".join(head)]
+    for metric, points in sorted(traj.items()):
+        by_round = dict(points)
+        row = [metric] + [_fmt(by_round.get(n)) for n in ns]
+        row.append("^" if metric_direction(metric) > 0 else "v")
+        lines.append(" | ".join(row))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*",
+                    help="round files (default: repo-root "
+                         "BENCH_r*.json)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="regression flag fraction vs the best prior "
+                         "round (default 0.10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable form instead of "
+                         "the table")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any regression is flagged")
+    args = ap.parse_args(argv)
+    files = args.files or sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_r*.json")))
+    if not files:
+        print("no BENCH_r*.json rounds found", file=sys.stderr)
+        return 2
+    rounds = load_rounds(files)
+    traj = trajectory(rounds)
+    newest = max((n for n, _m in rounds), default=None)
+    regs = regressions(traj, args.threshold, newest_round=newest)
+    if args.json:
+        print(json.dumps({
+            "rounds": [n for n, _m in rounds],
+            "trajectory": {k: [[n, v] for n, v in pts]
+                           for k, pts in sorted(traj.items())},
+            "regressions": regs,
+            "threshold": args.threshold}, indent=1, sort_keys=True))
+    else:
+        print(render_table(rounds, traj))
+        print()
+        if regs:
+            for r in regs:
+                delta = ("worse than a zero baseline"
+                         if r["change"] is None
+                         else f"{r['change']:+.1%}")
+                print(f"REGRESSION {r['metric']}: r{r['round']:02d} "
+                      f"{_fmt(r['value'])} is {delta} vs "
+                      f"best prior r{r['best_round']:02d} "
+                      f"{_fmt(r['best_prior'])}")
+        else:
+            print(f"no >{args.threshold:.0%} regressions vs the best "
+                  "prior round")
+    return 1 if (regs and args.strict) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,7 +41,14 @@ reference dccrg library (header-only C++/MPI/Zoltan; see SURVEY.md):
   strictly best-effort exporters; ``DCCRG_TRACE=1``, ``python -m
   dccrg_tpu.telemetry``) feeding latency-SLO fleet admission
   (``scheduler.SLOPolicy``: per-job ``slo_ms`` deadlines, EWMA
-  quantum-latency projection, over-latency bucket shedding).
+  quantum-latency projection, over-latency bucket shedding),
+- a production autopilot (``autopilot``: an opt-in deterministic
+  controller, ``DCCRG_AUTOPILOT=1``, tuning fleet quantum length,
+  per-stem checkpoint cadence, audit cadence and initial bucket
+  capacity within hard bounds from the telemetry the system already
+  records — with every decision journaled as a structured record
+  that ``python -m dccrg_tpu.autopilot explain|replay`` reconstructs
+  and re-derives from the journal alone).
 
 Reference: /root/reference (dccrg.hpp and friends). This package is a
 re-design for TPU, not a translation: structure (cell lists, neighbor
@@ -77,6 +84,8 @@ from .scheduler import FleetPreemptedError, FleetScheduler, SLOPolicy
 from .integrity import IntegrityError, register_conserved
 from . import telemetry
 from .telemetry import LogHistogram
+from . import autopilot
+from .autopilot import Autopilot
 
 __version__ = "0.1.0"
 
@@ -133,4 +142,6 @@ __all__ = [
     "SLOPolicy",
     "LogHistogram",
     "telemetry",
+    "autopilot",
+    "Autopilot",
 ]

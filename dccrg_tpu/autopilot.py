@@ -1,0 +1,840 @@
+"""Production autopilot: telemetry-driven self-tuning with an
+explainable decision journal.
+
+PR 9 gave the system eyes (per-bucket quantum-latency EWMAs,
+trip/rollback/audit counters, span traces) and the serving layers
+carry a dozen hand-set knobs (``DCCRG_FLEET_QUANTUM``,
+``bucket_capacity``, per-job ``checkpoint_every``,
+``DCCRG_AUDIT_EVERY``, ...). This module closes the loop: a
+**deterministic controller** wired into
+:class:`~dccrg_tpu.scheduler.FleetScheduler` that tunes, within hard
+bounds, from nothing but recorded observations:
+
+- **quantum length** against measured SLO slack — long quanta
+  amortize dispatch overhead, short quanta bound preemption/rollback
+  loss and tighten the watchdog/checkpoint poll cadence;
+- **per-stem checkpoint cadence** from measured save cost x observed
+  trip rate (Young's first-order optimum,
+  ``sqrt(2 * save_cost / trip_rate)`` in step units);
+- **audit cadence** up while a device lane's suspect counter is warm
+  and back down to the configured baseline after a clean streak;
+- **initial bucket capacity** seeded from the recorded OOM/shed
+  history instead of rediscovering it by halving every run (the
+  journal doubles as the cross-run memory).
+
+The observability half is the headline, not an afterthought: adaptive
+policies are only operable when every automatic decision is
+reconstructable from recorded observations (Dean & Barroso, "The Tail
+at Scale", CACM 2013; Hochschild et al., HotOS'21). Every decision is
+therefore emitted as a **structured record** — observed inputs
+(metric names + values), rule fired, action taken, expected effect —
+into a bounded in-memory ring and an append-only JSONL journal
+(``DCCRG_DECISION_FILE``, rank-tagged and merge-able across ranks
+exactly like the telemetry traces). ``python -m dccrg_tpu.autopilot
+explain`` renders every decision human-readably from the journal
+alone, and ``replay`` re-derives each action by feeding the RECORDED
+inputs back through the same pure rule functions the live controller
+used — any divergence is a bug (journal corruption, nondeterminism,
+or a rule edit that silently changed behavior). A periodic
+human-readable status snapshot (``DCCRG_STATUS_FILE``) shows the
+per-bucket latency EWMAs, live knob values, suspect counters and SLO
+slack an operator needs at a glance.
+
+Deterministic by construction, the :class:`~dccrg_tpu.scheduler
+.SLOPolicy` discipline: the clock is injectable, every rule is a pure
+function of ``(current value, recorded inputs)`` — thresholds and
+hard bounds travel INSIDE the recorded inputs so replay needs nothing
+but the journal — and the controller's own state (streak counters,
+windowed rates) feeds the rules only through those recorded inputs.
+
+OFF BY DEFAULT: without ``DCCRG_AUTOPILOT=1`` the scheduler never
+constructs a controller and fleet scheduling, checkpoint cadence and
+audit cadence are bitwise identical to the pre-autopilot behavior
+(pinned by tests/test_autopilot.py). With it on, the controller is
+pure host-side float arithmetic per scheduler tick — no device work,
+no extra dispatches (PERF.md quantifies: in the noise).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import math
+import os
+import time
+
+from . import telemetry
+
+logger = __import__("logging").getLogger("dccrg_tpu.autopilot")
+
+
+# ---------------------------------------------------------------------
+# env knobs
+# ---------------------------------------------------------------------
+
+def autopilot_enabled(default: bool = False) -> bool:
+    """The ``DCCRG_AUTOPILOT`` env knob: ``1`` lets the fleet
+    scheduler construct and run the self-tuning controller. Unset
+    (default): no controller object exists and every knob keeps its
+    configured value — the negative pin."""
+    v = os.environ.get("DCCRG_AUTOPILOT", "")
+    if v == "":
+        return default
+    return v not in ("0", "off", "false", "no")
+
+
+def decision_file_default():
+    """The ``DCCRG_DECISION_FILE`` env knob: JSONL journal every
+    decision record is appended to (best-effort, like every telemetry
+    exporter). A literal ``{rank}`` is substituted with the coord rank
+    id; per-rank files merge like traces (records carry the rank)."""
+    return os.environ.get("DCCRG_DECISION_FILE") or None
+
+
+def status_file_default():
+    """The ``DCCRG_STATUS_FILE`` env knob: where the periodic
+    human-readable status snapshot is (re)written."""
+    return os.environ.get("DCCRG_STATUS_FILE") or None
+
+
+def decision_ring_default(default: int = 4096) -> int:
+    """The ``DCCRG_DECISION_RING`` env knob: how many decision records
+    the in-memory ring holds (the journal file is unbounded)."""
+    try:
+        return max(16, int(os.environ.get("DCCRG_DECISION_RING", "")
+                           or default))
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------
+# the rules: pure functions of (current value, recorded inputs)
+# ---------------------------------------------------------------------
+#
+# Every rule takes the knob's current value and the inputs dict that
+# was (or will be) recorded in the decision journal, and returns the
+# new value — or None when the rule does not fire on those inputs.
+# Thresholds, streaks and hard bounds are all INSIDE the inputs, so
+# `replay` can re-derive the action from the journal alone. Rules
+# must be deterministic and JSON-faithful (inputs survive a
+# json round-trip unchanged).
+
+def _rule_quantum_shorten(before, inp):
+    """Negative SLO slack or a warm trip rate: halve the quantum —
+    shorter quanta bound preemption/rollback loss and tighten the
+    watchdog/checkpoint poll cadence."""
+    slack = inp.get("slo_slack_min_s")
+    violating = slack is not None and slack < 0.0
+    tripping = inp.get("trip_rate", 0.0) > inp.get("trip_warm", 0.02)
+    if not (violating or tripping):
+        return None
+    if inp.get("streak", 1) < inp.get("patience", 1):
+        return None
+    new = max(int(inp.get("lo", 1)), int(before) // 2)
+    return new if new != int(before) else None
+
+
+def _rule_quantum_lengthen(before, inp):
+    """Comfortable slack (or no SLO jobs at all) and a cool trip
+    rate, sustained: double the quantum — long quanta amortize
+    per-dispatch overhead across more steps."""
+    lat = inp.get("quantum_latency_s")
+    if lat is None:
+        return None  # never lengthen blind: no measured dispatch yet
+    if inp.get("trip_rate", 0.0) > inp.get("trip_cool", 0.005):
+        return None
+    slack = inp.get("slo_slack_min_s")
+    if slack is not None and slack < inp.get("slack_factor", 8.0) * lat:
+        return None
+    if inp.get("streak", 1) < inp.get("patience", 1):
+        return None
+    new = min(int(inp.get("hi", 64)), int(before) * 2)
+    return new if new != int(before) else None
+
+
+def _rule_ckpt_retune(before, inp):
+    """Young's first-order optimal checkpoint interval from measured
+    save cost x observed trip rate, in step units:
+    ``sqrt(2 * (save_cost_s / step_seconds) / trip_rate)``. A
+    trip-free history pushes the cadence to the upper bound (saves
+    cost, trips don't); a deadband suppresses churn."""
+    sc = inp.get("save_cost_s")
+    st = inp.get("step_seconds")
+    if sc is None or st is None or sc <= 0.0 or st <= 0.0:
+        return None
+    rate = inp.get("trip_rate", 0.0)
+    if rate <= 0.0:
+        opt = float(inp.get("hi", 256))
+    else:
+        opt = math.sqrt(2.0 * (sc / st) / rate)
+    new = max(int(inp.get("lo", 1)),
+              min(int(inp.get("hi", 256)), int(round(opt))))
+    before = int(before)
+    if abs(new - before) < max(1, int(before
+                                      * inp.get("deadband", 0.25))):
+        return None
+    return new
+
+
+def _rule_audit_tighten(before, inp):
+    """Fresh suspect verdicts on a device lane: audit more often —
+    halve the cadence (or switch audits ON at ``warm_start`` when the
+    baseline keeps them off)."""
+    if inp.get("new_suspects", 0) <= 0:
+        return None
+    before = int(before)
+    new = (int(inp.get("warm_start", 8)) if before <= 0
+           else max(1, before // 2))
+    new = min(new, int(inp.get("hi", 16))) if new > 0 else new
+    return new if new != before else None
+
+
+def _rule_audit_relax(before, inp):
+    """A sustained clean streak: walk the audit cadence back toward
+    the configured baseline (doubling; a zero baseline switches
+    audits back off once the cadence passes the envelope top)."""
+    if inp.get("clean_streak", 0) < inp.get("relax_after", 8):
+        return None
+    base = int(inp.get("baseline", 0))
+    before = int(before)
+    if before == base or before <= 0:
+        return None
+    new = before * 2
+    if base > 0:
+        new = min(new, base)
+    if new > int(inp.get("hi", 16)):
+        new = 0 if base <= 0 else int(inp.get("hi", 16))
+    return new if new != before else None
+
+
+def _rule_capacity_learn(before, inp):
+    """An OOM/shed rebuild survived at ``observed_capacity`` slots:
+    remember the smallest capacity that has ever had to be halved to
+    for this bucket key."""
+    obs = int(inp["observed_capacity"])
+    if before is None:
+        return obs
+    new = min(int(before), obs)
+    return new if new != int(before) else None
+
+
+def _rule_capacity_seed(before, inp):
+    """A new bucket for a key with recorded OOM/shed history: start
+    at the learned surviving capacity instead of rediscovering it by
+    halving."""
+    learned = inp.get("learned_capacity")
+    if learned is None:
+        return None
+    new = max(int(inp.get("lo", 1)), min(int(before), int(learned)))
+    return new if new != int(before) else None
+
+
+def _rule_capacity_probe(before, inp):
+    """A run that completed with NO OOM/shed on a seeded bucket key:
+    double the learned capacity back toward the configured default —
+    the learned floor is a recoverable observation, not a permanent
+    ratchet (one transient co-tenant spike must not pin a key's
+    throughput down forever)."""
+    if not inp.get("clean_run"):
+        return None
+    new = int(before) * 2
+    cap = inp.get("default_capacity")
+    if cap is not None:
+        new = min(new, int(cap))
+    return new if new != int(before) else None
+
+
+#: rule name -> pure derivation. `replay` and the live controller
+#: share these by construction — one source of truth.
+RULES = {
+    "quantum.shorten": _rule_quantum_shorten,
+    "quantum.lengthen": _rule_quantum_lengthen,
+    "checkpoint.retune": _rule_ckpt_retune,
+    "audit.tighten": _rule_audit_tighten,
+    "audit.relax": _rule_audit_relax,
+    "capacity.learn": _rule_capacity_learn,
+    "capacity.seed": _rule_capacity_seed,
+    "capacity.probe": _rule_capacity_probe,
+}
+
+#: the "expected effect" text journaled with each rule's decisions
+EXPECTED = {
+    "quantum.shorten": ("shorter quanta bound preemption/rollback "
+                        "loss and tighten the poll cadence"),
+    "quantum.lengthen": ("longer quanta amortize per-dispatch "
+                         "overhead across more steps"),
+    "checkpoint.retune": ("save cost x trip rate optimum (Young): "
+                          "minimize save overhead + expected replay"),
+    "audit.tighten": ("audit a warm-suspect fleet more often so a "
+                      "defective lane convicts sooner"),
+    "audit.relax": ("a clean streak earns the baseline audit cost "
+                    "back"),
+    "capacity.learn": ("remember the bucket capacity that survived "
+                       "the OOM/shed so future runs start there"),
+    "capacity.seed": ("start at the capacity that survived the "
+                      "recorded OOM/shed history instead of "
+                      "rediscovering it by halving"),
+    "capacity.probe": ("a clean run earns the seeded key headroom "
+                       "back toward the configured default — the "
+                       "learned floor decays instead of ratcheting"),
+}
+
+
+def key_id(bucket_key) -> str:
+    """A short stable id for a fleet bucket key (callable kernels are
+    normalized to their qualname so the id survives process
+    restarts — the journal is cross-run memory)."""
+    def norm(x):
+        if isinstance(x, tuple):
+            return tuple(norm(e) for e in x)
+        if callable(x):
+            return getattr(x, "__qualname__", repr(x))
+        return x
+    return hashlib.sha1(repr(norm(bucket_key)).encode()).hexdigest()[:12]
+
+
+# ---------------------------------------------------------------------
+# the controller
+# ---------------------------------------------------------------------
+
+class Autopilot:
+    """The deterministic self-tuning controller (see module
+    docstring). One instance per :class:`~dccrg_tpu.scheduler
+    .FleetScheduler`; the scheduler calls :meth:`tick` at every tick
+    boundary, :meth:`seed_capacity` when creating a bucket and
+    :meth:`record_oom` / :meth:`record_shed` after shrink rebuilds.
+
+    ``clock`` is injectable (the pinned tests drive a fake clock);
+    everything else the controller consumes comes from the telemetry
+    registry and the scheduler's own counters, and every value a
+    decision depended on is recorded IN the decision.
+
+    ``quantum``/``audit_every`` declare the BASELINES the hard
+    envelopes and the audit relax target derive from — pass the
+    scheduler's configured values (the ``DCCRG_AUTOPILOT`` env path
+    does). The scheduler's LIVE knob values stay the source of
+    truth: each tick adopts them and only a journaled rule firing
+    ever writes them back."""
+
+    def __init__(self, *, quantum=8, audit_every=0,
+                 clock=time.monotonic, decision_file=None,
+                 status_file=None, ring=None, ckpt_bounds=(1, 256),
+                 trip_warm=0.02, trip_cool=0.005, slack_factor=8.0,
+                 shorten_patience=1, lengthen_patience=4,
+                 relax_after=8, adjust_every=4, status_every=1,
+                 load_history=True):
+        self.clock = clock
+        self.quantum = max(1, int(quantum))
+        self.quantum0 = self.quantum
+        self.audit_every = max(0, int(audit_every))
+        self.audit0 = self.audit_every
+        #: the hard envelopes no decision may leave (the property
+        #: test's oracle; each rule also receives its lo/hi INSIDE
+        #: the recorded inputs so replay is self-contained)
+        self.bounds = {
+            "quantum": (1, max(8 * self.quantum0, self.quantum0)),
+            "checkpoint_every": (max(1, int(ckpt_bounds[0])),
+                                 max(1, int(ckpt_bounds[1]))),
+            "audit_every": (0, max(16, self.audit0)),
+        }
+        self.trip_warm = float(trip_warm)
+        self.trip_cool = float(trip_cool)
+        self.slack_factor = float(slack_factor)
+        self.shorten_patience = max(1, int(shorten_patience))
+        self.lengthen_patience = max(1, int(lengthen_patience))
+        self.relax_after = max(1, int(relax_after))
+        self.adjust_every = max(1, int(adjust_every))
+        self.status_every = max(1, int(status_every))
+        self._decision_file = (decision_file_default()
+                               if decision_file is None
+                               else str(decision_file))
+        self._status_file = (status_file_default() if status_file is None
+                             else str(status_file))
+        self.decisions = collections.deque(
+            maxlen=decision_ring_default() if ring is None
+            else max(16, int(ring)))
+        self.seq = 0
+        self._tick = 0
+        # learned safe bucket capacities: key_id -> slots. NOT a
+        # permanent ratchet: end_of_run() probes seeded keys that
+        # survived a clean run back up toward the default
+        self.capacity: dict = {}
+        self._seeded: set = set()   # keys the learned floor bound
+        self._shrunk: set = set()   # keys that OOMed/shed this run
+        self._default_seen: dict = {}  # key_id -> configured default
+        # windowed observation state feeding the rules. The registry
+        # is process-global: baseline the counters/histograms we
+        # difference at CONSTRUCTION time, so a controller attached
+        # to a fresh scheduler never inherits an earlier run's trips
+        # or save costs as a phantom first-tick observation.
+        self._last_steps = 0  # sched.steps_total is per-scheduler
+        self._last_trips = float(telemetry.registry().counter_total(
+            "dccrg_fleet_trips_total"))
+        self._save_cost_base = self._save_cost_totals()
+        self._last_suspects = 0
+        self._trip_rate = 0.0
+        self._clean = 0
+        self._q_short = 0
+        self._q_long = 0
+        if load_history and self._decision_file is not None:
+            self.load_history(self._resolved(self._decision_file))
+
+    # -- journal ------------------------------------------------------
+
+    @staticmethod
+    def _resolved(path: str) -> str:
+        return path.replace("{rank}", str(telemetry._rank()))
+
+    def load_history(self, path: str) -> int:
+        """Recover the persistent half of the controller state — the
+        per-bucket-key learned capacities — from a prior run's
+        journal, replaying the ``capacity.learn``/``capacity.probe``
+        records in order (shrinks AND clean-run recoveries both
+        apply — the history is not a one-way ratchet). Returns how
+        many records informed it. Missing/unreadable files are
+        simply no history."""
+        n = 0
+        for rec in read_journal(path):
+            if rec.get("rule") not in ("capacity.learn",
+                                       "capacity.probe"):
+                continue
+            knob = rec.get("knob", "")
+            if not (knob.startswith("capacity[") and knob.endswith("]")):
+                continue
+            kid = knob[len("capacity["):-1]
+            after = rec.get("after")
+            if not isinstance(after, int) or after < 1:
+                continue
+            self.capacity[kid] = after
+            n += 1
+        if n:
+            logger.info(
+                "autopilot recovered %d capacity record(s) from %s",
+                n, path)
+        return n
+
+    def _apply(self, rule: str, knob: str, before, inputs: dict):
+        """Run ``rule`` on ``(before, inputs)``; when it fires, record
+        the decision (ring + journal + metrics) and return the new
+        value, else return ``before`` unchanged."""
+        after = RULES[rule](before, inputs)
+        if after is None:
+            return before
+        rec = {
+            "seq": self.seq,
+            "tick": self._tick,
+            "ts": time.time(),
+            "t": round(float(self.clock()), 6),
+            "rank": telemetry._rank(),
+            "rule": rule,
+            "knob": knob,
+            "before": before,
+            "after": after,
+            "inputs": inputs,
+            "expected": EXPECTED.get(rule, ""),
+        }
+        self.seq += 1
+        self.decisions.append(rec)
+        telemetry.inc("dccrg_autopilot_decisions_total", rule=rule)
+        path = self._decision_file
+        if path is not None:
+            telemetry._best_effort_write(
+                self._resolved(path),
+                json.dumps(rec, sort_keys=True) + "\n", append=True)
+        logger.info("autopilot %s: %s %s -> %s (%s)", rule, knob,
+                    before, after, rec["expected"])
+        return after
+
+    # -- observation gathering ----------------------------------------
+
+    @staticmethod
+    def _save_cost_totals():
+        """``(sum_seconds, count)`` over the periodic save-cost
+        histogram series (``dccrg_ckpt_save_seconds`` kinds keyframe/
+        delta; the ``emergency`` kind is a deadline-bounded preempt
+        save and must not price the periodic cadence)."""
+        tot, n = 0.0, 0
+        for (nm, lab), h in telemetry.registry().histograms.items():
+            if nm != "dccrg_ckpt_save_seconds" \
+                    or ("kind", "emergency") in lab:
+                continue
+            tot += h.sum_seconds
+            n += h.total
+        return tot, n
+
+    def _save_cost_mean(self):
+        """Mean periodic save cost observed SINCE this controller was
+        constructed (the registry outlives schedulers), or None when
+        nothing was recorded yet."""
+        tot, n = self._save_cost_totals()
+        tot -= self._save_cost_base[0]
+        n -= self._save_cost_base[1]
+        return (tot / n) if n > 0 else None
+
+    def gather(self, sched) -> dict:
+        """One tick's controller inputs, computed from the scheduler's
+        state and the telemetry registry. Every value is a JSON
+        primitive — the decision journal must round-trip them
+        exactly."""
+        active = sched.active_jobs()
+        slacks = [s for s in (sched.slo.slack_s(j)
+                              for _b, _s, j in active) if s is not None]
+        slack_min = min(slacks) if slacks else None
+        lats = list(sched.slo._ewma.values())
+        lat = max(lats) if lats else None
+        trips = float(telemetry.registry().counter_total(
+            "dccrg_fleet_trips_total"))
+        steps = int(getattr(sched, "steps_total", 0))
+        d_steps = steps - self._last_steps
+        d_trips = trips - self._last_trips
+        if d_steps > 0:
+            # EWMA of the per-step trip rate over the tick window
+            self._trip_rate = (0.7 * self._trip_rate
+                               + 0.3 * (d_trips / d_steps))
+        self._last_steps, self._last_trips = steps, trips
+        suspects = int(sum(sched.suspects))
+        new_susp = suspects - self._last_suspects
+        self._last_suspects = suspects
+        if new_susp > 0:
+            self._clean = 0
+        else:
+            self._clean += 1
+        return {
+            "slo_slack_min_s": (None if slack_min is None
+                                else round(float(slack_min), 9)),
+            "quantum_latency_s": (None if lat is None
+                                  else round(float(lat), 9)),
+            "trip_rate": round(float(self._trip_rate), 9),
+            "save_cost_s": self._save_cost_mean(),
+            "new_suspects": new_susp,
+            "suspects_total": suspects,
+            "clean_streak": self._clean,
+            "active_jobs": len(active),
+        }
+
+    # -- the per-tick control pass ------------------------------------
+
+    def tick(self, sched) -> dict:
+        """One control pass at a scheduler tick boundary: gather
+        inputs, run every tuning rule, apply the surviving knob
+        values back onto the scheduler, export the live-knob gauges
+        and (periodically) the status snapshot. Pure host-side
+        arithmetic — no device work. Returns the gathered inputs
+        (the tests' window into the observation path)."""
+        self._tick = int(sched.ticks)
+        inp = self.gather(sched)
+        self._tune_quantum(sched, inp)
+        self._tune_audit(sched, inp)
+        if self._tick % self.adjust_every == 0:
+            self._tune_checkpoints(sched, inp)
+        telemetry.set_gauge("dccrg_autopilot_quantum", self.quantum)
+        telemetry.set_gauge("dccrg_autopilot_audit_every",
+                            self.audit_every)
+        if self._tick % self.status_every == 0:
+            self.write_status(sched, inp)
+        return inp
+
+    def _tune_quantum(self, sched, inp) -> None:
+        # the scheduler's live value is the source of truth: the
+        # controller only ever moves it through a journaled rule —
+        # an injected controller whose constructor defaults differ
+        # from the configured knob must not silently stomp it
+        self.quantum = max(1, int(sched.quantum))
+        lo, hi = self.bounds["quantum"]
+        slack = inp["slo_slack_min_s"]
+        rate = inp["trip_rate"]
+        short_evi = ((slack is not None and slack < 0.0)
+                     or rate > self.trip_warm)
+        self._q_short = self._q_short + 1 if short_evi else 0
+        lat = inp["quantum_latency_s"]
+        long_evi = (lat is not None and rate <= self.trip_cool
+                    and (slack is None
+                         or slack >= self.slack_factor * lat))
+        self._q_long = self._q_long + 1 if long_evi else 0
+        base = dict(inp, lo=lo, hi=hi, trip_warm=self.trip_warm,
+                    trip_cool=self.trip_cool,
+                    slack_factor=self.slack_factor)
+        q = self._apply(
+            "quantum.shorten", "quantum", self.quantum,
+            dict(base, streak=self._q_short,
+                 patience=self.shorten_patience))
+        if q == self.quantum:
+            q = self._apply(
+                "quantum.lengthen", "quantum", self.quantum,
+                dict(base, streak=self._q_long,
+                     patience=self.lengthen_patience))
+        if q != self.quantum:
+            self._q_short = self._q_long = 0
+            self.quantum = q
+            # the scheduler budgets and the SLO projections both
+            # follow the tuned quantum (written back ONLY on a
+            # journaled decision)
+            sched.quantum = self.quantum
+            sched.slo.quantum = self.quantum
+
+    def _tune_audit(self, sched, inp) -> None:
+        self.audit_every = max(0, int(sched.audit_every))  # live truth
+        lo, hi = self.bounds["audit_every"]
+        base = dict(inp, lo=lo, hi=hi, baseline=self.audit0,
+                    warm_start=8, relax_after=self.relax_after)
+        a = self._apply("audit.tighten", "audit_every",
+                        self.audit_every, base)
+        if a == self.audit_every:
+            a = self._apply("audit.relax", "audit_every",
+                            self.audit_every, base)
+        if a != self.audit_every:
+            self.audit_every = a
+            sched.audit_every = a
+
+    def _tune_checkpoints(self, sched, inp) -> None:
+        lo, hi = self.bounds["checkpoint_every"]
+        for b, _s, job in sched.active_jobs():
+            before = int(job.checkpoint_every)
+            if before <= 0 or job.steps_done < before:
+                continue  # cadence disabled / not one period of data
+            # step time from the job's OWN bucket latency (a
+            # heterogeneous fleet's fast buckets must not be priced
+            # by the slowest bucket's EWMA)
+            lat = sched.slo.quantum_latency(b.key)
+            step_s = (None if lat is None
+                      else round(lat / max(1, self.quantum), 9))
+            rate = round(len(job.trips) / max(1, job.steps_done), 9)
+            new = self._apply(
+                "checkpoint.retune", f"checkpoint_every[{job.name}]",
+                before, dict(inp, lo=lo, hi=hi, step_seconds=step_s,
+                             trip_rate=rate, deadband=0.25))
+            if new != before:
+                job.checkpoint_every = new
+
+    # -- capacity history ---------------------------------------------
+
+    def seed_capacity(self, bucket_key, default_cap: int,
+                      min_capacity: int = 1) -> int:
+        """The initial capacity for a NEW bucket of ``bucket_key``:
+        the learned surviving capacity when the recorded OOM/shed
+        history knows one smaller than ``default_cap``, else the
+        default. ``min_capacity`` floors the seed (the scheduler
+        passes the largest single job's slot demand, so a DMR job's
+        shadow slot survives history learned from plain jobs)."""
+        kid = key_id(bucket_key)
+        self._default_seen[kid] = int(default_cap)
+        if self.capacity.get(kid) is not None:
+            self._seeded.add(kid)
+        return self._apply(
+            "capacity.seed", f"capacity[{kid}]", int(default_cap),
+            {"learned_capacity": self.capacity.get(kid),
+             "default_capacity": int(default_cap),
+             "lo": max(1, int(min_capacity))})
+
+    def _learn_capacity(self, bucket_key, surviving: int,
+                        event: str) -> None:
+        kid = key_id(bucket_key)
+        self._shrunk.add(kid)
+        before = self.capacity.get(kid)
+        after = self._apply(
+            "capacity.learn", f"capacity[{kid}]", before,
+            {"observed_capacity": int(surviving), "event": event})
+        if after is not None:
+            self.capacity[kid] = int(after)
+
+    def record_oom(self, bucket_key, surviving_capacity: int) -> None:
+        """A real batch OOM forced a half-capacity rebuild that
+        survived at ``surviving_capacity`` slots."""
+        self._learn_capacity(bucket_key, surviving_capacity, "oom")
+
+    def record_shed(self, bucket_key, surviving_capacity: int) -> None:
+        """An SLO shed rebuilt the bucket at ``surviving_capacity``
+        slots."""
+        self._learn_capacity(bucket_key, surviving_capacity, "shed")
+
+    def end_of_run(self) -> None:
+        """The scheduler drained cleanly: every SEEDED bucket key
+        that saw no OOM/shed this run earns a ``capacity.probe`` —
+        the learned floor doubles back toward the configured default,
+        so one transient spike never pins a key's capacity down
+        across all future runs (the recovery is journaled and
+        replayable like every other decision)."""
+        for kid in sorted(self._seeded - self._shrunk):
+            before = self.capacity.get(kid)
+            if before is None:
+                continue
+            after = self._apply(
+                "capacity.probe", f"capacity[{kid}]", int(before),
+                {"clean_run": True,
+                 "default_capacity": self._default_seen.get(kid)})
+            if after != before:
+                self.capacity[kid] = int(after)
+        self._seeded.clear()
+        self._shrunk.clear()
+
+    # -- status snapshot ----------------------------------------------
+
+    def status_text(self, sched, inp=None) -> str:
+        """The human-readable operator snapshot: live knob values
+        (with their hard bounds), per-bucket latency EWMAs and
+        occupancy, per-lane suspect counters, per-job SLO slack and
+        checkpoint cadence, and the tail of the decision ring."""
+        lines = [
+            f"dccrg autopilot status — tick {self._tick}, "
+            f"{self.seq} decision(s)",
+            f"knobs: quantum={self.quantum} "
+            f"(bounds {self.bounds['quantum'][0]}.."
+            f"{self.bounds['quantum'][1]}, configured {self.quantum0})"
+            f" audit_every={self.audit_every} "
+            f"(bounds {self.bounds['audit_every'][0]}.."
+            f"{self.bounds['audit_every'][1]}, "
+            f"configured {self.audit0})",
+        ]
+        if inp is not None:
+            lines.append(
+                "inputs: " + " ".join(
+                    f"{k}={v}" for k, v in sorted(inp.items())))
+        lines.append("buckets:")
+        for key, insts in sched.buckets.items():
+            kid = key_id(key)
+            lat = sched.slo.quantum_latency(key)
+            for b in insts:
+                lines.append(
+                    f"  {kid} cap={b.capacity} jobs={len(b.jobs)} "
+                    f"ewma_s={'-' if lat is None else f'{lat:.6g}'}"
+                    + (f" seeded<={self.capacity[kid]}"
+                       if kid in self.capacity else ""))
+        lines.append(
+            "suspects: " + " ".join(
+                f"lane{i}={n}" + ("(quarantined)"
+                                  if i in sched.quarantined else "")
+                for i, n in enumerate(sched.suspects)))
+        lines.append("jobs:")
+        for _b, _s, job in sched.active_jobs():
+            slack = sched.slo.slack_s(job)
+            lines.append(
+                f"  {job.name} steps={job.steps_done}/{job.n_steps} "
+                f"ckpt_every={job.checkpoint_every} "
+                f"trips={len(job.trips)} slo_slack_s="
+                + ("-" if slack is None else f"{slack:.6g}"))
+        if self.decisions:
+            lines.append("recent decisions:")
+            for rec in list(self.decisions)[-5:]:
+                lines.append("  " + explain_decision(rec))
+        return "\n".join(lines) + "\n"
+
+    def write_status(self, sched, inp=None) -> bool:
+        """Best-effort (re)write of the status snapshot to
+        ``DCCRG_STATUS_FILE``; no sink configured is a no-op."""
+        path = self._status_file
+        if path is None:
+            return False
+        return telemetry._best_effort_write(
+            self._resolved(path), self.status_text(sched, inp),
+            append=False)
+
+
+# ---------------------------------------------------------------------
+# journal reading, explain, replay (no controller needed)
+# ---------------------------------------------------------------------
+
+def read_journal(path: str) -> list:
+    """Parse one JSONL decision journal — the trace-file reader with
+    a dict filter (torn tail lines from a killed run are skipped)."""
+    return [r for r in telemetry.read_trace(path)
+            if isinstance(r, dict)]
+
+
+def merge_journals(paths) -> list:
+    """Merge per-rank journals into one ``(ts, rank, seq)``-ordered
+    list — records already carry their rank tag, like trace
+    events."""
+    recs = []
+    for p in paths:
+        recs.extend(read_journal(p))
+    recs.sort(key=lambda r: (r.get("ts", 0.0), r.get("rank", 0),
+                             r.get("seq", 0)))
+    return recs
+
+
+def explain_decision(rec: dict) -> str:
+    """One decision record as a human-readable line: when, which rule,
+    what moved, every observed input it depended on, and the expected
+    effect."""
+    inputs = rec.get("inputs", {})
+    shown = ", ".join(f"{k}={inputs[k]}" for k in sorted(inputs))
+    return (f"[tick {rec.get('tick', '?')} seq {rec.get('seq', '?')} "
+            f"rank {rec.get('rank', 0)}] {rec.get('rule', '?')}: "
+            f"{rec.get('knob', '?')} {rec.get('before')} -> "
+            f"{rec.get('after')} | observed: {shown} | expected: "
+            f"{rec.get('expected', '')}")
+
+
+def replay(records) -> list:
+    """Re-derive every journaled action by feeding the RECORDED inputs
+    back through the same pure rules the live controller used.
+    Returns ``[(record, why)]`` divergences — an empty list means the
+    journal fully explains the run; anything else is a bug (journal
+    corruption, a nondeterministic input leak, or a rule edit that
+    silently changed behavior)."""
+    divergences = []
+    for rec in records:
+        rule = RULES.get(rec.get("rule"))
+        if rule is None:
+            divergences.append((rec, f"unknown rule {rec.get('rule')!r}"))
+            continue
+        try:
+            got = rule(rec.get("before"), rec.get("inputs", {}))
+        except Exception as e:  # noqa: BLE001 - a divergence, not a crash
+            divergences.append((rec, f"rule raised {e!r}"))
+            continue
+        if got is None:
+            divergences.append(
+                (rec, "rule does not fire on the recorded inputs"))
+        elif got != rec.get("after"):
+            divergences.append(
+                (rec, f"re-derived {got!r} != recorded "
+                      f"{rec.get('after')!r}"))
+    return divergences
+
+
+# ---------------------------------------------------------------------
+# CLI: python -m dccrg_tpu.autopilot explain|replay <journal>...
+# ---------------------------------------------------------------------
+
+def _main(argv=None) -> int:
+    """``python -m dccrg_tpu.autopilot explain <journal.jsonl>...``
+    prints every decision human-readably (rule, knob move, observed
+    inputs, expected effect) from the journal alone; ``replay``
+    re-derives each action from the recorded inputs through the same
+    rules the live controller used and exits 1 on any divergence
+    (replay divergence = bug). Per-rank journals of one run merge
+    like traces. Needs no jax."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="python -m dccrg_tpu.autopilot",
+                                 description=_main.__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    e = sub.add_parser("explain", help="reconstruct every decision "
+                                       "human-readably")
+    e.add_argument("files", nargs="+")
+    r = sub.add_parser("replay", help="re-derive every action from "
+                                      "the recorded inputs; exit 1 "
+                                      "on divergence")
+    r.add_argument("files", nargs="+")
+    args = ap.parse_args(argv)
+    recs = merge_journals(args.files)
+    if args.cmd == "explain":
+        for rec in recs:
+            print(explain_decision(rec))
+        print(f"# {len(recs)} decision(s)")
+        return 0
+    div = replay(recs)
+    for rec, why in div:
+        print(f"DIVERGED seq {rec.get('seq', '?')} "
+              f"({rec.get('rule', '?')}): {why}")
+    print(json.dumps({"decisions": len(recs),
+                      "divergences": len(div)}))
+    return 1 if div else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via CLI
+    import sys
+
+    sys.exit(_main())

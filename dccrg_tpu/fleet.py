@@ -801,7 +801,13 @@ def _main(argv=None) -> int:
     ap.add_argument("--keep-last", type=int, default=None)
     ap.add_argument("--no-resume", action="store_true",
                     help="ignore existing checkpoints in the workdir")
+    ap.add_argument("--autopilot", action="store_true",
+                    help="enable the telemetry-driven self-tuning "
+                         "controller (same as DCCRG_AUTOPILOT=1; "
+                         "decisions journal to DCCRG_DECISION_FILE)")
     args = ap.parse_args(argv)
+    if args.autopilot:
+        os.environ["DCCRG_AUTOPILOT"] = "1"
 
     from .scheduler import FleetPreemptedError, FleetScheduler
 
@@ -857,11 +863,20 @@ def _main(argv=None) -> int:
         done += row["status"] == "done"
         failed += row["status"] == "failed"
         steps += row["steps"]
-    print(json.dumps({"summary": {
+    summary = {
         "jobs": len(report), "done": done, "failed": failed,
         "steps_total": steps, "wall_s": round(wall, 3),
         "runs_per_s": round(done / wall, 3) if wall > 0 else None,
-        "workdir": workdir}}), flush=True)
+        "workdir": workdir}
+    if sched.autopilot is not None:
+        ap_state = sched.autopilot
+        summary["autopilot"] = {
+            "decisions": ap_state.seq,
+            "quantum": ap_state.quantum,
+            "audit_every": ap_state.audit_every,
+            "learned_capacities": dict(ap_state.capacity),
+        }
+    print(json.dumps({"summary": summary}), flush=True)
     return 0 if failed == 0 else 1
 
 

@@ -137,6 +137,21 @@ def integrity_rtol(default: float = 1e-4) -> float:
         return default
 
 
+def note_suspect(lane: int, count: int,
+                 quarantined: bool = False) -> None:
+    """Export one device lane's suspect accounting as live gauges
+    (``dccrg_lane_suspects{lane}`` / ``dccrg_lane_quarantined{lane}``)
+    — a first-class controller input for the autopilot's audit-cadence
+    rule and the operator's dashboard, useful with the autopilot off
+    too."""
+    from . import telemetry
+
+    telemetry.set_gauge("dccrg_lane_suspects", int(count),
+                        lane=str(int(lane)))
+    telemetry.set_gauge("dccrg_lane_quarantined",
+                        1 if quarantined else 0, lane=str(int(lane)))
+
+
 def sum_tolerance(base, n_elements: int, steps: int = 1) -> float:
     """Allowed |drift| of a conservation sum over ``steps`` steps of a
     conservative kernel: rounding accumulates ~eps per element-update,

@@ -627,9 +627,13 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
     ``sidecar_extra`` merges extra keys (the delta parent link) into
     the sidecar record — the incremental-save plumbing; use
     :func:`save_delta_checkpoint` rather than passing them directly."""
-    telemetry.inc("dccrg_saves_total",
-                  kind=("delta" if sidecar_extra and "delta"
-                        in sidecar_extra else "keyframe"))
+    kind = ("delta" if sidecar_extra and "delta" in sidecar_extra
+            else "keyframe")
+    telemetry.inc("dccrg_saves_total", kind=kind)
+    # measured save cost is a first-class controller input
+    # (dccrg_ckpt_save_seconds{kind}): the autopilot prices checkpoint
+    # cadence with it, and operators read the same histogram
+    t_save = time.perf_counter()
     if grid._multiproc:
         # multi-process meshes take the TWO-PHASE-COMMIT save
         # (checkpoint._save_process_slice): every rank streams its
@@ -647,6 +651,8 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
             sidecar=sidecar, sidecar_chunk_bytes=chunk_bytes,
             fields=fields, sidecar_extra=sidecar_extra)
         faults.corrupt_file(filename)
+        telemetry.observe("dccrg_ckpt_save_seconds",
+                          time.perf_counter() - t_save, kind=kind)
         return filename
 
     tmp = filename + f".tmp.{os.getpid()}"
@@ -705,6 +711,8 @@ def save_checkpoint(grid, filename: str, header: bytes = b"",
     # post-write corruption injection happens AFTER the sidecar records
     # the good bytes — exactly the at-rest corruption CRCs exist for
     faults.corrupt_file(filename)
+    telemetry.observe("dccrg_ckpt_save_seconds",
+                      time.perf_counter() - t_save, kind=kind)
     return filename
 
 
@@ -1421,6 +1429,7 @@ class ResilientRunner:
         # verifies + materializes the keyframe+delta chain (a broken
         # chain surfaces as DeltaChainError — a corrupt rollback
         # target either way)
+        t0 = time.perf_counter()
         with telemetry.span("runner.rollback"):
             load_checkpoint_into(self.grid, self.checkpoint_path,
                                  header_size=len(self.header),
@@ -1428,6 +1437,10 @@ class ResilientRunner:
         self.step = self._ckpt_step
         self.rollbacks += 1
         telemetry.inc("dccrg_rollbacks_total")
+        # rollback cost is a controller input (with the trip rate it
+        # prices the replay window a checkpoint cadence implies)
+        telemetry.observe("dccrg_rollback_seconds",
+                          time.perf_counter() - t0)
 
     # -- trip handling ------------------------------------------------
 

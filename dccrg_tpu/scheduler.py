@@ -68,6 +68,7 @@ from contextlib import nullcontext
 
 import numpy as np
 
+from . import autopilot as autopilot_mod
 from . import faults, integrity, resilience, supervise, telemetry
 from .fleet import (SHADOW, FleetJob, GridBatch, max_batch_default,
                     quantum_default)
@@ -214,13 +215,21 @@ class FleetScheduler:
     ``slo_policy`` injects a custom :class:`SLOPolicy` (fake clock /
     tuned EWMA for the deterministic tests); the default one is fed
     by the telemetry-measured quantum latencies and drives both the
-    SLO admission reorder and the over-latency bucket shedding."""
+    SLO admission reorder and the over-latency bucket shedding.
+    ``autopilot`` injects a :class:`~dccrg_tpu.autopilot.Autopilot`
+    controller (fake clock for the deterministic tests); with None
+    one is constructed only under ``DCCRG_AUTOPILOT=1`` — otherwise
+    ``self.autopilot`` stays None and every autopilot hook is a
+    skipped ``if``, leaving scheduling, checkpoint cadence and audit
+    cadence bitwise identical to the pre-autopilot behavior (the
+    negative pin in tests/test_autopilot.py)."""
 
     def __init__(self, checkpoint_dir, jobs=(), *, max_batch=None,
                  quantum=None, keep_last=None, keep_every=0,
                  resume=True, devices=None,
                  install_signal_handlers=False, audit_every=None,
-                 quarantine_after=None, slo_policy=None):
+                 quarantine_after=None, slo_policy=None,
+                 autopilot=None):
         self.dir = str(checkpoint_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.max_batch = (max_batch_default() if max_batch is None
@@ -257,6 +266,15 @@ class FleetScheduler:
         # policy (fake clock, tuned alpha) is injectable for tests
         self.slo = (SLOPolicy(quantum=self.quantum)
                     if slo_policy is None else slo_policy)
+        # the self-tuning controller: OFF unless injected or opted in
+        # via DCCRG_AUTOPILOT=1 — None means no hook below ever runs
+        if autopilot is None and autopilot_mod.autopilot_enabled():
+            autopilot = autopilot_mod.Autopilot(
+                quantum=self.quantum, audit_every=self.audit_every)
+        self.autopilot = autopilot
+        #: cumulative job-steps advanced by dispatches (a controller
+        #: input: the trip-rate denominator)
+        self.steps_total = 0
         self._queue: list = []  # heap of (-priority, seq, job)
         self._seq = itertools.count()
         self._by_name: dict = {}
@@ -326,6 +344,17 @@ class FleetScheduler:
             j.redundancy for j in pending
             if j.bucket_key() == key)
         cap = min(self.max_batch, bucket_capacity(same_key))
+        if self.autopilot is not None:
+            # seed from the recorded OOM/shed history instead of
+            # rediscovering the safe capacity by halving every run —
+            # floored at the largest single job's slot demand, so a
+            # redundancy=2 job's DMR shadow can never be stripped by
+            # history learned from a differently-shaped workload
+            need = max([job.redundancy] + [
+                j.redundancy for j in pending
+                if j.bucket_key() == key])
+            cap = self.autopilot.seed_capacity(key, cap,
+                                               min_capacity=need)
         lane = lanes[self._next_dev % len(lanes)]
         b = GridBatch(job, cap, device=self.devices[lane])
         b.lane = lane
@@ -484,6 +513,7 @@ class FleetScheduler:
             job.requeues += 1
             self.add(job)
             return
+        t0 = time.perf_counter()
         restored = self._load_newest(batch, self.store_for(job), job)
         if restored is None:
             logger.error("fleet job %s has no loadable checkpoint to "
@@ -498,6 +528,10 @@ class FleetScheduler:
         batch.sync_shadow(slot)
         job.rollbacks += 1
         telemetry.inc("dccrg_fleet_rollbacks_total", job=job.name)
+        # rollback cost is a controller input (with the trip rate it
+        # prices the expected replay a longer checkpoint cadence buys)
+        telemetry.observe("dccrg_rollback_seconds",
+                          time.perf_counter() - t0)
         job.steps_done = restored
         # re-baseline the cadence like _admit_into: a fallback to an
         # OLDER checkpoint would otherwise leave steps_done -
@@ -602,6 +636,7 @@ class FleetScheduler:
         inv = batch.last_inv  # fused invariants (None: integrity off)
         for slot, job in active:
             job.steps_done += int(budget[slot])
+            self.steps_total += int(budget[slot])
         # fleet-scoped fault landing pads (chaos tests): NaN poisons
         # and FINITE silent flips for the steps this quantum advanced
         # each job through
@@ -782,8 +817,7 @@ class FleetScheduler:
         job = batch.slots[slot]
         if job is None or job is SHADOW or steps <= 0:
             return
-        self.audits += 1
-        telemetry.inc("dccrg_audits_total")
+        t0 = time.perf_counter()
         try:
             with telemetry.span("integrity.audit"):
                 digests = self._audit_digests(batch, slot, pre,
@@ -791,6 +825,16 @@ class FleetScheduler:
                 if digests is None:  # no comparable re-execution path
                     return
                 live, shadow = digests
+                # an audit counts only once a re-execution actually
+                # compared — the bulk-no-spare and OOM skip paths
+                # increment their own skip counter instead, so the
+                # exposition never reports audits that did not run
+                self.audits += 1
+                telemetry.inc("dccrg_audits_total")
+                # audit cost is a controller input: what one extra
+                # re-execution window actually costs this fleet
+                telemetry.observe("dccrg_audit_seconds",
+                                  time.perf_counter() - t0)
         except Exception as e:  # noqa: BLE001 - filtered just below
             if not resilience._is_resource_exhausted(e):
                 raise
@@ -802,6 +846,7 @@ class FleetScheduler:
             logger.warning(
                 "shadow audit of job %s skipped: the audit dispatch "
                 "itself hit RESOURCE_EXHAUSTED (%s)", job.name, e)
+            telemetry.inc("dccrg_audits_skipped_total")
             return
         # the verdict + containment run OUTSIDE the OOM-swallowing
         # try: only the audit's own extra dispatches may be skipped —
@@ -900,6 +945,8 @@ class FleetScheduler:
         self._trip(batch, slot, job, "corrupt")
         if lane < len(self.suspects):
             self.suspects[lane] += 1
+            integrity.note_suspect(lane, self.suspects[lane],
+                                   quarantined=lane in self.quarantined)
             if (self.quarantine_after > 0
                     and lane not in self.quarantined
                     and self.suspects[lane] >= self.quarantine_after):
@@ -926,6 +973,8 @@ class FleetScheduler:
                 "serve on suspect hardware", lane, self.suspects[lane])
             return
         self.quarantined.add(lane)
+        integrity.note_suspect(lane, self.suspects[lane],
+                               quarantined=True)
         moved = 0
         for key, insts in self.buckets.items():
             for i, batch in enumerate(insts):
@@ -1017,6 +1066,8 @@ class FleetScheduler:
         drop = len(active) // 2
         self._requeue_keyframed(batch, by_prio[:drop])
         small = self._rebuild_smaller(batch)
+        if self.autopilot is not None:
+            self.autopilot.record_oom(batch.key, small.capacity)
         logger.warning(
             "fleet bucket OOM: requeued %d of %d job(s), rebuilt the "
             "bucket at capacity %d (was %d)", drop, len(active),
@@ -1047,6 +1098,8 @@ class FleetScheduler:
         # shed_victims caps at len(jobs)-1, so a survivor always
         # remains for the rebuild
         small = self._rebuild_smaller(batch)
+        if self.autopilot is not None:
+            self.autopilot.record_shed(batch.key, small.capacity)
         logger.warning(
             "SLO shed: requeued %d job(s) and rebuilt the bucket at "
             "capacity %d (was %d) — measured quantum latency blew "
@@ -1099,6 +1152,10 @@ class FleetScheduler:
                         raise RuntimeError(
                             "fleet wedged: queued jobs but no bucket "
                             "can admit them")
+                    if self.autopilot is not None:
+                        # a clean drain: seeded keys that never
+                        # OOMed/shed earn their capacity floor back
+                        self.autopilot.end_of_run()
                     break
                 for batch in active:
                     self._quantum(batch)
@@ -1116,6 +1173,11 @@ class FleetScheduler:
                     for batch in list(insts):
                         if batch.jobs:
                             self._shed_for_slo(batch)
+                # autopilot control pass — also a tick-boundary act
+                # (it retunes the knobs the NEXT tick dispatches
+                # with); None (the default) skips everything
+                if self.autopilot is not None:
+                    self.autopilot.tick(self)
                 self.ticks += 1
                 telemetry.maybe_export_metrics()
                 if max_ticks is not None and self.ticks >= int(max_ticks):

@@ -1086,10 +1086,17 @@ class SupervisedRunner:
                     f"verification (chunks {bad})", bad_chunks=bad)
 
         try:
+            t0 = time.perf_counter()
             with telemetry.span("ckpt.emergency"), _grace_env(self.grace):
                 _under_deadline(_save, self.grace,
                                 f"emergency checkpoint at step {step}",
                                 step=step)
+            # the deadline-bounded save+verify cost, distinct from the
+            # periodic kinds: how much of the grace window a preempt
+            # actually spends (a controller/operator input)
+            telemetry.observe("dccrg_ckpt_save_seconds",
+                              time.perf_counter() - t0,
+                              kind="emergency")
         except Exception as e:  # noqa: BLE001 - resumability outranks it
             logger.error(
                 "emergency checkpoint failed (%s); the last periodic "

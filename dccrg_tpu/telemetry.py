@@ -62,6 +62,7 @@ import collections
 import json
 import math
 import os
+import re
 import threading
 import time
 
@@ -673,15 +674,157 @@ def root_coverage(evs, wall_s: float) -> float:
 
 
 # ---------------------------------------------------------------------
+# Prometheus exposition read-back: the histogram half of `summary`
+# ---------------------------------------------------------------------
+
+_PROM_LINE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)"
+                        r"(?:\{(.*)\})?\s(\S+)$")
+_PROM_LABEL = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)='
+                         r'"((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label(v: str) -> str:
+    # single pass: sequential str.replace would corrupt values like
+    # a\n-after-backslash (the \\ must not feed the \n rule)
+    return re.sub(r"\\(.)",
+                  lambda m: "\n" if m.group(1) == "n" else m.group(1),
+                  v)
+
+
+def parse_prometheus_histograms(text: str) -> dict:
+    """Parse the histogram series back out of a Prometheus text
+    exposition (a ``DCCRG_METRICS_FILE``): ``{(name, labels):
+    {"count", "sum", "buckets": [(le, cumulative)]}}`` with the
+    ``le`` label lifted out of the labels and ``+Inf`` mapped to
+    ``math.inf``. Counters/gauges are ignored (they read directly);
+    this is the read-back path for the numbers the registry's
+    :class:`LogHistogram` wrote out."""
+    series: dict = {}
+
+    def ent(name, labels):
+        key = (name, tuple(sorted(labels.items())))
+        return series.setdefault(
+            key, {"count": 0, "sum": 0.0, "buckets": []})
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        if m is None:
+            continue
+        name, labstr, sval = m.groups()
+        try:
+            val = float(sval)
+        except ValueError:
+            continue
+        labels = {k: _unescape_label(v)
+                  for k, v in _PROM_LABEL.findall(labstr or "")}
+        if name.endswith("_bucket") and "le" in labels:
+            le = labels.pop("le")
+            ent(name[:-len("_bucket")], labels)["buckets"].append(
+                (math.inf if le in ("+Inf", "+inf", "inf") else
+                 float(le), val))
+        elif name.endswith("_sum"):
+            ent(name[:-len("_sum")], labels)["sum"] = val
+        elif name.endswith("_count"):
+            ent(name[:-len("_count")], labels)["count"] = int(val)
+    out = {}
+    for key, s in series.items():
+        if not s["buckets"]:
+            continue  # a counter that merely ends in _sum/_count
+        s["buckets"].sort(key=lambda b: b[0])
+        out[key] = s
+    return out
+
+
+def merge_prometheus_histograms(into: dict, more: dict) -> dict:
+    """Accumulate one :func:`parse_prometheus_histograms` result into
+    another IN PLACE (and return it): same-keyed series SUM their
+    counts, sums and per-``le`` cumulative bucket counts — the
+    correct merge for per-rank metrics files of one run (a plain
+    dict update would silently keep only the last rank's series)."""
+    for key, s in more.items():
+        have = into.get(key)
+        if have is None:
+            into[key] = {"count": s["count"], "sum": s["sum"],
+                         "buckets": list(s["buckets"])}
+            continue
+        have["count"] += s["count"]
+        have["sum"] += s["sum"]
+        by_le = dict(have["buckets"])
+        for le, cum in s["buckets"]:
+            by_le[le] = by_le.get(le, 0.0) + cum
+        have["buckets"] = sorted(by_le.items(), key=lambda b: b[0])
+    return into
+
+
+def _bucket_quantile(buckets, total: int, q: float):
+    """Upper bucket edge holding the q-quantile of a cumulative
+    ``[(le, cum)]`` list (the same convention as
+    :meth:`LogHistogram.quantile`); None when empty/unbounded."""
+    if total <= 0:
+        return 0.0
+    target = max(1, math.ceil(q * total))
+    for le, cum in buckets:
+        if cum >= target:
+            return None if le == math.inf else le
+    le = buckets[-1][0]
+    return None if le == math.inf else le
+
+
+def histogram_stats(hists=None) -> dict:
+    """Per-histogram ``{series: {count, sum_s, p50_s, p99_s}}`` — the
+    same numbers the autopilot controller acts on, readable by
+    operators. ``hists=None`` aggregates the LIVE registry histograms;
+    otherwise pass a :func:`parse_prometheus_histograms` result (the
+    offline ``summary`` CLI path over a metrics file)."""
+    out = {}
+    if hists is None:
+        for (name, lab), h in sorted(_REGISTRY.histograms.items()):
+            out[name + _fmt_labels(lab)] = {
+                "count": h.total, "sum_s": h.sum_seconds,
+                "p50_s": h.quantile(0.5), "p99_s": h.quantile(0.99),
+                "max_s": h.max_seconds}
+        return out
+    for (name, lab), s in sorted(hists.items()):
+        out[name + _fmt_labels(lab)] = {
+            "count": s["count"], "sum_s": s["sum"],
+            "p50_s": _bucket_quantile(s["buckets"], s["count"], 0.5),
+            "p99_s": _bucket_quantile(s["buckets"], s["count"], 0.99)}
+    return out
+
+
+# ---------------------------------------------------------------------
 # CLI: python -m dccrg_tpu.telemetry merge|summary ...
 # ---------------------------------------------------------------------
 
+def _looks_like_prometheus(path: str) -> bool:
+    """Sniff a summary input: a Prometheus exposition (a
+    ``DCCRG_METRICS_FILE``) vs a JSONL trace. Traces are JSON object
+    lines; expositions carry ``# TYPE`` comments / bare samples."""
+    try:
+        with open(path) as f:
+            head = f.read(4096)
+    except OSError:
+        return False
+    for line in head.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        return not line.startswith("{")
+    return False
+
+
 def _main(argv=None) -> int:
     """``python -m dccrg_tpu.telemetry merge <trace.jsonl>...`` prints
-    the rank-merged timeline as JSONL; ``summary <trace.jsonl>...``
-    prints per-span aggregates (count, total, p50/p99/max) as JSON.
-    Works on per-rank files of one run (the events carry rank tags)
-    without importing jax."""
+    the rank-merged timeline as JSONL; ``summary <file>...`` prints
+    per-span aggregates (count, total, p50/p99/max) of JSONL traces
+    AND per-histogram p50/p99 of Prometheus metrics files
+    (``DCCRG_METRICS_FILE`` expositions — sniffed apart
+    automatically), so operators can read the same latency numbers
+    the autopilot controller acts on. Works on per-rank files of one
+    run (the events carry rank tags) without importing jax."""
     import argparse
 
     ap = argparse.ArgumentParser(prog="python -m dccrg_tpu.telemetry",
@@ -691,18 +834,31 @@ def _main(argv=None) -> int:
                                      "one ts-ordered timeline")
     m.add_argument("files", nargs="+")
     s = sub.add_parser("summary", help="per-span-name aggregates of "
-                                       "one or more traces")
+                                       "traces and per-histogram "
+                                       "p50/p99 of metrics files")
     s.add_argument("files", nargs="+")
     args = ap.parse_args(argv)
-    evs = merge_traces(args.files)
     if args.cmd == "merge":
-        for e in evs:
+        for e in merge_traces(args.files):
             print(json.dumps(e, sort_keys=True))
         return 0
-    print(json.dumps({"events": len(evs),
-                      "ranks": sorted({e.get("rank", 0) for e in evs}),
-                      "spans": span_stats(evs)}, indent=1,
-                     sort_keys=True))
+    prom_files = [p for p in args.files if _looks_like_prometheus(p)]
+    trace_files = [p for p in args.files if p not in prom_files]
+    evs = merge_traces(trace_files)
+    out = {"events": len(evs),
+           "ranks": sorted({e.get("rank", 0) for e in evs}),
+           "spans": span_stats(evs)}
+    if prom_files:
+        hists: dict = {}
+        for p in prom_files:
+            try:
+                with open(p) as f:
+                    merge_prometheus_histograms(
+                        hists, parse_prometheus_histograms(f.read()))
+            except OSError:
+                continue
+        out["histograms"] = histogram_stats(hists)
+    print(json.dumps(out, indent=1, sort_keys=True))
     return 0
 
 

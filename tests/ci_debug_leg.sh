@@ -40,6 +40,12 @@
 # negative pin that DCCRG_BULK unset compiles the pre-executor
 # program.
 #
+# Also runs an autopilot smoke leg under DCCRG_DEBUG=1: an opted-in
+# fleet run writes its decision journal and every decision replays
+# (re-derives) from the journal alone, the explain/replay CLI round
+# trips (tampering detected), and the off-by-default negative pin
+# holds — no controller, no knob movement, bitwise-solo results.
+#
 # Usage: tests/ci_debug_leg.sh [extra pytest args]
 set -e
 cd "$(dirname "$0")/.."
@@ -65,6 +71,11 @@ env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_bulk_executor.py::test_bulk_negative_pin" \
     "tests/test_bulk_executor.py::test_fleet_bulk_bucket_matches_table_path" \
     -p no:cacheprovider "$@"
+env JAX_PLATFORMS=cpu python -m pytest -q \
+    "tests/test_autopilot.py::test_autopilot_on_preserves_results" \
+    "tests/test_autopilot.py::test_explain_and_replay_cli" \
+    "tests/test_autopilot.py::test_off_by_default_negative_pin" \
+    --dccrg-debug -p no:cacheprovider "$@"
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_recommit.py::test_native_numpy_plans_bitwise_identical" \
     "tests/test_checkpoint_integrity.py::test_chain_salvage_falls_back_to_verifying_prefix" \

@@ -1,0 +1,755 @@
+"""Production autopilot: telemetry-driven self-tuning with an
+explainable decision journal.
+
+The pins: the controller is deterministic under a fake clock and pure
+rules (a journal replay re-derives every action from the recorded
+inputs alone); knob convergence under injected OOM/trip/shed/suspect
+histories; the hard-bound property (no decision ever leaves its
+envelope); the off-by-default negative pin (with ``DCCRG_AUTOPILOT``
+unset the scheduler constructs no controller and fleet results,
+cadences and knobs are untouched); and the controller-input metrics
+(save/rollback/audit cost histograms, per-lane suspect gauges) that
+are useful observability even with the autopilot off."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dccrg_tpu import autopilot as ap_mod
+from dccrg_tpu import telemetry
+from dccrg_tpu.autopilot import (RULES, Autopilot, explain_decision,
+                                 key_id, read_journal, replay)
+from dccrg_tpu.faults import FaultPlan
+from dccrg_tpu.fleet import FleetJob, GridBatch, run_solo
+from dccrg_tpu.scheduler import FleetScheduler, SLOPolicy
+
+pytestmark = pytest.mark.autopilot
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Autopilot off in the env, a fresh registry, and both again on
+    the way out (the registry is process-global by design)."""
+    for var in ("DCCRG_AUTOPILOT", "DCCRG_DECISION_FILE",
+                "DCCRG_STATUS_FILE"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.configure(trace=False)
+    telemetry.clear_trace()
+    telemetry.registry().reset()
+    yield
+    telemetry.configure(trace=False)
+    telemetry.clear_trace()
+    telemetry.registry().reset()
+
+
+def _jobs(count=4, steps=16, slo_ms=None, **kw):
+    return [FleetJob(f"a{i:02d}", length=(8, 8, 8), n_steps=steps,
+                     seed=i, params=(0.03,), checkpoint_every=4,
+                     slo_ms=slo_ms, **kw)
+            for i in range(count)]
+
+
+def _solo(jobs):
+    return {j.name: run_solo(FleetJob(
+        j.name, length=(8, 8, 8), n_steps=j.n_steps, seed=j.seed,
+        params=j.params)) for j in jobs}
+
+
+def _sched(tmp_path, jobs, ap=None, quantum=4, **kw):
+    pol = SLOPolicy(quantum=quantum, clock=lambda: 0.0)
+    return FleetScheduler(str(tmp_path / "work"), jobs,
+                          quantum=quantum, slo_policy=pol,
+                          autopilot=ap, **kw), pol
+
+
+# -- the rules: pure, deterministic, JSON-faithful --------------------
+
+def test_rules_pure_and_json_faithful():
+    """Every rule derives the same action from the same inputs, and
+    from the inputs after a JSON round-trip — the property replay
+    rests on (journaled inputs ARE json)."""
+    cases = {
+        "quantum.shorten": (8, {"slo_slack_min_s": -1.0,
+                                "trip_rate": 0.0, "lo": 1, "hi": 64,
+                                "streak": 1, "patience": 1}),
+        "quantum.lengthen": (8, {"slo_slack_min_s": None,
+                                 "quantum_latency_s": 0.001,
+                                 "trip_rate": 0.0, "lo": 1, "hi": 64,
+                                 "streak": 9, "patience": 4}),
+        "checkpoint.retune": (32, {"save_cost_s": 0.05,
+                                   "step_seconds": 0.01,
+                                   "trip_rate": 0.125,
+                                   "lo": 1, "hi": 256}),
+        "audit.tighten": (8, {"new_suspects": 1, "hi": 16}),
+        "audit.relax": (2, {"clean_streak": 9, "relax_after": 8,
+                            "baseline": 8, "hi": 16}),
+        "capacity.learn": (None, {"observed_capacity": 4}),
+        "capacity.seed": (16, {"learned_capacity": 4, "lo": 1}),
+        "capacity.probe": (4, {"clean_run": True,
+                               "default_capacity": 16}),
+    }
+    assert set(cases) == set(RULES)
+    for rule, (before, inp) in cases.items():
+        first = RULES[rule](before, inp)
+        assert first is not None, rule  # the case is a firing one
+        assert RULES[rule](before, inp) == first, rule
+        rt = json.loads(json.dumps(inp))
+        assert RULES[rule](before, rt) == first, rule
+
+
+def test_checkpoint_retune_is_youngs_optimum():
+    """sqrt(2 * (save_cost/step_time) / trip_rate): higher trip rate
+    -> shorter cadence, a trip-free history -> the upper bound."""
+    inp = {"save_cost_s": 0.05, "step_seconds": 0.01, "lo": 1,
+           "hi": 256}
+    calm = RULES["checkpoint.retune"](32, dict(inp, trip_rate=0.0))
+    warm = RULES["checkpoint.retune"](32, dict(inp, trip_rate=0.02))
+    hot = RULES["checkpoint.retune"](32, dict(inp, trip_rate=0.5))
+    assert calm == 256  # no trips: saves cost, trips don't
+    assert warm == round((2 * 5 / 0.02) ** 0.5)  # = 22
+    assert hot < warm < calm
+    # the deadband suppresses churn: a value within 25% stands
+    assert RULES["checkpoint.retune"](21, dict(inp, trip_rate=0.02)) \
+        is None
+
+
+def test_hard_bounds_property():
+    """Fuzzed inputs (extreme rates, negative slacks, huge costs):
+    every rule either declines or lands inside the recorded
+    envelope. The knobs can NEVER leave their bounds."""
+    rng = np.random.default_rng(7)
+    maybe = lambda v: None if rng.random() < 0.2 else v  # noqa: E731
+    for _ in range(400):
+        lo, hi = 1, int(rng.integers(2, 512))
+        inp = {
+            "lo": lo, "hi": hi,
+            "slo_slack_min_s": maybe(float(rng.normal(0, 50))),
+            "quantum_latency_s": maybe(float(abs(rng.normal(0, 10)))),
+            "trip_rate": float(abs(rng.normal(0, 1))),
+            "save_cost_s": maybe(float(abs(rng.normal(0, 10)))),
+            "step_seconds": maybe(float(abs(rng.normal(0, 1)))),
+            "new_suspects": int(rng.integers(-1, 5)),
+            "clean_streak": int(rng.integers(0, 20)),
+            "relax_after": 8, "baseline": int(rng.integers(0, 17)),
+            "warm_start": 8, "streak": int(rng.integers(0, 10)),
+            "patience": int(rng.integers(1, 5)),
+            "trip_warm": 0.02, "trip_cool": 0.005,
+            "slack_factor": 8.0, "deadband": 0.25,
+            "observed_capacity": int(rng.integers(1, 256)),
+            "learned_capacity": maybe(int(rng.integers(1, 256))),
+        }
+        before = int(rng.integers(lo, hi + 1))
+        for rule in ("quantum.shorten", "quantum.lengthen",
+                     "checkpoint.retune"):
+            got = RULES[rule](before, inp)
+            assert got is None or lo <= got <= hi, (rule, inp)
+        for rule in ("audit.tighten", "audit.relax"):
+            got = RULES[rule](int(rng.integers(0, hi + 1)), inp)
+            # audits: {0 = off} ∪ [1, hi]
+            assert got is None or got == 0 or 1 <= got <= hi, \
+                (rule, inp)
+        got = RULES["capacity.seed"](before, inp)
+        assert got is None or lo <= got <= before, inp
+        got = RULES["capacity.learn"](maybe(before), inp)
+        assert got is None or got >= 1
+        got = RULES["capacity.probe"](
+            before, dict(inp, clean_run=bool(rng.integers(0, 2)),
+                         default_capacity=maybe(int(
+                             rng.integers(1, 256)))))
+        assert got is None or got >= 1
+
+
+# -- knob convergence under injected histories ------------------------
+
+def _tick(sched, ap, n=1):
+    """Drive n controller ticks without dispatching (the tests inject
+    the observations by hand — the fake-clock discipline)."""
+    for _ in range(n):
+        ap.tick(sched)
+        sched.ticks += 1
+
+
+def test_quantum_shortens_under_slo_violation(tmp_path):
+    """Sustained negative SLO slack halves the quantum down to the
+    envelope floor — and never through it."""
+    jobs = _jobs(2, slo_ms=100.0)
+    ap = Autopilot(quantum=16, clock=lambda: 0.0)
+    sched, pol = _sched(tmp_path, jobs, ap, quantum=16)
+    sched._admit_pending()
+    for j in jobs:
+        j.slo_t0 = 0.0
+    pol.observe(jobs[0].bucket_key(), 10.0)  # blows the 100 ms SLO
+    seen = []
+    _tick(sched, ap, 8)
+    for rec in ap.decisions:
+        seen.append((rec["rule"], rec["before"], rec["after"]))
+    lo, hi = ap.bounds["quantum"]
+    assert sched.quantum == lo == 1
+    assert [r for r, _b, _a in seen] == ["quantum.shorten"] * 4
+    assert [(b, a) for _r, b, a in seen] == [(16, 8), (8, 4), (4, 2),
+                                             (2, 1)]
+    # the SLO projections follow the tuned quantum
+    assert pol.quantum == 1
+
+
+def test_quantum_lengthens_with_comfortable_slack(tmp_path):
+    """Low measured latency, no violations, cool trip rate: the
+    quantum doubles (after the patience streak) up to the envelope
+    ceiling — amortizing dispatch — and never through it."""
+    jobs = _jobs(2)  # best-effort only: slack is None
+    ap = Autopilot(quantum=4, clock=lambda: 0.0, lengthen_patience=3)
+    sched, pol = _sched(tmp_path, jobs, ap, quantum=4)
+    sched._admit_pending()
+    pol.observe(jobs[0].bucket_key(), 1e-4)
+    _tick(sched, ap, 2)
+    assert sched.quantum == 4  # patience not yet reached
+    _tick(sched, ap, 20)
+    assert sched.quantum == ap.bounds["quantum"][1] == 32
+    rules = {r["rule"] for r in ap.decisions}
+    assert rules == {"quantum.lengthen"}
+
+
+def test_checkpoint_cadence_follows_trip_history(tmp_path):
+    """The acceptance pin: an injected high-trip-rate history
+    measurably SHORTENS the checkpoint cadence; a trip-free history
+    with the same measured save cost lengthens it to the bound."""
+    jobs = _jobs(2, steps=400)
+    for j in jobs:
+        j.checkpoint_every = 32
+    ap = Autopilot(quantum=4, clock=lambda: 0.0, adjust_every=1)
+    sched, pol = _sched(tmp_path, jobs, ap, quantum=4)
+    sched._admit_pending()
+    pol.observe(jobs[0].bucket_key(), 0.04)  # 0.01 s/step
+    # replace the admission keyframes' real timings with a fixed
+    # injected save-cost history (the test is about the rule)
+    telemetry.registry().reset()
+    for _ in range(6):
+        telemetry.observe("dccrg_ckpt_save_seconds", 0.05,
+                          kind="keyframe")
+    calm, tripping = jobs
+    calm.steps_done = 64
+    tripping.steps_done = 64
+    tripping.trips = [("nan", i) for i in range(8)]  # rate 0.125
+    _tick(sched, ap)
+    assert calm.checkpoint_every == 256  # trip-free: the bound
+    assert tripping.checkpoint_every < 32  # high trips: shortened
+    # Young: sqrt(2 * (0.05/0.01) / 0.125) = sqrt(80) ~ 9
+    assert tripping.checkpoint_every == 9
+    knobs = {r["knob"] for r in ap.decisions}
+    assert f"checkpoint_every[{calm.name}]" in knobs
+    assert f"checkpoint_every[{tripping.name}]" in knobs
+
+
+def test_audit_cadence_warm_then_clean(tmp_path):
+    """Suspect verdicts tighten the audit cadence (halving); a clean
+    streak relaxes it back to the configured baseline — and not
+    past it."""
+    jobs = _jobs(2)
+    ap = Autopilot(quantum=4, audit_every=8, clock=lambda: 0.0,
+                   relax_after=2)
+    sched, _pol = _sched(tmp_path, jobs, ap, quantum=4, audit_every=8)
+    sched._admit_pending()
+    sched.suspects[0] = 1
+    _tick(sched, ap)
+    assert sched.audit_every == 4
+    sched.suspects[0] = 2
+    _tick(sched, ap)
+    assert sched.audit_every == 2
+    # clean from here: relax_after=2 clean ticks per doubling
+    _tick(sched, ap, 2)
+    assert sched.audit_every == 4
+    _tick(sched, ap, 2)
+    assert sched.audit_every == 8
+    _tick(sched, ap, 6)
+    assert sched.audit_every == 8  # the baseline, never past
+
+
+def test_audit_cadence_switches_on_from_zero_baseline(tmp_path):
+    """A baseline of 0 (audits off) still warms up under suspects —
+    and a long clean streak switches audits back off."""
+    jobs = _jobs(2)
+    ap = Autopilot(quantum=4, audit_every=0, clock=lambda: 0.0,
+                   relax_after=1)
+    sched, _pol = _sched(tmp_path, jobs, ap, quantum=4, audit_every=0)
+    sched._admit_pending()
+    sched.suspects[0] = 1
+    _tick(sched, ap)
+    assert sched.audit_every == 8  # warm start: audits ON
+    _tick(sched, ap)  # clean: 8 -> 16 (the envelope top)
+    assert sched.audit_every == 16
+    _tick(sched, ap)  # past the top with baseline 0: back OFF
+    assert sched.audit_every == 0
+
+
+def test_capacity_seeded_from_oom_history(tmp_path, monkeypatch):
+    """THE acceptance pin: a run whose bucket had to halve to survive
+    a real batch OOM journals the surviving capacity; the NEXT run
+    (sharing only the journal) seeds its bucket AT that capacity
+    instead of rediscovering it by halving — and every digest still
+    matches solo."""
+    journal = str(tmp_path / "decisions.jsonl")
+    jobs = _jobs(8, steps=10)
+    solo = _solo(jobs)
+    real_step = GridBatch.step
+
+    def step(self, budget):
+        if self.capacity > 4:
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: out of memory (injected)")
+        return real_step(self, budget)
+
+    monkeypatch.setattr(GridBatch, "step", step)
+    ap1 = Autopilot(quantum=4, clock=lambda: 0.0,
+                    decision_file=journal)
+    sched1, _ = _sched(tmp_path, jobs, ap1, quantum=4)
+    report = sched1.run()
+    assert all(r["status"] == "done" for r in report.values())
+    assert {n: r["digest"] for n, r in report.items()} == solo
+    kid = key_id(jobs[0].bucket_key())
+    assert ap1.capacity[kid] <= 4
+    assert any(r["rule"] == "capacity.learn" for r in ap1.decisions)
+
+    # run 2: no OOM injection, fresh scheduler + controller, SAME
+    # journal -> the bucket starts at the learned capacity
+    monkeypatch.setattr(GridBatch, "step", real_step)
+    jobs2 = _jobs(8, steps=10)
+    ap2 = Autopilot(quantum=4, clock=lambda: 0.0,
+                    decision_file=journal)
+    assert ap2.capacity[kid] <= 4  # recovered from the journal alone
+    pol2 = SLOPolicy(quantum=4, clock=lambda: 0.0)
+    sched2 = FleetScheduler(str(tmp_path / "work2"), jobs2,
+                            quantum=4, slo_policy=pol2, autopilot=ap2)
+    sched2._admit_pending()
+    caps = [b.capacity for bs in sched2.buckets.values() for b in bs]
+    assert caps and all(c <= 4 for c in caps)
+    assert any(r["rule"] == "capacity.seed" for r in ap2.decisions)
+    report2 = sched2.run()
+    assert {n: r["digest"] for n, r in report2.items()} == solo
+
+
+def test_shed_history_recorded(tmp_path):
+    """An SLO shed rebuild also lands in the capacity history."""
+    ap = Autopilot(quantum=4, clock=lambda: 0.0)
+    key = _jobs(1)[0].bucket_key()
+    ap.record_shed(key, 6)
+    ap.record_oom(key, 3)
+    ap.record_oom(key, 5)  # never grows the learned floor mid-run
+    assert ap.capacity[key_id(key)] == 3
+    events = [r["inputs"]["event"] for r in ap.decisions]
+    assert events == ["shed", "oom"]
+
+
+def test_seed_floor_never_strips_a_dmr_shadow(tmp_path):
+    """Capacity history learned from plain jobs must not disable a
+    redundancy=2 job's DMR replica: the seed floors at the largest
+    single job's slot demand."""
+    ap = Autopilot(quantum=4, clock=lambda: 0.0)
+    dmr = FleetJob("dmr0", length=(8, 8, 8), n_steps=8, seed=1,
+                   params=(0.03,), checkpoint_every=4, redundancy=2)
+    ap.capacity[key_id(dmr.bucket_key())] = 1  # history: plain jobs
+    sched, _pol = _sched(tmp_path, [dmr], ap, quantum=4)
+    sched._admit_pending()
+    (batch,) = [b for bs in sched.buckets.values() for b in bs]
+    assert batch.capacity >= 2
+    assert batch.shadow_of  # the shadow replica was admitted
+    (rec,) = [r for r in ap.decisions if r["rule"] == "capacity.seed"]
+    assert rec["after"] == 2 and rec["inputs"]["lo"] == 2
+
+
+def test_checkpoint_retune_uses_each_buckets_own_latency(tmp_path):
+    """A heterogeneous fleet: each job's step time comes from ITS
+    bucket's latency EWMA, not the slowest bucket's (which would
+    over-checkpoint every fast job ~latency-ratio-fold)."""
+    fast = FleetJob("fastj", length=(8, 8, 8), n_steps=400, seed=1,
+                    params=(0.03,), checkpoint_every=64)
+    slow = FleetJob("slowj", length=(12, 12, 12), n_steps=400, seed=2,
+                    params=(0.03,), checkpoint_every=64)
+    ap = Autopilot(quantum=4, clock=lambda: 0.0, adjust_every=1)
+    sched, pol = _sched(tmp_path, [fast, slow], ap, quantum=4)
+    sched._admit_pending()
+    telemetry.registry().reset()
+    telemetry.observe("dccrg_ckpt_save_seconds", 0.05,
+                      kind="keyframe")
+    pol.observe(fast.bucket_key(), 0.004)  # 0.001 s/step
+    pol.observe(slow.bucket_key(), 0.4)    # 0.1 s/step
+    for j in (fast, slow):
+        j.steps_done = 64
+        j.trips = [("nan", i) for i in range(8)]  # rate 0.125
+    _tick(sched, ap)
+    by_job = {r["knob"]: r["inputs"]["step_seconds"]
+              for r in ap.decisions
+              if r["rule"] == "checkpoint.retune"}
+    assert len(by_job) == 2
+    assert by_job["checkpoint_every[fastj]"] == pytest.approx(0.001)
+    assert by_job["checkpoint_every[slowj]"] == pytest.approx(0.1)
+    # Young with the SAME save cost and trip rate: the fast bucket
+    # affords a longer cadence (sqrt(2*50/.125)=28), the slow one a
+    # shorter (sqrt(2*0.5/.125)=3) — not one global answer
+    assert fast.checkpoint_every == 28
+    assert slow.checkpoint_every == 3
+
+
+def test_capacity_floor_recovers_after_clean_runs(tmp_path):
+    """The learned capacity is NOT a permanent ratchet: a seeded key
+    that survives a whole run without OOM/shed earns a journaled
+    capacity.probe doubling it back toward the configured default —
+    and the journal replay reconstructs the recovery sequence."""
+    journal = str(tmp_path / "j.jsonl")
+    ap = Autopilot(quantum=4, clock=lambda: 0.0,
+                   decision_file=journal)
+    key = _jobs(1)[0].bucket_key()
+    kid = key_id(key)
+    ap.record_oom(key, 4)
+    ap.end_of_run()  # the OOM run itself earns nothing
+    assert ap.capacity[kid] == 4
+    # (seeded at, recovered to): doubles per clean run, capped at
+    # the configured default — after which neither rule fires
+    for seeded, recovered in ((4, 8), (8, 16), (16, 16)):
+        assert ap.seed_capacity(key, 16) == seeded
+        ap.end_of_run()
+        assert ap.capacity[kid] == recovered
+    # a fresh controller replays learn AND probe records in order
+    ap2 = Autopilot(quantum=4, clock=lambda: 0.0,
+                    decision_file=journal)
+    assert ap2.capacity[kid] == 16
+    assert replay(read_journal(journal)) == []
+
+
+# -- the negative pin: off by default, bitwise untouched --------------
+
+def test_off_by_default_negative_pin(tmp_path):
+    """With ``DCCRG_AUTOPILOT`` unset: no controller exists, every
+    knob keeps its configured value through a full serving run (trips
+    included), results are bitwise the solo baselines, and no journal
+    or status file appears."""
+    jobs = _jobs(4)
+    solo = _solo(jobs)
+    plan = FaultPlan(seed=3)
+    plan.nan_poison("rho", step=7, job="a01")
+    sched, _pol = _sched(tmp_path, jobs, quantum=4, audit_every=2)
+    assert sched.autopilot is None
+    with plan:
+        report = sched.run()
+    assert all(r["status"] == "done" for r in report.values())
+    assert {n: r["digest"] for n, r in report.items()} == solo
+    assert report["a01"]["trips"] == 1
+    # knobs bitwise untouched through trips, saves and audits
+    assert sched.quantum == 4 and sched.audit_every == 2
+    assert all(j.checkpoint_every == 4 for j in jobs)
+    assert telemetry.registry().counter_total(
+        "dccrg_autopilot_decisions_total") == 0
+    leftovers = [f for f in os.listdir(tmp_path)
+                 if "decision" in f or "status" in f]
+    assert leftovers == []
+
+
+def test_autopilot_on_preserves_results(tmp_path, monkeypatch):
+    """The env-opt-in path: ``DCCRG_AUTOPILOT=1`` constructs the
+    controller inside the scheduler, the run self-tunes (decisions
+    journal), and every job's digest STILL matches its solo run —
+    tuning moves cadences, never bytes."""
+    journal = str(tmp_path / "decisions.jsonl")
+    status = str(tmp_path / "status.txt")
+    monkeypatch.setenv("DCCRG_AUTOPILOT", "1")
+    monkeypatch.setenv("DCCRG_DECISION_FILE", journal)
+    monkeypatch.setenv("DCCRG_STATUS_FILE", status)
+    jobs = _jobs(4, steps=24)
+    solo = _solo(jobs)
+    plan = FaultPlan(seed=5)
+    plan.nan_poison("rho", step=9, job="a02")
+    sched, _pol = _sched(tmp_path, jobs, quantum=4)
+    assert sched.autopilot is not None
+    with plan:
+        report = sched.run()
+    assert all(r["status"] == "done" for r in report.values())
+    assert {n: r["digest"] for n, r in report.items()} == solo
+    assert os.path.exists(status)
+    text = open(status).read()
+    assert "quantum=" in text and "suspects:" in text \
+        and "buckets:" in text
+    # whatever it decided is fully re-derivable from the journal
+    recs = read_journal(journal)
+    assert replay(recs) == []
+
+
+# -- the journal: explain + replay ------------------------------------
+
+def _synth_journal(tmp_path, n=6):
+    """A journal with real decisions, produced by the controller
+    itself (fake clock, hand-fed pressure)."""
+    journal = str(tmp_path / "j.jsonl")
+    jobs = _jobs(2, slo_ms=100.0)
+    sched, pol = _sched(tmp_path, jobs, None, quantum=16)
+    sched._admit_pending()
+    for j in jobs:
+        j.slo_t0 = 0.0
+    pol.observe(jobs[0].bucket_key(), 10.0)
+    sched.suspects[0] = 1
+    # the admission keyframes recorded REAL save timings: reset, then
+    # construct the controller (its observation baseline anchors
+    # here) and feed it a fixed history, so the journal is fully
+    # deterministic
+    telemetry.registry().reset()
+    ap = Autopilot(quantum=16, clock=lambda: 0.0,
+                   decision_file=journal)
+    sched.autopilot = ap
+    telemetry.observe("dccrg_ckpt_save_seconds", 0.05,
+                      kind="keyframe")
+    _tick(sched, ap, n)
+    ap.record_oom(jobs[0].bucket_key(), 4)
+    assert ap.seq >= 3
+    return journal, ap
+
+
+def test_journal_replay_equivalence_and_divergence(tmp_path):
+    """Replay re-derives every action from the recorded inputs; a
+    tampered record (or an unknown rule) is a detected divergence."""
+    journal, ap = _synth_journal(tmp_path)
+    recs = read_journal(journal)
+    assert len(recs) == ap.seq == len(ap.decisions)
+    assert replay(recs) == []
+    bad = [dict(r) for r in recs]
+    bad[0]["after"] = 999
+    div = replay(bad)
+    assert len(div) == 1 and "re-derived" in div[0][1]
+    bad[1]["rule"] = "quantum.noSuchRule"
+    assert len(replay(bad)) == 2
+
+
+def test_journal_is_deterministic(tmp_path):
+    """Two identical fake-clock runs journal identical decision
+    sequences (wall-clock anchors aside) — the controller has no
+    hidden nondeterministic input."""
+    strip = lambda rs: [  # noqa: E731
+        {k: v for k, v in r.items() if k != "ts"} for r in rs]
+    j1, _ = _synth_journal(tmp_path / "one")
+    j2, _ = _synth_journal(tmp_path / "two")
+    assert strip(read_journal(j1)) == strip(read_journal(j2))
+
+
+def test_explain_and_replay_cli(tmp_path, capsys):
+    journal, ap = _synth_journal(tmp_path)
+    assert ap_mod._main(["explain", journal]) == 0
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.startswith("[tick")]
+    assert len(lines) == ap.seq
+    assert any("quantum.shorten" in ln and "->" in ln
+               and "observed:" in ln and "expected:" in ln
+               for ln in lines)
+    assert ap_mod._main(["replay", journal]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out.splitlines()[-1])["divergences"] == 0
+    # tamper -> nonzero exit naming the diverged record
+    recs = read_journal(journal)
+    recs[-1]["after"] = -5
+    broken = str(tmp_path / "broken.jsonl")
+    with open(broken, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    assert ap_mod._main(["replay", broken]) == 1
+    assert "DIVERGED" in capsys.readouterr().out
+
+
+def test_decision_ring_bounded(tmp_path):
+    ap = Autopilot(quantum=4, clock=lambda: 0.0, ring=16,
+                   decision_file=None)
+    key = ("k",)
+    for i in range(50):
+        ap._learn_capacity(key, 50 - i, "oom")  # fires every time
+    assert ap.seq == 50 and len(ap.decisions) == 16
+    assert ap.decisions[-1]["seq"] == 49
+
+
+def test_explain_decision_names_everything():
+    rec = {"seq": 0, "tick": 3, "rank": 1, "rule": "audit.tighten",
+           "knob": "audit_every", "before": 8, "after": 4,
+           "inputs": {"new_suspects": 2}, "expected": "x"}
+    line = explain_decision(rec)
+    for frag in ("tick 3", "rank 1", "audit.tighten", "8 -> 4",
+                 "new_suspects=2", "expected: x"):
+        assert frag in line
+
+
+# -- controller-input metrics (useful with the autopilot off) ---------
+
+def test_save_rollback_audit_metrics_and_lane_gauges(tmp_path):
+    """The satellite pin: save-cost/rollback-cost/audit-cost
+    histograms and per-lane suspect gauges are recorded by a plain
+    (autopilot-off) fleet run with a trip, an audit cadence and a
+    silent flip."""
+    jobs = _jobs(4, steps=16)
+    plan = FaultPlan(seed=11)
+    plan.nan_poison("rho", step=6, job="a01")
+    plan.silent_flip("rho", step=10, job="a03")
+    sched, _pol = _sched(tmp_path, jobs, quantum=4, audit_every=2)
+    with plan:
+        report = sched.run()
+    assert all(r["status"] == "done" for r in report.values())
+    reg = telemetry.registry()
+    h = reg.histogram("dccrg_ckpt_save_seconds", kind="keyframe")
+    assert h is not None and h.total > 0 and h.sum_seconds > 0
+    assert reg.histogram("dccrg_rollback_seconds").total >= 2
+    assert reg.histogram("dccrg_audit_seconds").total >= 1
+    assert reg.gauges[("dccrg_lane_suspects",
+                       (("lane", "0"),))] >= 1.0
+    assert ("dccrg_lane_quarantined",
+            (("lane", "0"),)) in reg.gauges
+
+
+def test_telemetry_summary_covers_histograms(tmp_path, capsys):
+    """The satellite pin: ``python -m dccrg_tpu.telemetry summary``
+    over a metrics file prints per-histogram p50/p99 — the same
+    numbers the controller acts on — parsed back from the Prometheus
+    exposition."""
+    for v in (0.002, 0.004, 0.008, 0.3):
+        telemetry.observe("dccrg_ckpt_save_seconds", v,
+                          kind="keyframe")
+    telemetry.observe("dccrg_fleet_quantum_seconds", 0.05, job="a")
+    live = telemetry.histogram_stats()
+    path = str(tmp_path / "metrics.prom")
+    assert telemetry.export_metrics(path)
+    hists = telemetry.parse_prometheus_histograms(open(path).read())
+    offline = telemetry.histogram_stats(hists)
+    key = 'dccrg_ckpt_save_seconds{kind="keyframe"}'
+    assert key in offline
+    assert offline[key]["count"] == 4
+    assert offline[key]["p50_s"] == pytest.approx(live[key]["p50_s"])
+    assert offline[key]["p99_s"] == pytest.approx(live[key]["p99_s"])
+    assert telemetry._main(["summary", path]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["histograms"][key]["p99_s"] == pytest.approx(
+        live[key]["p99_s"])
+    assert 'dccrg_fleet_quantum_seconds{job="a"}' in out["histograms"]
+
+
+def test_controller_baselines_preexisting_registry_history(tmp_path):
+    """The registry outlives schedulers: a controller constructed
+    after an earlier run's trips/saves must NOT inherit them as a
+    phantom first-tick observation (no spurious quantum.shorten, no
+    foreign save costs — and emergency saves never price the
+    periodic cadence)."""
+    sched, _pol = _sched(tmp_path, _jobs(2), None, quantum=8)
+    sched._admit_pending()
+    # foreign history lands BEFORE the controller exists...
+    telemetry.inc("dccrg_fleet_trips_total", 50, job="old")
+    telemetry.observe("dccrg_ckpt_save_seconds", 100.0,
+                      kind="keyframe")
+    ap = Autopilot(quantum=8, clock=lambda: 0.0)  # ...baseline here
+    sched.autopilot = ap
+    telemetry.observe("dccrg_ckpt_save_seconds", 0.25,
+                      kind="delta")
+    telemetry.observe("dccrg_ckpt_save_seconds", 9.0,
+                      kind="emergency")  # excluded from the mean
+    inp = ap.tick(sched)
+    assert inp["trip_rate"] == 0.0  # the 50 old trips never count
+    assert inp["save_cost_s"] == pytest.approx(0.25)
+    assert not any(r["rule"] == "quantum.shorten"
+                   for r in ap.decisions)
+
+
+def test_injected_autopilot_never_stomps_configured_knobs(tmp_path):
+    """The scheduler's live knobs are the source of truth: an
+    injected controller whose constructor defaults differ writes
+    nothing back unless a rule fires (every knob move is a journaled
+    decision — the module's headline contract)."""
+    ap = Autopilot(clock=lambda: 0.0)  # defaults: quantum=8, audit=0
+    sched, pol = _sched(tmp_path, _jobs(2), ap, quantum=4,
+                        audit_every=6)
+    sched._admit_pending()
+    _tick(sched, ap, 3)  # no pressure, no latency data: no rules
+    assert ap.seq == 0
+    assert sched.quantum == 4 and sched.audit_every == 6
+    assert pol.quantum == 4
+
+
+def test_skipped_audit_not_counted_as_performed(tmp_path,
+                                                monkeypatch):
+    """An audit window with no comparable re-execution path (bulk
+    bucket, no spare slot) must not report a performed audit."""
+    jobs = _jobs(2, steps=8)
+    sched, _pol = _sched(tmp_path, jobs, quantum=4, audit_every=1)
+    sched._admit_pending()
+    monkeypatch.setattr(FleetScheduler, "_audit_digests",
+                        lambda self, *a: None)
+    report = sched.run()
+    assert all(r["status"] == "done" for r in report.values())
+    assert sched.audits == 0
+    assert telemetry.registry().counter_total(
+        "dccrg_audits_total") == 0
+    assert telemetry.registry().histogram(
+        "dccrg_audit_seconds") is None
+
+
+def test_summary_sums_per_rank_metrics_files(tmp_path, capsys):
+    """Per-rank metrics files of one run SUM per series (a plain
+    dict merge would keep only the last rank)."""
+    paths = []
+    tricky = "a\\nb"  # literal backslash then 'n': the escape-order trap
+    for rank, vals in enumerate([(0.002, 0.004), (0.004, 0.3)]):
+        telemetry.registry().reset()
+        for v in vals:
+            telemetry.observe("dccrg_step_seconds", v)
+            telemetry.observe("dccrg_fleet_quantum_seconds", v,
+                              job=tricky)
+        p = str(tmp_path / f"metrics_r{rank}.prom")
+        assert telemetry.export_metrics(p)
+        paths.append(p)
+    telemetry.registry().reset()
+    assert telemetry._main(["summary", *paths]) == 0
+    out = json.loads(capsys.readouterr().out)
+    h = out["histograms"]["dccrg_step_seconds"]
+    assert h["count"] == 4
+    assert h["sum_s"] == pytest.approx(0.31)
+    # the merged p99 sees rank 1's tail, not just the last file
+    assert h["p99_s"] >= 0.3
+    # a label holding backslash-then-n round-trips the exposition
+    # escaping exactly, so both ranks' series merged under ONE key
+    (tricky_key,) = [k for k in out["histograms"]
+                     if k.startswith("dccrg_fleet_quantum_seconds")]
+    assert out["histograms"][tricky_key]["count"] == 4
+
+
+def test_bench_trend_flags_regressions(tmp_path):
+    """The satellite pin: bench/trend.py merges the per-round JSONs
+    into one metric-keyed trajectory and flags >10% regressions vs
+    the best prior round (direction-aware)."""
+    rows = [
+        (1, {"grid_path_updates_per_sec": 100.0, "l2_error": 1e-4,
+             "parity_l2_error": 0.0, "legacy_per_sec": 100.0}),
+        (2, {"grid_path_updates_per_sec": 120.0, "l2_error": 1e-4,
+             "parity_l2_error": 0.0, "legacy_per_sec": 50.0}),
+        (3, {"grid_path_updates_per_sec": 90.0, "l2_error": 2e-4,
+             "parity_l2_error": 1e-3}),
+    ]
+    files = []
+    for n, parsed in rows:
+        p = str(tmp_path / f"BENCH_r{n:02d}.json")
+        with open(p, "w") as f:
+            json.dump({"n": n, "parsed": parsed}, f)
+        files.append(p)
+    script = os.path.join(os.path.dirname(__file__), "..", "bench",
+                          "trend.py")
+    out = subprocess.run(
+        [sys.executable, script, *files, "--json"],
+        capture_output=True, text=True)
+    assert out.returncode == 0
+    d = json.loads(out.stdout)
+    flagged = {r["metric"] for r in d["regressions"]}
+    # 90 is -25% vs best prior 120; 2e-4 doubles the error; and a
+    # regression FROM a perfect 0.0 baseline (a bitwise-parity
+    # metric going nonzero) flags even though no ratio exists —
+    # while legacy_per_sec, regressed in r02 but ABSENT from the
+    # newest round (a removed bench leg), never flags stale
+    assert flagged == {"grid_path_updates_per_sec", "l2_error",
+                       "parity_l2_error"}
+    # within-noise rounds do not flag, and --strict gates CI
+    assert subprocess.run(
+        [sys.executable, script, *files[:2], "--json"],
+        capture_output=True, text=True).returncode == 0
+    assert subprocess.run(
+        [sys.executable, script, *files, "--strict"],
+        capture_output=True, text=True).returncode == 1
