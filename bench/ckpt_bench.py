@@ -13,11 +13,23 @@ direct ``resilience.save_checkpoint``) and ``delta``
 compared bitwise against a direct full save — the bench doubles as an
 end-to-end integrity check.
 
+``--overlap`` runs the async-save leg instead: the same periodic-save
+loop with the next quantum's dispatch between save and drain,
+measuring how much of each save's wall the serving loop actually
+loses — synchronously (the whole save call) vs ``DCCRG_ASYNC_SAVE=1``
+(the snapshot+submit call plus the residual drain after the quantum).
+The saved files are asserted bitwise identical between the two legs
+(the negative pin and the async parity pin in one comparison).
+Acceptance: >= 70% of the save wall overlapped with the next
+quantum's dispatch.
+
 Run:  timeout -k 10 600 python bench/ckpt_bench.py [--n 32] [--saves 8]
 
 JSON rows go to stdout like the other bench emitters; the summary row
 carries the bytes-per-save table PERF.md quotes (acceptance: the
-delta rows >= 10x fewer bytes than the full rows).
+delta rows >= 10x fewer bytes than the full rows). The --overlap
+summary's ``ckpt_stall_sync_seconds``/``ckpt_stall_async_seconds``
+keys follow bench/trend.py's lower-is-better naming.
 """
 
 import argparse
@@ -118,6 +130,120 @@ def run_mode(mode, n, saves, keyframe_every, workdir):
     return rows
 
 
+# ---------------------------------------------------------------------
+# the --overlap leg: save wall overlapped with the next quantum
+# ---------------------------------------------------------------------
+
+def _sha(path):
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _async_write_wall_total():
+    from dccrg_tpu import telemetry
+
+    tot = 0.0
+    for (nm, _lab), h in telemetry.registry().histograms.items():
+        if nm == "dccrg_ckpt_async_write_seconds":
+            tot += h.sum_seconds
+    return tot
+
+
+def run_overlap(n, saves, quantum_steps, workdir):
+    """Periodic keyframe saves with the next quantum's dispatch
+    between save and drain: the serving loop's actual per-save stall,
+    synchronous vs DCCRG_ASYNC_SAVE=1, files bitwise identical. The
+    async write's TRUE wall is measured on the writer thread
+    (``dccrg_ckpt_async_write_seconds``), so the overlap fraction is
+    (write wall not spent blocking the caller) / save wall — a short
+    write under a long dispatch reads as a short save fully
+    overlapped, not as a long one."""
+    from dccrg_tpu import supervise
+
+    def leg(async_on):
+        os.environ["DCCRG_ASYNC_SAVE"] = "1" if async_on else "0"
+        d = os.path.join(workdir, "async" if async_on else "sync")
+        g = _mk_grid(n)
+        g.run_steps(_kernel, ["rho"], ["rho"], quantum_steps)  # warm
+        jax.block_until_ready(g.data["rho"])
+        store = supervise.CheckpointStore(d, stem="ov")
+        rows = []
+        for i in range(saves):
+            w0 = _async_write_wall_total()
+            t0 = time.perf_counter()
+            store.save(g, i, force_keyframe=True)
+            submit = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            g.run_steps(_kernel, ["rho"], ["rho"], quantum_steps)
+            jax.block_until_ready(g.data["rho"])
+            dispatch = time.perf_counter() - t1
+            t2 = time.perf_counter()
+            store.drain()
+            residual = time.perf_counter() - t2
+            # the save's wall: the blocking submit (snapshot/pull)
+            # plus the write's wall as measured ON the writer thread
+            # (sync mode: the save call is the whole wall)
+            write_wall = (_async_write_wall_total() - w0 if async_on
+                          else 0.0)
+            save_wall = submit + write_wall if async_on else submit
+            # the write ran concurrently with dispatch except for the
+            # tail the caller had to block for (the residual drain)
+            overlapped = max(0.0, write_wall - residual)
+            rows.append({"save_call_s": submit, "dispatch_s": dispatch,
+                         "drain_s": residual,
+                         "stall_s": submit + residual,
+                         "write_wall_s": write_wall,
+                         "save_wall_s": save_wall,
+                         "overlapped_s": overlapped if async_on else 0.0})
+        digests = {os.path.basename(p): _sha(p) for _s, p in store.list()}
+        return rows, digests
+
+    sync_rows, sync_digests = leg(False)
+    async_rows, async_digests = leg(True)
+    os.environ.pop("DCCRG_ASYNC_SAVE", None)
+    assert sync_digests == async_digests, \
+        "DCCRG_ASYNC_SAVE=1 checkpoints differ bitwise from sync saves"
+    mean = lambda rs, k: sum(r[k] for r in rs) / max(1, len(rs))  # noqa: E731
+    wall_sync = mean(sync_rows, "stall_s")
+    stall_async = mean(async_rows, "stall_s")
+    # the acceptance metric: what fraction of the async save's wall
+    # (blocking submit + the write's true writer-thread wall) ran
+    # CONCURRENTLY with the next quantum's dispatch — i.e. everything
+    # except the submit and the residual drain tail. The separate
+    # stall-reduction ratio is the serving-loop payoff.
+    overlap_frac = (mean(async_rows, "overlapped_s")
+                    / max(mean(async_rows, "save_wall_s"), 1e-9))
+    summary = {
+        "cells": n ** 3, "saves": saves,
+        "quantum_steps": quantum_steps,
+        "ckpt_stall_sync_seconds": round(wall_sync, 4),
+        "ckpt_stall_async_seconds": round(stall_async, 4),
+        "async_submit_s_per_save": round(mean(async_rows,
+                                              "save_call_s"), 4),
+        "async_write_wall_s_per_save": round(mean(async_rows,
+                                                  "write_wall_s"), 4),
+        "async_residual_drain_s_per_save": round(mean(async_rows,
+                                                      "drain_s"), 4),
+        "dispatch_s_per_quantum": round(mean(async_rows,
+                                             "dispatch_s"), 4),
+        "save_wall_overlap_frac": round(overlap_frac, 3),
+        "stall_reduction_frac": round(
+            max(0.0, 1.0 - stall_async / max(wall_sync, 1e-9)), 3),
+        "files_bitwise_identical": True,
+    }
+    for r in sync_rows:
+        print(json.dumps(dict(r, mode="sync")), flush=True)
+    for r in async_rows:
+        print(json.dumps(dict(r, mode="async")), flush=True)
+    print(json.dumps({"overlap_summary": summary}), flush=True)
+    return summary
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=32,
@@ -125,6 +251,13 @@ def main():
     ap.add_argument("--saves", type=int, default=8,
                     help="periodic saves per mode")
     ap.add_argument("--keyframe-every", type=int, default=8)
+    ap.add_argument("--overlap", action="store_true",
+                    help="measure per-save serving stall sync vs "
+                         "DCCRG_ASYNC_SAVE=1 (files asserted bitwise "
+                         "identical)")
+    ap.add_argument("--quantum-steps", type=int, default=48,
+                    help="steps dispatched between an async save's "
+                         "submit and its drain (the overlap window)")
     args = ap.parse_args()
 
     # hang-proof backend probe before any jax work (like the other
@@ -135,6 +268,9 @@ def main():
 
     workdir = tempfile.mkdtemp(prefix="dccrg_ckpt_bench_")
     try:
+        if args.overlap:
+            return run_overlap(args.n, args.saves, args.quantum_steps,
+                               workdir)
         rows = []
         for mode in ("full", "delta"):
             rows += run_mode(mode, args.n, args.saves,

@@ -12,10 +12,16 @@ bounds, from nothing but recorded observations:
 
 - **quantum length** against measured SLO slack — long quanta
   amortize dispatch overhead, short quanta bound preemption/rollback
-  loss and tighten the watchdog/checkpoint poll cadence;
+  loss and tighten the watchdog/checkpoint poll cadence — with a
+  journal-driven cross-run warm start (``quantum.learn`` at a clean
+  drain, ``quantum.warm_start`` on the next run's first tick: the
+  capacity.learn/probe discipline applied to the QUANTUM knob);
 - **per-stem checkpoint cadence** from measured save cost x observed
   trip rate (Young's first-order optimum,
-  ``sqrt(2 * save_cost / trip_rate)`` in step units);
+  ``sqrt(2 * save_cost / trip_rate)`` in step units), extended by the
+  MEASURED per-trip recovery cost — the ``dccrg_rollback_seconds``
+  histogram feeds Daly's ``sqrt(2 * C * (M + R))`` ``R`` term, so
+  replay is no longer priced via save cost alone;
 - **audit cadence** up while a device lane's suspect counter is warm
   and back down to the configured baseline after a clean streak;
 - **initial bucket capacity** seeded from the recorded OOM/shed
@@ -154,9 +160,14 @@ def _rule_quantum_lengthen(before, inp):
 
 
 def _rule_ckpt_retune(before, inp):
-    """Young's first-order optimal checkpoint interval from measured
-    save cost x observed trip rate, in step units:
-    ``sqrt(2 * (save_cost_s / step_seconds) / trip_rate)``. A
+    """Young/Daly first-order optimal checkpoint interval from
+    measured save cost x observed trip rate, in step units. With the
+    measured per-trip recovery cost (``rollback_s`` — the chain-aware
+    checkpoint load the ``dccrg_rollback_seconds`` histogram times)
+    the optimum is Daly's ``sqrt(2 * C * (M + R))`` with ``C =
+    save_cost_s/step_seconds``, ``M = 1/trip_rate`` and ``R =
+    rollback_s/step_seconds``; without it (no rollback observed yet)
+    it degrades to Young's ``sqrt(2 * C / trip_rate)`` exactly. A
     trip-free history pushes the cadence to the upper bound (saves
     cost, trips don't); a deadband suppresses churn."""
     sc = inp.get("save_cost_s")
@@ -167,7 +178,11 @@ def _rule_ckpt_retune(before, inp):
     if rate <= 0.0:
         opt = float(inp.get("hi", 256))
     else:
-        opt = math.sqrt(2.0 * (sc / st) / rate)
+        mtbf_steps = 1.0 / rate
+        rb = inp.get("rollback_s")
+        if rb is not None and rb > 0.0:
+            mtbf_steps += rb / st
+        opt = math.sqrt(2.0 * (sc / st) * mtbf_steps)
     new = max(int(inp.get("lo", 1)),
               min(int(inp.get("hi", 256)), int(round(opt))))
     before = int(before)
@@ -230,6 +245,36 @@ def _rule_capacity_seed(before, inp):
     return new if new != int(before) else None
 
 
+def _rule_quantum_learn(before, inp):
+    """The run drained cleanly: journal the converged quantum as
+    cross-run memory (the ``capacity.learn`` discipline for the
+    QUANTUM knob — the journal record IS the memory,
+    ``load_history`` replays it). Fires only when the final value
+    differs from what the next run would otherwise start at (the
+    previously learned value, else the configured default)."""
+    final = inp.get("final_quantum")
+    if final is None:
+        return None
+    final = int(final)
+    base = before if before is not None else inp.get("configured")
+    if base is not None and int(base) == final:
+        return None
+    return final
+
+
+def _rule_quantum_warm_start(before, inp):
+    """A prior run journaled its converged quantum for this
+    scheduler: start there (clamped to the hard envelope) instead of
+    re-converging from the configured default — the ``capacity.seed``
+    mirror."""
+    learned = inp.get("learned_quantum")
+    if learned is None:
+        return None
+    new = max(int(inp.get("lo", 1)),
+              min(int(inp.get("hi", 64)), int(learned)))
+    return new if new != int(before) else None
+
+
 def _rule_capacity_probe(before, inp):
     """A run that completed with NO OOM/shed on a seeded bucket key:
     double the learned capacity back toward the configured default —
@@ -250,6 +295,8 @@ def _rule_capacity_probe(before, inp):
 RULES = {
     "quantum.shorten": _rule_quantum_shorten,
     "quantum.lengthen": _rule_quantum_lengthen,
+    "quantum.learn": _rule_quantum_learn,
+    "quantum.warm_start": _rule_quantum_warm_start,
     "checkpoint.retune": _rule_ckpt_retune,
     "audit.tighten": _rule_audit_tighten,
     "audit.relax": _rule_audit_relax,
@@ -264,6 +311,11 @@ EXPECTED = {
                         "loss and tighten the poll cadence"),
     "quantum.lengthen": ("longer quanta amortize per-dispatch "
                          "overhead across more steps"),
+    "quantum.learn": ("remember the converged quantum so the next "
+                      "run starts there instead of re-converging"),
+    "quantum.warm_start": ("start at the quantum a prior run "
+                           "converged to (journal-driven cross-run "
+                           "warm start)"),
     "checkpoint.retune": ("save cost x trip rate optimum (Young): "
                           "minimize save overhead + expected replay"),
     "audit.tighten": ("audit a warm-suspect fleet more often so a "
@@ -372,7 +424,14 @@ class Autopilot:
         self._last_trips = float(telemetry.registry().counter_total(
             "dccrg_fleet_trips_total"))
         self._save_cost_base = self._save_cost_totals()
+        self._rollback_base = self._rollback_totals()
         self._last_suspects = 0
+        # journal-driven cross-run warm start of the QUANTUM knob
+        # (the capacity.learn/probe discipline): load_history recovers
+        # the last run's journaled quantum.learn, the first tick
+        # applies it through the quantum.warm_start rule
+        self.learned_quantum = None
+        self._warmed = False
         self._trip_rate = 0.0
         self._clean = 0
         self._q_short = 0
@@ -388,14 +447,21 @@ class Autopilot:
 
     def load_history(self, path: str) -> int:
         """Recover the persistent half of the controller state — the
-        per-bucket-key learned capacities — from a prior run's
-        journal, replaying the ``capacity.learn``/``capacity.probe``
+        per-bucket-key learned capacities and the learned QUANTUM —
+        from a prior run's journal, replaying the
+        ``capacity.learn``/``capacity.probe``/``quantum.learn``
         records in order (shrinks AND clean-run recoveries both
         apply — the history is not a one-way ratchet). Returns how
         many records informed it. Missing/unreadable files are
         simply no history."""
         n = 0
         for rec in read_journal(path):
+            after = rec.get("after")
+            if rec.get("rule") == "quantum.learn":
+                if isinstance(after, int) and after >= 1:
+                    self.learned_quantum = after
+                    n += 1
+                continue
             if rec.get("rule") not in ("capacity.learn",
                                        "capacity.probe"):
                 continue
@@ -403,7 +469,6 @@ class Autopilot:
             if not (knob.startswith("capacity[") and knob.endswith("]")):
                 continue
             kid = knob[len("capacity["):-1]
-            after = rec.get("after")
             if not isinstance(after, int) or after < 1:
                 continue
             self.capacity[kid] = after
@@ -472,6 +537,29 @@ class Autopilot:
         n -= self._save_cost_base[1]
         return (tot / n) if n > 0 else None
 
+    @staticmethod
+    def _rollback_totals():
+        """``(sum_seconds, count)`` over every ``dccrg_rollback_
+        seconds`` series (the runner's chain-aware checkpoint load and
+        the fleet's per-slot restore both observe it)."""
+        tot, n = 0.0, 0
+        for (nm, _lab), h in telemetry.registry().histograms.items():
+            if nm != "dccrg_rollback_seconds":
+                continue
+            tot += h.sum_seconds
+            n += h.total
+        return tot, n
+
+    def _rollback_cost_mean(self):
+        """Mean measured per-trip recovery cost since construction,
+        or None before the first observed rollback — the
+        ``checkpoint.retune`` rule's Daly ``R`` term (replay was
+        previously priced via save cost only)."""
+        tot, n = self._rollback_totals()
+        tot -= self._rollback_base[0]
+        n -= self._rollback_base[1]
+        return (tot / n) if n > 0 else None
+
     def gather(self, sched) -> dict:
         """One tick's controller inputs, computed from the scheduler's
         state and the telemetry registry. Every value is a JSON
@@ -507,6 +595,7 @@ class Autopilot:
                                   else round(float(lat), 9)),
             "trip_rate": round(float(self._trip_rate), 9),
             "save_cost_s": self._save_cost_mean(),
+            "rollback_s": self._rollback_cost_mean(),
             "new_suspects": new_susp,
             "suspects_total": suspects,
             "clean_streak": self._clean,
@@ -524,6 +613,12 @@ class Autopilot:
         (the tests' window into the observation path)."""
         self._tick = int(sched.ticks)
         inp = self.gather(sched)
+        if not self._warmed:
+            # journal-driven cross-run warm start: applied once, at
+            # the first control pass, through a journaled rule like
+            # every other knob move (no-op without recovered history)
+            self._warmed = True
+            self._warm_start_quantum(sched)
         self._tune_quantum(sched, inp)
         self._tune_audit(sched, inp)
         if self._tick % self.adjust_every == 0:
@@ -534,6 +629,18 @@ class Autopilot:
         if self._tick % self.status_every == 0:
             self.write_status(sched, inp)
         return inp
+
+    def _warm_start_quantum(self, sched) -> None:
+        before = max(1, int(sched.quantum))
+        lo, hi = self.bounds["quantum"]
+        q = self._apply(
+            "quantum.warm_start", "quantum", before,
+            {"learned_quantum": self.learned_quantum, "lo": lo,
+             "hi": hi, "configured": self.quantum0})
+        if q != before:
+            self.quantum = q
+            sched.quantum = q
+            sched.slo.quantum = q
 
     def _tune_quantum(self, sched, inp) -> None:
         # the scheduler's live value is the source of truth: the
@@ -667,6 +774,18 @@ class Autopilot:
                 self.capacity[kid] = int(after)
         self._seeded.clear()
         self._shrunk.clear()
+        # cross-run QUANTUM memory: journal the converged value when
+        # it differs from what the next run would start at (the
+        # previously learned value, else the configured default) —
+        # a fresh controller sharing only the journal warm-starts
+        # there (pinned by tests/test_autopilot.py)
+        before_q = self.learned_quantum
+        after_q = self._apply(
+            "quantum.learn", "quantum.learned", before_q,
+            {"final_quantum": int(self.quantum),
+             "configured": self.quantum0})
+        if after_q != before_q and after_q is not None:
+            self.learned_quantum = int(after_q)
 
     # -- status snapshot ----------------------------------------------
 

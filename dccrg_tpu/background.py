@@ -1,0 +1,263 @@
+"""Zero-stall serving primitives: background work that used to block
+the step loop.
+
+Two multi-second host-side pauses sit directly on the serving path:
+
+- an AMR recommit epoch (the structure-plan rebuild after
+  ``stop_refining``) blocks the step loop for its whole build — 9-12 s
+  at 192^3 even on the native fast path — although the *build* is pure
+  host/ctypes work that releases the GIL and depends only on the new
+  (cells, owner) structure, never on the field bytes the loop keeps
+  advancing;
+- a periodic checkpoint save serializes the CRC + fsync + rename file
+  work against the next quantum's dispatch, although the payload
+  snapshot is a set of immutable jax array references the moment it is
+  taken.
+
+This module holds the two pieces of machinery that take both off the
+critical path, opt-in via environment flags and bitwise-neutral when
+off:
+
+:class:`PlanBuildWorker` (``DCCRG_BG_RECOMMIT=1``) builds the *next*
+structure epoch's plan on a worker thread against a third
+:class:`~dccrg_tpu.hybrid.PlanArena` generation (live plan and the
+transaction rollback snapshot stay protected) while stepping continues
+on the live plan; :meth:`Grid.run_steps
+<dccrg_tpu.grid.Grid.run_steps>` / ``GridBatch.step`` install the
+finished plan at the next step/quantum boundary (`grid.bg_install`),
+bitwise-identical to the synchronous build. Grown arena tables are
+prefaulted inside the worker, so the shape-transition cold-first-touch
+stall never hits the step loop either.
+
+:class:`AsyncSaver` (``DCCRG_ASYNC_SAVE=1``) runs a single-controller
+checkpoint write (temp stream + CRC sidecar + fsync + rename) on a
+writer thread against a :func:`freeze_grid` snapshot, overlapped with
+the next quantum's dispatch; :meth:`AsyncSaver.drain` is the barrier
+every store reader (rollback, resume, GC, emergency save) takes before
+trusting the directory. Multi-process saves stay synchronous: the
+two-phase commit's barriers belong to the rank's main thread.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import threading
+import time
+
+import numpy as np
+
+from . import telemetry
+
+
+def bg_recommit_enabled() -> bool:
+    """``DCCRG_BG_RECOMMIT=1``: defer AMR recommits to a background
+    plan-build worker, swapping at the next step/quantum boundary."""
+    return os.environ.get("DCCRG_BG_RECOMMIT") == "1"
+
+
+def async_save_enabled() -> bool:
+    """``DCCRG_ASYNC_SAVE=1``: overlap single-controller checkpoint
+    writes with the next quantum's dispatch."""
+    return os.environ.get("DCCRG_ASYNC_SAVE") == "1"
+
+
+# ---------------------------------------------------------------------
+# background plan builds
+# ---------------------------------------------------------------------
+
+class PlanBuildWorker:
+    """One in-flight background structure-plan build for a grid.
+
+    The worker runs ``grid._construct_plan`` — the pure build half of a
+    restructure, no install — on a daemon thread. The build reads only
+    structural inputs captured at submit time (the new cells/owners and
+    the dirty-set hint) plus the grid's build caches (sticky capacity
+    memo, hybrid stream-reuse cache, plan arena), none of which the
+    step loop touches; the arena itself is lock-protected because the
+    LIVE plan's lazy table thunks may take buffers concurrently. At
+    most one build is in flight per grid (submission is serialized by
+    the grid's drain discipline), so the caches see the same ordered
+    build sequence as the synchronous path and the resulting plan is
+    bitwise identical to it (pinned by tests/test_bgrecommit.py).
+
+    A worker crash (any exception, injected faults included) is
+    captured, not raised: the swap point falls back to the inline
+    rebuild."""
+
+    def __init__(self, grid, cells, owner, changed_hint):
+        self.cells = cells
+        self.owner = owner
+        self.changed_hint = changed_hint
+        self.plan = None
+        self.error = None
+        self.done = threading.Event()
+        self._grid = grid
+        self.thread = threading.Thread(
+            target=self._work, name="dccrg-bg-recommit", daemon=True)
+
+    def start(self) -> "PlanBuildWorker":
+        telemetry.inc("dccrg_recommit_bg_builds_total")
+        self.thread.start()
+        return self
+
+    def _work(self) -> None:
+        grid = self._grid
+        arena = getattr(grid, "_plan_arena", None)
+        t0 = time.perf_counter()
+        try:
+            # fresh arena allocations fault their pages INSIDE the
+            # worker (prefault=True touches them at take time even for
+            # sparsely-written tables), so a shape transition's
+            # cold-first-touch cost never lands on the step loop
+            if arena is not None:
+                arena.prefault = True
+            self.plan = grid._construct_plan(
+                self.cells, self.owner, self.changed_hint)
+            # derive the lazy post-swap tables (roll plans) here too,
+            # so the first dispatch after the swap pays nothing
+            grid._prewarm_plan(self.plan)
+        except BaseException as e:  # noqa: BLE001 - surfaced at swap
+            self.error = e
+            telemetry.inc("dccrg_recommit_bg_errors_total")
+        finally:
+            if arena is not None:
+                arena.prefault = False
+            telemetry.record_span("recommit.bg", time.perf_counter() - t0)
+            telemetry.observe("dccrg_recommit_bg_build_seconds",
+                              time.perf_counter() - t0)
+            self.done.set()
+
+    def ready(self) -> bool:
+        return self.done.is_set()
+
+    def wait(self, timeout=None) -> bool:
+        """Block until the build finishes; the blocked time is the
+        step loop's residual stall and lands in the stall histogram."""
+        if not self.done.is_set():
+            t0 = time.perf_counter()
+            self.done.wait(timeout)
+            telemetry.observe("dccrg_recommit_stall_seconds",
+                              time.perf_counter() - t0)
+        return self.done.is_set()
+
+
+# ---------------------------------------------------------------------
+# async checkpoint writes
+# ---------------------------------------------------------------------
+
+def freeze_grid(grid, fields=None):
+    """An immutable snapshot of ``grid`` for a background checkpoint
+    writer: a shallow copy whose field data is pulled to HOST numpy
+    arrays here, on the caller's thread — the device-side extraction
+    is the save's one synchronization point with dispatch, and once
+    extracted the payload bytes are immutable. The writer thread then
+    does pure numpy + file I/O and NEVER dispatches jax work: two
+    threads tracing/dispatching concurrently can deadlock the runtime
+    (observed on this jax: a background ``Array.__getitem__`` against
+    the main thread's ``block_until_ready``), and the device queue is
+    the serving path's, not the writer's. Plans are rebuilt wholesale
+    and mapping/topology/geometry/fields never change after
+    initialize, so everything else shallow-shares — the writer
+    serializes exactly the bytes a synchronous save at the submit
+    point would have (the bitwise pin in tests/test_bgrecommit.py).
+    ``fields`` restricts the pull to a save's sub-schema (the delta
+    path: a static field's bytes never cross for a dirty-field
+    save)."""
+    snap = copy.copy(grid)
+    names = sorted(grid.data) if fields is None else sorted(fields)
+    snap.data = {n: np.asarray(grid.data[n]) for n in names}
+    # the plan object itself is never edited in place, but a HYBRID
+    # plan's row_of_pos is an arena-held view: once the live grid
+    # restructures twice, the frozen plan is no longer in any
+    # arena.begin protect set and its buffers recycle under the
+    # writer — which would gather wrong rows into a checkpoint whose
+    # CRC sidecar (computed from the written bytes) still verifies.
+    # Pin the one layout array the save path reads through
+    # _host_rows with a private copy; cells/owner/n_local are plain
+    # per-epoch arrays and the rest of the layout is never touched
+    # by a save.
+    snap.plan = copy.copy(grid.plan)
+    snap.plan.row_of_pos = np.array(grid.plan.row_of_pos, copy=True)
+    dirty = getattr(grid, "_ckpt_dirty", None)
+    snap._ckpt_dirty = set(dirty) if isinstance(dirty, set) else dirty
+    # the snapshot must never alias live background machinery: a save
+    # of the frozen copy may not drain/install the real grid's builds
+    snap._bg_build = None
+    return snap
+
+
+class AsyncSaver:
+    """At most one checkpoint write in flight, with a drain barrier.
+
+    ``submit(fn)`` drains any previous write (surfacing its failure at
+    this save boundary — the async analogue of a synchronous save
+    raising in place), then runs ``fn`` on a fresh daemon thread under
+    a ``ckpt.async`` span. ``drain()`` joins the writer and re-raises
+    its exception after invoking the submitter's ``on_fail`` hook
+    (which un-publishes speculative bookkeeping: the rollback target /
+    delta parent must fall back to the last DURABLE checkpoint). The
+    time a drain actually blocks is the checkpoint stall that survived
+    overlapping and lands in ``dccrg_ckpt_stall_seconds``."""
+
+    def __init__(self):
+        self._thread = None
+        self._box = None
+
+    def submit(self, fn, on_fail=None, label="") -> None:
+        self.drain()
+        telemetry.inc("dccrg_ckpt_async_saves_total")
+        box = {"error": None, "label": label,
+               "on_fail": [on_fail] if on_fail is not None else []}
+
+        def work():
+            t0 = time.perf_counter()
+            try:
+                with telemetry.span("ckpt.async", tags={"path": label}):
+                    fn()
+            except BaseException as e:  # noqa: BLE001 - rethrown at drain
+                box["error"] = e
+            finally:
+                # the write's true wall, measured ON the writer — what
+                # the overlap benches compare dispatch windows against
+                telemetry.observe("dccrg_ckpt_async_write_seconds",
+                                  time.perf_counter() - t0)
+
+        t = threading.Thread(target=work, name="dccrg-async-save",
+                             daemon=True)
+        self._thread, self._box = t, box
+        t.start()
+
+    def add_on_fail(self, cb) -> None:
+        """Chain another failure hook onto the in-flight write (the
+        runner adds its rollback-target restore after the store
+        recorded its own parent/dirty reset). No-op when nothing is
+        pending."""
+        if self._box is not None:
+            self._box["on_fail"].append(cb)
+
+    def pending(self) -> bool:
+        return self._thread is not None
+
+    def drain(self) -> None:
+        """The store-reader barrier: returns only once no write is in
+        flight, re-raising a captured writer failure (after its
+        ``on_fail`` bookkeeping rollbacks ran, oldest first). Called
+        from the writer thread itself (post-save work chained onto the
+        same submission, e.g. retention GC) it is a no-op — that work
+        is already ordered after the write."""
+        t, box = self._thread, self._box
+        if t is None or t is threading.current_thread():
+            return
+        t0 = time.perf_counter()
+        t.join()
+        stall = time.perf_counter() - t0
+        if stall > 0:
+            telemetry.observe("dccrg_ckpt_stall_seconds", stall)
+        self._thread = self._box = None
+        err = box["error"]
+        if err is not None:
+            telemetry.inc("dccrg_ckpt_async_errors_total")
+            for cb in box["on_fail"]:
+                cb(err)
+            raise err

@@ -639,6 +639,12 @@ class GridBatch:
         returns the fused per-slot invariants (entry/exit
         fingerprints + conservation sums), published on
         :attr:`last_inv` as host arrays."""
+        # quantum boundaries are the fleet's step boundaries: a
+        # structure plan a background recommit finished for the scratch
+        # grid installs here, never mid-quantum (DCCRG_BG_RECOMMIT —
+        # the same swap discipline as Grid.run_steps)
+        if self.grid.bg_pending():
+            self.grid.bg_install()
         budget = np.asarray(budget, dtype=np.int32)
         q = int(budget.max()) if len(budget) else 0
         if q <= 0:

@@ -25,7 +25,9 @@ TPU-native equivalent of the reference's ``class Dccrg``
 
 from __future__ import annotations
 
+import logging
 import os
+import time
 from dataclasses import dataclass, field as dataclass_field
 from functools import partial
 
@@ -37,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .compat import shard_map as _shard_map
 
+from . import background
 from . import faults
 from . import telemetry
 from .geometry import CartesianGeometry, NoGeometry, StretchedCartesianGeometry
@@ -58,6 +61,8 @@ from .topology import GridTopology
 from .txn import grid_transaction
 from .types import ERROR_CELL
 from . import uniform as uniform_mod
+
+logger = logging.getLogger("dccrg_tpu.grid")
 
 # Parity with the reference's default neighborhood id (dccrg.hpp:99).
 DEFAULT_NEIGHBORHOOD_ID = -0xDCC
@@ -910,16 +915,27 @@ class Grid:
         ``changed_hint`` is ``(prev_cells, changed_ids)`` from a
         structure mutation that knows its own dirty set (see
         hybrid.build_hybrid_plan); only the hybrid path consumes it."""
-        # any rebuild invalidates a gather mode forced by the OOM
-        # fallback (resilience._apply_mode re-pins and re-marks it)
-        self._plan_gather_mode = None
-        self._build_plan_impl(cells, owner, changed_hint)
+        self._finish_plan(self._construct_plan(cells, owner, changed_hint))
+
+    def _construct_plan(self, cells: np.ndarray, owner: np.ndarray,
+                        changed_hint=None):
+        """Build a complete structure plan for ``(cells, owner)``
+        WITHOUT installing it — the pure half of a rebuild, safe to run
+        on a background worker thread while the step loop keeps
+        dispatching against the live plan (DCCRG_BG_RECOMMIT; see
+        dccrg_tpu.background.PlanBuildWorker). Reads only structural
+        inputs and the build caches (capacity memo, hybrid stream-reuse
+        cache, plan arena — never the field data), and builds are
+        serialized per grid, so the result is bitwise identical to the
+        synchronous path's."""
+        plan = self._build_plan_impl(cells, owner, changed_hint)
         # the builder's large temporaries are dead only once the impl
         # frame is gone; trim here so malloc_trim can actually return
         # the build's peak to the OS (the arena-held tables stay
         # resident — that is the point)
         if len(cells) > 1 << 20:
             _trim_allocator()
+        return plan
 
     def _build_plan_impl(self, cells: np.ndarray, owner: np.ndarray,
                          changed_hint=None):
@@ -939,15 +955,13 @@ class Grid:
         # the reference's uint64 ids have no such bound).
         n0 = self.mapping.length.total_level0_cells
         if uniform_mod.is_uniform(cells, n0) and n0 < 2**31 - 2:
-            self._build_plan_uniform(cells, owner)
-            return
+            return self._build_plan_uniform(cells, owner)
 
         # refined grids take the hybrid path (hybrid.py): closed-form
         # tables away from refinement, generic engine for the hard
         # subset near it — O(refinement surface), not O(grid)
         if n0 < 2**31 - 2 and os.environ.get("DCCRG_FORCE_GENERIC") != "1":
-            self._build_plan_hybrid(cells, owner, changed_hint)
-            return
+            return self._build_plan_hybrid(cells, owner, changed_hint)
 
         # per-hood neighbor lists (host), with neighbor positions in the
         # sorted cell array resolved once per hood (reused everywhere)
@@ -1036,7 +1050,7 @@ class Grid:
                 n_inner_arr if hid == DEFAULT_NEIGHBORHOOD_ID else None,
                 hood_gidx[hid], row_by_gidx, hid,
             )
-        self._finish_plan(plan)
+        return plan
 
     def _build_plan_uniform(self, cells: np.ndarray, owner: np.ndarray):
         """Closed-form plan construction for all-level-0 grids
@@ -1082,7 +1096,7 @@ class Grid:
                 # roll shifts + wrap fixups were computed arithmetically
                 hood._roll_plan = hd["roll_plan"]
             plan.hoods[hid] = hood
-        self._finish_plan(plan)
+        return plan
 
     def _build_plan_hybrid(self, cells: np.ndarray, owner: np.ndarray,
                            changed_hint=None):
@@ -1148,11 +1162,14 @@ class Grid:
                          if hid == DEFAULT_NEIGHBORHOOD_ID else None),
                 lists=lists_thunk,
             )
-        self._finish_plan(plan)
+        return plan
 
     def _finish_plan(self, plan: _Plan):
         plan.epoch = getattr(self, "plan", None).epoch + 1 if getattr(self, "plan", None) else 0
         self.plan = plan
+        # any rebuild invalidates a gather mode forced by the OOM
+        # fallback (resilience._apply_mode re-pins and re-marks it)
+        self._plan_gather_mode = None
         # compiled programs are shape-keyed and survive the epoch; the
         # per-epoch device tables live on the (replaced) hood plans
 
@@ -1415,6 +1432,16 @@ class Grid:
             return self.plan.owner.copy(), self.plan.row_of_pos.astype(np.int64)
         pos = np.searchsorted(self.plan.cells, ids)
         if np.any(pos >= len(self.plan.cells)) or np.any(self.plan.cells[np.minimum(pos, len(self.plan.cells)-1)] != ids):
+            if getattr(self, "_bg_build", None) is not None:
+                # a deferred recommit (DCCRG_BG_RECOMMIT) may hold the
+                # epoch these ids belong to — the adapt-then-project
+                # pattern reads/writes new children right after
+                # stop_refining. A data access that NEEDS the new
+                # epoch IS a boundary: install (blocking) and retry,
+                # so apps stay oblivious while accesses the live epoch
+                # can serve keep costing nothing.
+                self.bg_install(wait=True)
+                return self._host_rows(ids)
             raise KeyError("unknown cell id(s)")
         dev = self.plan.owner[pos]
         rows = self.plan.row_of_pos[pos].astype(np.int64)
@@ -3019,6 +3046,12 @@ class Grid:
     ) -> None:
         """Run ``n_steps`` fused exchange+stencil steps and install the
         results (see compile_step_loop)."""
+        # the background-recommit swap point: a FINISHED plan installs
+        # here, at a step boundary, before this dispatch compiles
+        # against the (then previous) epoch; an unfinished build keeps
+        # the loop on the live plan — zero stall (DCCRG_BG_RECOMMIT)
+        if getattr(self, "_bg_build", None) is not None:
+            self.bg_install()
         fields_in = tuple(fields_in)
         fields_out = tuple(fields_out)
         with telemetry.span("grid.step"):
@@ -3574,22 +3607,67 @@ class Grid:
             # dirty-set propagation into the hybrid recommit: the ids
             # that appear in exactly one of the pre/post cell lists
             self._pending_changed_cells = res.changed_cells
-            self._restructure(res.cells, res.owner)
+            self._restructure(res.cells, res.owner, defer_ok=True)
             return res.new_cells.copy()
 
-    def _restructure(self, new_cells, new_owner):
+    def _restructure(self, new_cells, new_owner, defer_ok=False):
         with telemetry.span("grid.recommit"):
-            return self._restructure_impl(new_cells, new_owner)
+            return self._restructure_impl(new_cells, new_owner,
+                                          defer_ok=defer_ok)
 
-    def _restructure_impl(self, new_cells, new_owner):
+    def _restructure_impl(self, new_cells, new_owner, defer_ok=False):
         """Rebuild the plan for a new cell set, carrying over the data
         of surviving cells (the reference's rebuild at
         dccrg.hpp:10642-10690, with data movement folded in).
+
+        With ``DCCRG_BG_RECOMMIT=1`` and ``defer_ok`` (the
+        ``stop_refining`` commit — a balance must land its staged data
+        on the new plan immediately, so it never defers), the plan
+        build runs on a background worker while stepping continues on
+        the live plan; :meth:`run_steps` (and ``GridBatch.step``)
+        installs the finished plan at the next step boundary via
+        :meth:`bg_install`. Until the swap, queries and checkpoints
+        reflect the previous (consistent) structure epoch.
 
         Data moves entirely on device: each surviving cell's (old dev,
         old row) -> (new dev, new row) relocation is ONE sharded gather
         per field (XLA inserts the cross-device collective), instead of
         pulling every field to host and re-uploading."""
+        # builds are serialized per grid: a still-pending background
+        # plan installs (or inline-rebuilds) before a new one starts
+        self.bg_install(wait=True)
+        old_plan = self.plan
+
+        # dirty-set hint for the hybrid recommit: stop_refining knows
+        # exactly which ids changed; an owner-only restructure (a
+        # repartition) changes none. The hint is keyed on the previous
+        # plan's cell array OBJECT so a stale hint can never alias a
+        # different epoch (hybrid.build_hybrid_plan verifies identity).
+        pending = getattr(self, "_pending_changed_cells", None)
+        self._pending_changed_cells = None
+        same_cells = (len(new_cells) == len(old_plan.cells)
+                      and np.array_equal(new_cells, old_plan.cells))
+        if same_cells:
+            changed_hint = (old_plan.cells, np.empty(0, dtype=np.uint64))
+        elif pending is not None:
+            changed_hint = (old_plan.cells, pending)
+        else:
+            changed_hint = None
+
+        if (defer_ok and background.bg_recommit_enabled()
+                and not self._multiproc):
+            self._bg_build = background.PlanBuildWorker(
+                self, new_cells, new_owner, changed_hint).start()
+            return
+
+        plan = self._construct_plan(new_cells, new_owner, changed_hint)
+        self._install_plan(plan, same_cells=same_cells)
+
+    def _install_plan(self, plan, same_cells=None):
+        """Install a constructed plan as the live structure epoch and
+        relocate the surviving cells' data — the impure half of a
+        restructure, always on the thread that owns the grid (the
+        step-boundary swap point for background builds)."""
         old_plan = self.plan
         old_R = old_plan.R
         # any restructure (cell-set change OR repartition) ends the
@@ -3600,8 +3678,10 @@ class Grid:
         # for checkpointing the whole payload is conservatively dirty)
         self._ckpt_epoch = getattr(self, "_ckpt_epoch", 0) + 1
         self._mark_ckpt_dirty()
-        same_cells = (len(new_cells) == len(old_plan.cells)
-                      and np.array_equal(new_cells, old_plan.cells))
+        new_cells = plan.cells
+        if same_cells is None:
+            same_cells = (len(new_cells) == len(old_plan.cells)
+                          and np.array_equal(new_cells, old_plan.cells))
         if not same_cells:
             # cell-set epoch: caches keyed on the cell SET (not the
             # partition) — e.g. the cut partitioner's edge arrays —
@@ -3611,21 +3691,7 @@ class Grid:
         old_dev, old_rows = self._host_rows(surviving)
         old_flat = old_dev.astype(np.int64) * old_R + old_rows
 
-        # dirty-set hint for the hybrid recommit: stop_refining knows
-        # exactly which ids changed; an owner-only restructure (a
-        # repartition) changes none. The hint is keyed on the previous
-        # plan's cell array OBJECT so a stale hint can never alias a
-        # different epoch (hybrid.build_hybrid_plan verifies identity).
-        pending = getattr(self, "_pending_changed_cells", None)
-        self._pending_changed_cells = None
-        if same_cells:
-            changed_hint = (old_plan.cells, np.empty(0, dtype=np.uint64))
-        elif pending is not None:
-            changed_hint = (old_plan.cells, pending)
-        else:
-            changed_hint = None
-
-        self._build_plan(new_cells, new_owner, changed_hint)
+        self._finish_plan(plan)
         faults.fire("grid.restructure", phase="planned")
         new_dev, new_rows = self._host_rows(surviving)
         new_flat = new_dev.astype(np.int64) * self.plan.R + new_rows
@@ -3683,6 +3749,78 @@ class Grid:
             from . import verify as _verify
 
             _verify.verify_user_data(self)
+
+    # -- background recommit (DCCRG_BG_RECOMMIT; see background.py) ----
+
+    def bg_pending(self) -> bool:
+        """True while a background plan build is in flight or awaiting
+        its step-boundary swap."""
+        return getattr(self, "_bg_build", None) is not None
+
+    def bg_install(self, wait: bool = False) -> bool:
+        """The step-boundary swap point: install the background-built
+        plan if one is finished (``wait=True`` blocks for it — the
+        residual stall lands in ``dccrg_recommit_stall_seconds``) and
+        relocate the surviving cells' data, exactly as the synchronous
+        restructure would have. A worker crash falls back to the
+        inline rebuild here. The install runs inside its own
+        transaction, so a failure mid-swap (injected faults included)
+        rolls back to the live pre-swap epoch and surfaces as
+        MutationAbortedError. Returns True when a plan was installed."""
+        bg = getattr(self, "_bg_build", None)
+        if bg is None:
+            return False
+        if not bg.ready() and not wait:
+            return False
+        bg.wait()
+        # consumed BEFORE the swap transaction: its entry barrier (and
+        # any nested mutation) must not re-enter this install
+        self._bg_build = None
+        t0 = time.perf_counter()
+        with telemetry.span("grid.recommit.swap"), \
+                grid_transaction(self, op="bg_recommit_swap"):
+            if bg.error is not None:
+                logger.warning(
+                    "background recommit worker failed (%s: %s); "
+                    "rebuilding inline", type(bg.error).__name__, bg.error)
+                plan = self._construct_plan(bg.cells, bg.owner,
+                                            bg.changed_hint)
+            else:
+                plan = bg.plan
+            self._install_plan(plan)
+        telemetry.observe("dccrg_recommit_stall_seconds",
+                          time.perf_counter() - t0, where="swap")
+        return True
+
+    def bg_discard(self) -> None:
+        """Drop a pending background build without installing it (the
+        transaction-rollback path: an aborted mutation must leave the
+        live plan AND the snapshot plan exactly as they were). Blocks
+        until the worker thread has actually stopped touching the
+        arena; the orphaned build generation's buffers are reclaimed
+        by the next build's ``arena.begin`` (it is never protected)."""
+        bg = getattr(self, "_bg_build", None)
+        if bg is None:
+            return
+        bg.done.wait()
+        self._bg_build = None
+
+    def _prewarm_plan(self, plan) -> None:
+        """Pre-materialize the lazily-derived per-hood tables the first
+        post-swap dispatch would otherwise compute on the step loop
+        (the roll-plan affine decomposition — an O(L*S) numpy pass),
+        with the same capacity function the compile path passes. Runs
+        on the background worker; best-effort (a failure here simply
+        re-surfaces at compile time)."""
+        try:
+            for hid, hood in plan.hoods.items():
+                if hood.closed_form is not None:
+                    hood.roll_plan(plan.L)
+                elif hood.offs_const is not None and self._use_roll_gather():
+                    hood.roll_plan(plan.L, cap=lambda n, hid=hid:
+                                   self._sticky_cap(("rollW", hid), n))
+        except Exception:  # noqa: BLE001 - prewarm must never kill a build
+            logger.debug("plan prewarm failed", exc_info=True)
 
     def get_removed_cells(self) -> np.ndarray:
         """Cells removed by the last stop_refining (dccrg.hpp:3519)."""

@@ -40,6 +40,7 @@ tables are built lazily on first use, as on the uniform fast path.
 from __future__ import annotations
 
 import os
+import threading
 import time
 import weakref
 
@@ -123,23 +124,34 @@ class PlanArena:
         self._pending = []   # buffers taken by the in-flight build
         self.hits = 0        # takes served from the pool
         self.misses = 0      # takes that allocated fresh pages
+        # a background build (DCCRG_BG_RECOMMIT) takes from the pool on
+        # its worker thread while the LIVE plan's lazy table thunks may
+        # take on the step loop's thread — the free lists need a lock.
+        # Builds themselves stay serialized (one in flight per grid).
+        self._lock = threading.RLock()
+        #: set by the background worker for its build's duration: fresh
+        #: allocations are page-touched at take time, so a grown
+        #: table's cold-first-touch faults land in the worker, never on
+        #: the step loop at swap (the shape-transition stall)
+        self.prefault = False
 
     def begin(self, protect=()):
         """Open a build: reclaim every unprotected generation."""
         protected = {id(p) for p in protect if p is not None}
-        survivors = []
-        for ref, bufs in self._owned:
-            plan = ref()
-            if plan is not None and id(plan) in protected:
-                survivors.append((ref, bufs))
-            else:
-                for b in bufs:
-                    self._free.setdefault(b.dtype.str, []).append(b)
-        self._owned = survivors
-        for b in self._pending:
-            self._free.setdefault(b.dtype.str, []).append(b)
-        pending = []
-        self._pending = pending
+        with self._lock:
+            survivors = []
+            for ref, bufs in self._owned:
+                plan = ref()
+                if plan is not None and id(plan) in protected:
+                    survivors.append((ref, bufs))
+                else:
+                    for b in bufs:
+                        self._free.setdefault(b.dtype.str, []).append(b)
+            self._owned = survivors
+            for b in self._pending:
+                self._free.setdefault(b.dtype.str, []).append(b)
+            pending = []
+            self._pending = pending
         # generation rotation is the arena's hot event: the swap count
         # plus pool-efficiency gauges make a cold (miss-heavy) epoch
         # visible in the same exposition as the recommit spans
@@ -155,24 +167,39 @@ class PlanArena:
         backing buffer on (defaults to the current build's)."""
         dtype = np.dtype(dtype)
         n = int(np.prod(shape, dtype=np.int64)) if shape else 1
-        pool = self._free.get(dtype.str, ())
-        best = None
-        for i, b in enumerate(pool):
-            if b.size >= n and (best is None or b.size < pool[best].size):
-                best = i
-        if best is not None:
-            buf = pool.pop(best)
-            self.hits += 1
-        else:
-            # geometric growth: the next power-of-two element count, so
-            # a drifting refined region re-allocates O(log) times ever
-            cap = max(1 << max(0, int(n - 1).bit_length()), 1)
-            buf = self._alloc(cap, dtype)
-            self.misses += 1
-        (self._pending if owner is None else owner).append(buf)
+        fresh = False
+        with self._lock:
+            pool = self._free.get(dtype.str, ())
+            best = None
+            for i, b in enumerate(pool):
+                if b.size >= n and (best is None or b.size < pool[best].size):
+                    best = i
+            if best is not None:
+                buf = pool.pop(best)
+                self.hits += 1
+            else:
+                # geometric growth: the next power-of-two element
+                # count, so a drifting refined region re-allocates
+                # O(log) times ever
+                cap = max(1 << max(0, int(n - 1).bit_length()), 1)
+                buf = self._alloc(cap, dtype)
+                self.misses += 1
+                fresh = True
+            (self._pending if owner is None else owner).append(buf)
         view = buf[:n].reshape(shape)
         if fill is not None:
             _fill_chunked(view, fill)
+        elif fresh and self.prefault and owner is None:
+            # background build of a GROWN table with no fill pass:
+            # touch one byte per page of the USED extent now, on the
+            # worker, so even a sparsely-written table never faults on
+            # the step loop. Gated on owner is None — only the build's
+            # own takes: a LIVE plan's lazy thunk materializing on the
+            # step loop mid-build (owner=its plan's list) must never
+            # pay a page-touch pass there, which is the exact stall
+            # the flag exists to remove.
+            flat = view.reshape(-1).view(np.uint8)
+            flat[::4096] = flat[::4096]
         return view
 
     @staticmethod
@@ -193,14 +220,17 @@ class PlanArena:
     def bind(self, plan):
         """Transfer the in-flight build's buffers to ``plan``; returns
         the ownership list so lazy thunks can keep appending to it."""
-        owned = self._pending
-        self._owned.append((weakref.ref(plan), owned))
-        self._pending = []
+        with self._lock:
+            owned = self._pending
+            self._owned.append((weakref.ref(plan), owned))
+            self._pending = []
         return owned
 
     def stats(self) -> dict:
-        pooled = sum(b.nbytes for bufs in self._free.values() for b in bufs)
-        owned = sum(b.nbytes for _r, bufs in self._owned for b in bufs)
+        with self._lock:
+            pooled = sum(b.nbytes for bufs in self._free.values()
+                         for b in bufs)
+            owned = sum(b.nbytes for _r, bufs in self._owned for b in bufs)
         return {"hits": self.hits, "misses": self.misses,
                 "free_bytes": int(pooled), "owned_bytes": int(owned)}
 

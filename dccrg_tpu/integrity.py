@@ -282,6 +282,17 @@ def grid_fingerprint(grid, fields=None) -> dict:
     for name in names:
         s1 = s2 = 0
         arr = grid.data[name]
+        if isinstance(arr, np.ndarray):
+            # a frozen host snapshot (background.freeze_grid): the
+            # async-save writer must never touch jax, and the pulled
+            # [n_dev, R, ...] array carries the same owned rows the
+            # shard walk below reads — bitwise the same fingerprint
+            for d in range(grid.n_dev):
+                a, b = fingerprint_rows(arr[d, : int(grid.plan.n_local[d])])
+                s1 = (s1 + a) & 0xFFFFFFFF
+                s2 = (s2 + b) & 0xFFFFFFFF
+            out[name] = (s1, s2)
+            continue
         shards = sorted(arr.addressable_shards,
                         key=lambda s: s.index[0].start or 0)
         for s in shards:

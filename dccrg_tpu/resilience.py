@@ -57,6 +57,7 @@ from dataclasses import dataclass, field as dataclass_field
 
 import numpy as np
 
+from . import background
 from . import checkpoint as checkpoint_mod
 from . import faults, telemetry
 
@@ -732,6 +733,27 @@ def save_delta_checkpoint(grid, filename: str, *, parent_path: str,
     a keyframe otherwise). Restore via the chain-aware
     :func:`load_checkpoint` / ``resume_latest``; the reconstruction is
     bitwise identical to an uninterrupted full save."""
+    extra = delta_sidecar_extra(parent_path, parent_step=parent_step,
+                                step=step, fields=fields,
+                                variable=variable)
+    return save_checkpoint(grid, filename, header=header,
+                           variable=variable, retries=retries,
+                           backoff=backoff, chunk_bytes=chunk_bytes,
+                           fields=extra["delta"]["fields"],
+                           sidecar_extra=extra)
+
+
+def delta_sidecar_extra(parent_path: str, *, parent_step: int, step: int,
+                        fields, variable=None) -> dict:
+    """The delta save's ``sidecar_extra`` record: the sorted dirty
+    field list plus the parent link ``{file, step, digest}`` (digest
+    derived from the parent's CURRENT sidecar, so a replaced parent is
+    detected at load). Split out of :func:`save_delta_checkpoint` so
+    the async-save path (``DCCRG_ASYNC_SAVE``) can resolve the link
+    synchronously — while the drained parent is provably durable —
+    before handing the write to the background thread. Raises
+    :class:`CheckpointCorruptionError` when the parent has no sidecar
+    (the caller falls back to a keyframe)."""
     fields = sorted(fields)
     var = variable or {}
     ragged = set(var) | set(var.values())
@@ -748,15 +770,11 @@ def save_delta_checkpoint(grid, filename: str, *, parent_path: str,
     digest = record_digest(parent_rec)
     if faults.take_delta_parent_corrupt():
         digest ^= 0x5A5A5A5A  # injected parent-link corruption
-    extra = {"delta": {
+    return {"delta": {
         "fields": fields, "step": int(step),
         "parent": {"file": os.path.basename(parent_path),
                    "step": int(parent_step),
                    "digest": int(digest)}}}
-    return save_checkpoint(grid, filename, header=header,
-                           variable=variable, retries=retries,
-                           backoff=backoff, chunk_bytes=chunk_bytes,
-                           fields=fields, sidecar_extra=extra)
 
 
 def _integrity_record(grid, fields, variable) -> dict:
@@ -1365,12 +1383,57 @@ class ResilientRunner:
         """Write the periodic checkpoint; returns the path written.
         The supervision layer's store-backed runner overrides this to
         route through :meth:`dccrg_tpu.supervise.CheckpointStore.save`
-        (numbered files, dirty-field delta saves)."""
+        (numbered files, dirty-field delta saves).
+
+        With ``DCCRG_ASYNC_SAVE=1`` (single-controller only: the
+        multi-process two-phase commit's barriers belong to the rank's
+        main thread) the write runs on a background thread against a
+        :func:`dccrg_tpu.background.freeze_grid` snapshot, overlapped
+        with the following steps' dispatch — bitwise identical bytes,
+        published atomically; :meth:`_drain_saves` is the barrier every
+        store reader (rollback, run end) takes first."""
+        if background.async_save_enabled() and not self.grid._multiproc:
+            saver = self._active_saver(create=True)
+            saver.drain()  # one in flight; an earlier failure raises here
+            frozen = background.freeze_grid(self.grid)
+            path = self.checkpoint_path
+            saver.submit(
+                lambda: save_checkpoint(frozen, path, header=self.header,
+                                        variable=self.variable),
+                label=path)
+            return path
         save_checkpoint(self.grid, self.checkpoint_path,
                         header=self.header, variable=self.variable)
         return self.checkpoint_path
 
+    def _active_saver(self, create: bool = False):
+        """The :class:`~dccrg_tpu.background.AsyncSaver` carrying this
+        runner's in-flight periodic write, or None. The store-backed
+        runner overrides this with its store's saver."""
+        if create and getattr(self, "_saver", None) is None:
+            self._saver = background.AsyncSaver()
+        return getattr(self, "_saver", None)
+
+    def _drain_saves(self, swallow: bool = False) -> None:
+        """Async-save barrier: block until no periodic write is in
+        flight. ``swallow=True`` (the rollback/emergency paths, where
+        resumability outranks the report) logs a writer failure
+        instead of raising — its ``on_fail`` hooks have already
+        re-pointed the rollback target at the last durable save."""
+        saver = self._active_saver()
+        if saver is None:
+            return
+        try:
+            saver.drain()
+        except Exception as e:  # noqa: BLE001 - policy filter below
+            if not swallow:
+                raise
+            logger.error("async checkpoint write failed (%s); the last "
+                         "durable checkpoint is the rollback target", e)
+
     def _save(self) -> None:
+        prev = (self.checkpoint_path, self._ckpt_step, self._last_save_t,
+                self._integrity_base)
         self.checkpoint_path = self._write_checkpoint()
         self._ckpt_step = self.step
         self._last_save_t = time.monotonic()
@@ -1381,6 +1444,17 @@ class ResilientRunner:
             # a corrupt verdict always rolls back to state whose
             # invariants were verified clean
             self._integrity_base = self._conservation_sums()
+        saver = self._active_saver()
+        if saver is not None and saver.pending():
+            # the bookkeeping above is speculative while the write is
+            # in flight: a writer failure reverts the rollback target
+            # to the last DURABLE checkpoint at the drain barrier
+            def _restore(_err, prev=prev):
+                (self.checkpoint_path, self._ckpt_step,
+                 self._last_save_t, self._integrity_base) = prev
+                self.checkpoints -= 1
+
+            saver.add_on_fail(_restore)
 
     def _integrity_on(self) -> bool:
         from . import integrity
@@ -1430,6 +1504,10 @@ class ResilientRunner:
         # chain surfaces as DeltaChainError — a corrupt rollback
         # target either way)
         t0 = time.perf_counter()
+        # drain barrier: never read a store an async write is still
+        # publishing into (a failed write re-points checkpoint_path at
+        # the last durable save before the load below)
+        self._drain_saves(swallow=True)
         with telemetry.span("runner.rollback"):
             load_checkpoint_into(self.grid, self.checkpoint_path,
                                  header_size=len(self.header),
@@ -1639,6 +1717,10 @@ class ResilientRunner:
                     continue
             if ckpt_due:
                 self._save()
+        # a write still in flight when the loop finishes must be
+        # durable before the caller reads the store (resume, digest
+        # comparisons); a failure surfaces here like a sync save's
+        self._drain_saves()
         return self
 
 

@@ -435,6 +435,11 @@ class FleetScheduler:
     def _purge_stem(self, store, job) -> None:
         """Delete every checkpoint (and sidecar) of ``job``'s stem —
         the ``resume=False`` contract is a from-scratch run."""
+        try:
+            store.drain()  # never unlink under an in-flight publish
+        except Exception as e:  # noqa: BLE001 - purging anyway
+            logger.warning("draining stem %s before purge failed (%s)",
+                           job.name, e)
         n = 0
         for _step, path in store.list():
             for p in (path, resilience.sidecar_path(path)):
@@ -451,6 +456,15 @@ class FleetScheduler:
         into the bucket's scratch grid (chain-aware; older entries are
         the fallback, mirroring ``resume_latest``). Returns the
         restored step or None."""
+        # drain barrier: never read a stem an async write is still
+        # publishing into. A failed write already re-pointed the chain
+        # state; the newest-first walk below IS the fallback.
+        try:
+            store.drain()
+        except Exception as e:  # noqa: BLE001 - the walk is the fallback
+            logger.error("async save of stem %s failed (%s); rolling "
+                         "back to its last durable checkpoint",
+                         job.name, e)
         for step, path in store.list():
             try:
                 resilience.load_checkpoint_into(batch.grid, path)
@@ -467,18 +481,36 @@ class FleetScheduler:
         with telemetry.tags(job=job.name):
             g = batch.write_grid(slot)
             store = self.store_for(job)
-            store.save(g, job.steps_done,
-                       dirty_fields=set(job.fields_out),
-                       force_keyframe=force_keyframe)
-            job.last_save_step = job.steps_done
-            try:
-                supervise.gc_checkpoints(
-                    self.dir, keep_last=self.keep_last,
-                    keep_every=self.keep_every, stem=job.name,
-                    apply=True, assume_ok=job.steps_done)
-            except OSError as e:  # GC must never kill the fleet
-                logger.warning("per-stem GC failed for %s (%s)",
-                               job.name, e)
+            steps = job.steps_done
+
+            def _gc():
+                # rides the save as its post hook: inline after a sync
+                # save, chained onto the writer thread after an async
+                # one (DCCRG_ASYNC_SAVE) — so the CRC+fsync+rename of a
+                # periodic save overlaps the next quantum's dispatch
+                # and GC still never races a publish
+                try:
+                    supervise.gc_checkpoints(
+                        self.dir, keep_last=self.keep_last,
+                        keep_every=self.keep_every, stem=job.name,
+                        apply=True, assume_ok=steps)
+                except OSError as e:  # GC must never kill the fleet
+                    logger.warning("per-stem GC failed for %s (%s)",
+                                   job.name, e)
+
+            prev_last = job.last_save_step
+            store.save(g, steps, dirty_fields=set(job.fields_out),
+                       force_keyframe=force_keyframe, post=_gc)
+            job.last_save_step = steps
+            if store.pending():
+                # speculative while the async write is in flight: a
+                # writer failure reverts the cadence baseline at the
+                # drain barrier (the ResilientRunner._save discipline),
+                # so the next save isn't delayed by a checkpoint that
+                # never published
+                store._saver.add_on_fail(
+                    lambda _e, job=job, prev=prev_last:
+                    setattr(job, "last_save_step", prev))
 
     # -- trips: per-slot isolation ------------------------------------
 
@@ -1120,9 +1152,25 @@ class FleetScheduler:
                         job.requeues += 1
                         self.add(job)
                         requeued.append(job.name)
+            # every emergency keyframe must be DURABLE before the
+            # resumable exit — the async writers get no grace after
+            # the raise (kill-mid-overlap smoke in ci_debug_leg.sh)
+            self._drain_stores(swallow=True)
         telemetry.inc("dccrg_fleet_preempts_total")
         supervise.clear_preempt()
         raise FleetPreemptedError(requeued)
+
+    def _drain_stores(self, swallow: bool = False) -> None:
+        """Async-save barrier over every stem this scheduler owns."""
+        for name, store in list(self._stores.items()):
+            try:
+                store.drain()
+            except Exception as e:  # noqa: BLE001 - policy filter below
+                if not swallow:
+                    raise
+                logger.error("async save of stem %s failed at drain "
+                             "(%s); its last durable checkpoint is the "
+                             "resume point", name, e)
 
     # -- the serving loop ---------------------------------------------
 
@@ -1182,4 +1230,8 @@ class FleetScheduler:
                 telemetry.maybe_export_metrics()
                 if max_ticks is not None and self.ticks >= int(max_ticks):
                     break
+        # a write still in flight when serving stops must be durable
+        # before the caller reads the report/stores (digest checks,
+        # resume over the same dir); failures surface like sync saves'
+        self._drain_stores()
         return self.report

@@ -79,6 +79,7 @@ import time
 from contextlib import contextmanager, nullcontext
 from dataclasses import dataclass, field as dataclass_field
 
+from . import background
 from . import checkpoint as checkpoint_mod
 from . import coord, faults, resilience, telemetry
 
@@ -654,7 +655,22 @@ class CheckpointStore:
         # the last save THIS process made: the next delta's parent
         # (path, step, grid structure epoch, chain length so far)
         self._parent = None
+        # async-save writer (DCCRG_ASYNC_SAVE): at most one write in
+        # flight per store; drain() is the barrier every reader takes
+        self._saver = background.AsyncSaver()
         os.makedirs(self.dir, exist_ok=True)
+
+    def drain(self) -> None:
+        """Async-save barrier: block until this stem's in-flight write
+        (if any) is durable, re-raising its failure (see
+        :class:`~dccrg_tpu.background.AsyncSaver`). Every reader of
+        the store — rollback, resume, retention GC, digest comparisons
+        — must pass through here first."""
+        self._saver.drain()
+
+    def pending(self) -> bool:
+        """True while an async write of this stem is in flight."""
+        return self._saver.pending()
 
     def path_for(self, step: int, delta: bool = False) -> str:
         ext = resilience.DELTA_SUFFIX if delta else ".dc"
@@ -690,7 +706,8 @@ class CheckpointStore:
         return sorted(dirty)
 
     def save(self, grid, step: int, header: bytes = b"", variable=None,
-             force_keyframe: bool = False, dirty_fields=None) -> str:
+             force_keyframe: bool = False, dirty_fields=None,
+             post=None) -> str:
         """Periodic save at ``step``: a dirty-field delta chained to
         this process's previous save when safe (see class docstring),
         else a full keyframe. Atomic either way (two-phase on
@@ -699,27 +716,82 @@ class CheckpointStore:
         ``dirty_fields`` overrides the grid's own dirty tracking — the
         fleet layer saves ONE batch slot through a shared scratch grid
         whose tracking reflects whatever slot passed through last, but
-        it knows exactly which fields its step program writes."""
+        it knows exactly which fields its step program writes.
+
+        With ``DCCRG_ASYNC_SAVE=1`` (single-controller grids) the
+        write runs on a background thread against a frozen snapshot,
+        overlapped with the next quantum's dispatch; the chain policy,
+        the parent link and the dirty re-baseline are all resolved
+        synchronously here, so the published bytes are bitwise
+        identical to a synchronous save's. ``post`` (the retention-GC
+        hook) runs after the write — on the writer thread when async,
+        inline otherwise — so GC never races a publish."""
+        # one write in flight per stem: an earlier failure surfaces at
+        # this save boundary (its on_fail already forced the next save
+        # to a keyframe and dropped the unpublishable parent link)
+        self.drain()
         fields = self._delta_fields(grid, variable, force_keyframe,
                                     dirty_override=dirty_fields)
+        if not (background.async_save_enabled() and not grid._multiproc):
+            if fields is not None:
+                path = self.path_for(step, delta=True)
+                try:
+                    resilience.save_delta_checkpoint(
+                        grid, path, parent_path=self._parent["path"],
+                        parent_step=self._parent["step"], step=step,
+                        fields=fields, header=header, variable=variable)
+                except resilience.CheckpointCorruptionError as e:
+                    # the parent's sidecar went bad under us (external
+                    # damage): save a keyframe, don't fail the run
+                    logger.warning(
+                        "delta save at step %d fell back to a keyframe "
+                        "(%s)", step, e)
+                    fields = None
+            if fields is None:
+                path = self.path_for(step)
+                resilience.save_checkpoint(grid, path, header=header,
+                                           variable=variable)
+            self._record_parent(grid, path, step, fields)
+            if post is not None:
+                post()
+            return path
+
+        # async: resolve the delta parent link NOW — the drain above
+        # made the parent durable — then hand the frozen snapshot to
+        # the writer thread
+        extra = None
         if fields is not None:
-            path = self.path_for(step, delta=True)
             try:
-                resilience.save_delta_checkpoint(
-                    grid, path, parent_path=self._parent["path"],
-                    parent_step=self._parent["step"], step=step,
-                    fields=fields, header=header, variable=variable)
+                extra = resilience.delta_sidecar_extra(
+                    self._parent["path"], parent_step=self._parent["step"],
+                    step=step, fields=fields, variable=variable)
             except resilience.CheckpointCorruptionError as e:
-                # the parent's sidecar went bad under us (external
-                # damage): save a keyframe instead of failing the run
-                logger.warning(
-                    "delta save at step %d fell back to a keyframe "
-                    "(%s)", step, e)
+                logger.warning("delta save at step %d fell back to a "
+                               "keyframe (%s)", step, e)
                 fields = None
-        if fields is None:
-            path = self.path_for(step)
-            resilience.save_checkpoint(grid, path, header=header,
-                                       variable=variable)
+        path = self.path_for(step, delta=fields is not None)
+        frozen = background.freeze_grid(grid, fields=fields)
+
+        def _write(path=path, fields=fields, extra=extra):
+            resilience.save_checkpoint(frozen, path, header=header,
+                                       variable=variable, fields=fields,
+                                       sidecar_extra=extra)
+            if post is not None:
+                post()
+
+        def _on_fail(_err):
+            # the write never published: nothing may chain to it, and
+            # the dirty set can no longer prove a proper delta subset
+            # relative to a durable parent — force the next save to a
+            # full keyframe
+            self._parent = None
+            grid._ckpt_dirty = None
+
+        self._saver.submit(_write, on_fail=_on_fail, label=path)
+        self._record_parent(grid, path, step, fields)
+        return path
+
+    def _record_parent(self, grid, path, step, fields) -> None:
         self._parent = {
             "path": path, "step": int(step),
             "epoch": getattr(grid, "_ckpt_epoch", 0),
@@ -729,7 +801,6 @@ class CheckpointStore:
         # re-baseline the dirty tracking: subsequent changes are
         # relative to THIS save (the next delta's parent)
         grid._ckpt_dirty = set()
-        return path
 
     def list(self) -> list:
         """``[(step, path)]``, newest first (keyframes and deltas)."""
@@ -737,6 +808,10 @@ class CheckpointStore:
 
     def gc(self, keep_last: int = 3, keep_every: int = 0,
            apply: bool = True, assume_ok: int | None = None) -> GCReport:
+        # drain barrier: GC must never race an in-flight publish (a
+        # no-op on the writer thread itself, where post-save GC is
+        # already ordered after the write)
+        self.drain()
         return gc_checkpoints(self.dir, keep_last=keep_last,
                               keep_every=keep_every, stem=self.stem,
                               apply=apply, assume_ok=assume_ok)
@@ -838,13 +913,17 @@ class _StoreRunner(resilience.ResilientRunner):
         super().__init__(grid, step_fn, sup.store.path_for(0), **kw)
 
     def _write_checkpoint(self):
-        return self._sup.store.save(self.grid, self.step,
-                                    header=self.header,
-                                    variable=self.variable)
+        # retention GC rides the save as its ``post`` hook: inline
+        # after a synchronous save (the pre-async behavior), chained
+        # onto the writer thread after an async one — either way GC
+        # only ever sees a fully published store
+        step = self.step
+        return self._sup.store.save(
+            self.grid, step, header=self.header, variable=self.variable,
+            post=lambda: self._sup._after_save(step))
 
-    def _save(self):
-        super()._save()
-        self._sup._after_save(self.step)
+    def _active_saver(self, create: bool = False):
+        return self._sup.store._saver
 
 
 class SupervisedRunner:
@@ -1074,6 +1153,12 @@ class SupervisedRunner:
         pull), the LAST PERIODIC checkpoint is the resume point: the
         grace window belongs to the exit, not to the save."""
         r = self._runner
+        # drain the periodic writer first: the emergency save itself
+        # stays SYNCHRONOUS (it is deadline-bounded and must be
+        # durable before the resumable exit), and a failed in-flight
+        # write re-points the fallback at the last durable checkpoint
+        # (resumability outranks the report — swallow)
+        r._drain_saves(swallow=True)
         path = self.store.path_for(step)
 
         def _save():

@@ -29,7 +29,16 @@ by tests/test_telemetry.py). Every hot boundary the codebase owns is
 instrumented: grid step / exchange start+wait, adapt/recommit epochs
 and arena swaps, checkpoint save/load/delta/GC phases, runner
 trips+rollbacks, integrity invariant checks and shadow audits, fleet
-admission/dispatch/quantum/preemption.
+admission/dispatch/quantum/preemption — and the zero-stall overlap
+machinery (background.py): ``recommit.bg`` wraps a background plan
+build, ``grid.recommit.swap`` the step-boundary install, and
+``ckpt.async`` an overlapped checkpoint write, with the *residual*
+step-loop blockage recorded in the ``dccrg_recommit_stall_seconds``
+(labeled ``where=swap``/unlabeled worker waits) and
+``dccrg_ckpt_stall_seconds`` histograms — the serving-path stall a
+sync epoch would have charged in full, so the sync-vs-background win
+is one PromQL ratio (``bench/recommit_bench.py --overlap`` measures
+the same quantity offline).
 
 **Trace export** — :func:`flush_trace` appends the ring as JSONL (one
 event per line) to ``DCCRG_TRACE_FILE`` (auto-flushed at process

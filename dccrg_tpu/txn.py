@@ -163,6 +163,18 @@ def _put(grid, name, val):
         setattr(grid, name, val)
 
 
+def _discard_bg(grid) -> None:
+    """Rollback hook: drop a background plan build submitted INSIDE
+    the aborted transaction (any build pending at entry was installed
+    by the entry barrier). Waits for the worker to stop touching the
+    arena; the orphaned generation's buffers are reclaimed by the next
+    build's ``arena.begin`` — the live plan's and the snapshot's
+    (restored) tables were protected the whole time, pinned by
+    tests/test_bgrecommit.py."""
+    if getattr(grid, "_bg_build", None) is not None:
+        grid.bg_discard()
+
+
 @contextmanager
 def grid_transaction(grid, op: str = "mutation", validate=None):
     """Run a structural mutation atomically (see module docstring).
@@ -180,6 +192,15 @@ def grid_transaction(grid, op: str = "mutation", validate=None):
             grid._txn_depth -= 1
         return
 
+    # background-recommit barrier (DCCRG_BG_RECOMMIT): a pending
+    # background plan build installs BEFORE the snapshot — the mutation
+    # must observe (and a rollback must restore) the final structure
+    # epoch, and no worker may be writing arena tables while this
+    # mutation rebuilds them. The install wraps itself in its own
+    # (outermost, completed here) transaction.
+    if getattr(grid, "_bg_build", None) is not None:
+        grid.bg_install(wait=True)
+
     snap = snapshot_state(grid)
     grid._txn_depth = 1
     # the rollback target plan: the hybrid builder's PlanArena keeps
@@ -191,12 +212,14 @@ def grid_transaction(grid, op: str = "mutation", validate=None):
         try:
             yield
         except Exception as e:
+            _discard_bg(grid)
             restore_state(grid, snap)
             raise MutationAbortedError(
                 op, e, cells=tuple(getattr(e, "cells", ()) or ())) from e
         except BaseException:
             # KeyboardInterrupt & co.: still leave a consistent grid,
             # but re-raise untouched
+            _discard_bg(grid)
             restore_state(grid, snap)
             raise
         check = (getattr(grid, "_debug", False)
@@ -211,6 +234,7 @@ def grid_transaction(grid, op: str = "mutation", validate=None):
                 # verifier CRASHING on malformed state is the same
                 # verdict with less detail — either way the commit is
                 # suspect, so all-or-nothing demands the rollback
+                _discard_bg(grid)
                 restore_state(grid, snap)
                 raise GridInvariantError(
                     op, e, cells=getattr(e, "cells", ())) from e

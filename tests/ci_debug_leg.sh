@@ -40,6 +40,16 @@
 # negative pin that DCCRG_BULK unset compiles the pre-executor
 # program.
 #
+# Also runs a background-recommit leg under DCCRG_DEBUG=1: the
+# refine/unrefine/balance parity suite with DCCRG_BG_RECOMMIT on, so
+# every step-boundary swap's post-commit verify_all runs against a
+# plan built on the worker thread (the swap wraps itself in a
+# transaction; --dccrg-debug makes that transaction verify), plus an
+# async-save + kill-mid-overlap smoke: a child process is killed
+# (os._exit, no cleanup) while an async checkpoint write is in
+# flight, and the parent must resume from the last durable save with
+# only sweepable temp litter left behind.
+#
 # Also runs an autopilot smoke leg under DCCRG_DEBUG=1: an opted-in
 # fleet run writes its decision journal and every decision replays
 # (re-derives) from the journal alone, the explain/replay CLI round
@@ -76,6 +86,53 @@ env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_autopilot.py::test_explain_and_replay_cli" \
     "tests/test_autopilot.py::test_off_by_default_negative_pin" \
     --dccrg-debug -p no:cacheprovider "$@"
+env JAX_PLATFORMS=cpu python -m pytest -q \
+    "tests/test_bgrecommit.py::test_bg_plan_parity_across_refine_unrefine_balance" \
+    "tests/test_bgrecommit.py::test_balance_drains_pending_build_first" \
+    "tests/test_bgrecommit.py::test_async_preempt_emergency_save_then_resume_bitwise" \
+    --dccrg-debug -p no:cacheprovider "$@"
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+# async-save kill-mid-overlap smoke: SIGKILL-equivalent death while a
+# checkpoint write is overlapped with dispatch; the store must still
+# resume from the last durable save, with only sweepable temp litter.
+import os, subprocess, sys, tempfile
+workdir = tempfile.mkdtemp(prefix="dccrg_kill_overlap_")
+child = r'''
+import os, sys
+import numpy as np, jax.numpy as jnp
+from dccrg_tpu import Grid
+from dccrg_tpu.supervise import CheckpointStore
+os.environ["DCCRG_ASYNC_SAVE"] = "1"
+g = (Grid(cell_data={"rho": jnp.float32})
+     .set_initial_length((8, 8, 4)).set_periodic(True, True, False)
+     .set_load_balancing_method("block").initialize())
+cells = g.plan.cells
+g.set("rho", cells, (cells.astype(np.float64) % 13).astype(np.float32))
+store = CheckpointStore(sys.argv[1], stem="k")
+store.save(g, 1); store.drain()          # one durable save
+g.set("rho", cells, (cells.astype(np.float64) % 7).astype(np.float32))
+store.save(g, 2)                          # in flight...
+os._exit(137)                             # ...killed mid-overlap
+'''
+rc = subprocess.run([sys.executable, "-c", child, workdir],
+                    env=dict(os.environ, JAX_PLATFORMS="cpu")).returncode
+assert rc == 137, rc
+import jax.numpy as jnp
+from dccrg_tpu import checkpoint as ckpt, resilience
+from dccrg_tpu.supervise import resume_latest
+info = resume_latest(workdir, {"rho": jnp.float32}, stem="k",
+                     load_balancing_method="block")
+assert info is not None and not info.salvaged and info.step >= 1, info
+# whatever the kill left behind is recognized stale temp litter, and
+# the durable checkpoint the resume used still CRC-verifies
+assert resilience.verify_checkpoint(info.path) == []
+for p in ckpt.stale_temp_files(workdir):
+    os.unlink(p)
+left = [n for n in os.listdir(workdir)
+        if ".tmp." in n or n.endswith(".mp-tmp")]
+assert not left, left
+print("kill-mid-overlap smoke OK (resumed step %d)" % info.step)
+PYEOF
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_recommit.py::test_native_numpy_plans_bitwise_identical" \
     "tests/test_checkpoint_integrity.py::test_chain_salvage_falls_back_to_verifying_prefix" \

@@ -91,6 +91,10 @@ def test_rules_pure_and_json_faithful():
         "capacity.seed": (16, {"learned_capacity": 4, "lo": 1}),
         "capacity.probe": (4, {"clean_run": True,
                                "default_capacity": 16}),
+        "quantum.learn": (None, {"final_quantum": 2,
+                                 "configured": 8}),
+        "quantum.warm_start": (8, {"learned_quantum": 2, "lo": 1,
+                                   "hi": 64, "configured": 8}),
     }
     assert set(cases) == set(RULES)
     for rule, (before, inp) in cases.items():
@@ -141,6 +145,10 @@ def test_hard_bounds_property():
             "slack_factor": 8.0, "deadband": 0.25,
             "observed_capacity": int(rng.integers(1, 256)),
             "learned_capacity": maybe(int(rng.integers(1, 256))),
+            "rollback_s": maybe(float(abs(rng.normal(0, 10)))),
+            "learned_quantum": maybe(int(rng.integers(1, 512))),
+            "final_quantum": maybe(int(rng.integers(1, 512))),
+            "configured": int(rng.integers(1, 512)),
         }
         before = int(rng.integers(lo, hi + 1))
         for rule in ("quantum.shorten", "quantum.lengthen",
@@ -160,6 +168,10 @@ def test_hard_bounds_property():
             before, dict(inp, clean_run=bool(rng.integers(0, 2)),
                          default_capacity=maybe(int(
                              rng.integers(1, 256)))))
+        assert got is None or got >= 1
+        got = RULES["quantum.warm_start"](before, inp)
+        assert got is None or lo <= got <= hi, inp
+        got = RULES["quantum.learn"](maybe(before), inp)
         assert got is None or got >= 1
 
 
@@ -416,6 +428,95 @@ def test_capacity_floor_recovers_after_clean_runs(tmp_path):
     ap2 = Autopilot(quantum=4, clock=lambda: 0.0,
                     decision_file=journal)
     assert ap2.capacity[kid] == 16
+    assert replay(read_journal(journal)) == []
+
+
+def test_quantum_warm_starts_from_journal(tmp_path):
+    """The carried-item pin: a run whose controller converged the
+    QUANTUM knob journals the final value at the clean drain
+    (quantum.learn), and a FRESH controller sharing only the journal
+    warm-starts the next scheduler there on its first tick
+    (quantum.warm_start) instead of re-halving from the configured
+    default — the capacity.learn/probe discipline for the quantum.
+    Replay reconstructs both runs."""
+    journal = str(tmp_path / "j.jsonl")
+    jobs = _jobs(2, slo_ms=100.0)
+    ap = Autopilot(quantum=16, clock=lambda: 0.0,
+                   decision_file=journal)
+    sched, pol = _sched(tmp_path / "one", _jobs(2, slo_ms=100.0), ap,
+                        quantum=16)
+    sched._admit_pending()
+    for _b, _s, j in sched.active_jobs():
+        j.slo_t0 = 0.0
+    pol.observe(jobs[0].bucket_key(), 10.0)  # blows the 100 ms SLO
+    _tick(sched, ap, 8)
+    assert sched.quantum == 1  # converged to the floor
+    ap.end_of_run()
+    learns = [r for r in ap.decisions if r["rule"] == "quantum.learn"]
+    assert [(r["before"], r["after"]) for r in learns] == [(None, 1)]
+    # run 2: a fresh controller + scheduler, same configured quantum,
+    # sharing ONLY the journal
+    ap2 = Autopilot(quantum=16, clock=lambda: 0.0,
+                    decision_file=journal)
+    assert ap2.learned_quantum == 1
+    sched2, _pol2 = _sched(tmp_path / "two", _jobs(2), ap2, quantum=16)
+    sched2._admit_pending()
+    _tick(sched2, ap2, 1)
+    assert sched2.quantum == 1  # warm-started, not re-halved
+    warm = [r for r in ap2.decisions
+            if r["rule"] == "quantum.warm_start"]
+    assert [(r["before"], r["after"]) for r in warm] == [(16, 1)]
+    assert replay(read_journal(journal)) == []
+    # a run that never tuned (and has no prior memory) journals no
+    # quantum.learn: nothing to remember
+    j3 = str(tmp_path / "j3.jsonl")
+    ap3 = Autopilot(quantum=16, clock=lambda: 0.0, decision_file=j3)
+    ap3.end_of_run()
+    assert not os.path.exists(j3)
+
+
+def test_checkpoint_retune_prices_measured_rollback_cost(tmp_path):
+    """The carried-item pin: the cadence rule extends Young with the
+    MEASURED per-trip recovery cost (Daly's sqrt(2*C*(M+R))): with a
+    recorded ``rollback_s`` the optimum lengthens exactly by the
+    closed form, the live gather feeds the measured
+    dccrg_rollback_seconds mean into the journaled inputs, and
+    replay stays divergence-free."""
+    inp = {"save_cost_s": 0.05, "step_seconds": 0.01, "lo": 1,
+           "hi": 256, "trip_rate": 0.125}
+    young = RULES["checkpoint.retune"](64, dict(inp))
+    daly = RULES["checkpoint.retune"](64, dict(inp, rollback_s=0.4))
+    assert young == round((2 * 5 / 0.125) ** 0.5)  # = 9, R absent
+    # M = 8 steps, R = 0.4/0.01 = 40 steps: sqrt(2*5*48) ~ 22
+    assert daly == round((2 * 5 * (8 + 40)) ** 0.5)
+    assert daly > young
+    # None / zero rollback history degrades to Young exactly
+    assert RULES["checkpoint.retune"](
+        64, dict(inp, rollback_s=None)) == young
+    # live path: the measured rollback histogram lands in the inputs
+    journal = str(tmp_path / "j.jsonl")
+    jobs = _jobs(2, steps=400)
+    sched, pol = _sched(tmp_path, jobs, None, quantum=4)
+    sched._admit_pending()
+    pol.observe(jobs[0].bucket_key(), 0.04)  # 0.01 s/step
+    telemetry.registry().reset()
+    ap = Autopilot(quantum=4, clock=lambda: 0.0, adjust_every=1,
+                   decision_file=journal)
+    sched.autopilot = ap
+    for _ in range(6):
+        telemetry.observe("dccrg_ckpt_save_seconds", 0.05,
+                          kind="keyframe")
+    telemetry.observe("dccrg_rollback_seconds", 0.4)
+    telemetry.observe("dccrg_rollback_seconds", 0.4)
+    tripping = jobs[0]
+    tripping.steps_done = 64
+    tripping.trips = [("nan", i) for i in range(8)]  # rate 0.125
+    _tick(sched, ap)
+    assert tripping.checkpoint_every == daly
+    recs = [r for r in read_journal(journal)
+            if r["rule"] == "checkpoint.retune"]
+    assert recs and all(abs(r["inputs"]["rollback_s"] - 0.4) < 1e-9
+                        for r in recs)
     assert replay(read_journal(journal)) == []
 
 

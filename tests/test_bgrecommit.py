@@ -1,0 +1,454 @@
+"""Zero-stall serving: background AMR recommit + async checkpoint
+saves (dccrg_tpu.background).
+
+The pins: background-built plans are BITWISE identical to synchronous
+builds across refine/unrefine/balance sequences; a finished plan
+installs only at a step boundary (never mid-anything); a transaction
+abort while a build is in flight discards it and leaves the live AND
+snapshot generations bitwise untouched; a worker crash falls back to
+the inline rebuild; ``DCCRG_ASYNC_SAVE=1`` checkpoints are bitwise
+identical to synchronous saves with torn-write / preemption / GC-race
+fault injection riding the existing FaultPlan sites; resumed runs
+reconverge bitwise; and with both env flags unset nothing changes
+(the negative pins).
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from test_recommit import make_grid, plan_fingerprint
+
+from dccrg_tpu import Grid, FaultPlan, MutationAbortedError, faults
+from dccrg_tpu import checkpoint as checkpoint_mod
+from dccrg_tpu import resilience, supervise, telemetry
+from dccrg_tpu.supervise import (CheckpointStore, PreemptedError,
+                                 SupervisedRunner, resume_latest)
+from dccrg_tpu.txn import grid_state_bytes, grid_transaction
+
+pytestmark = pytest.mark.bgrecommit
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("DCCRG_BG_RECOMMIT", raising=False)
+    monkeypatch.delenv("DCCRG_ASYNC_SAVE", raising=False)
+    telemetry.registry().reset()
+
+
+def _kernel(cell, nbr, offs, mask, *extra):
+    return {"v": cell["v"] + jnp.float32(0.01) * jnp.sum(
+        jnp.where(mask, nbr["v"] - cell["v"][:, None], jnp.float32(0)),
+        axis=1)}
+
+
+def _seed(g):
+    cells = g.plan.cells
+    g.set("v", cells, (cells.astype(np.float64) % 29).astype(np.float32))
+    g.update_copies_of_remote_neighbors()
+
+
+def _step(g, n=1):
+    g.run_steps(_kernel, ["v"], ["v"], n)
+
+
+# -- bitwise plan parity ----------------------------------------------
+
+def _adapt_balance_sequence(bg, monkeypatch, steps_between=0):
+    """refine -> recommit -> balance -> unrefine, a fingerprint +
+    state digest after every commit (bg mode flushes at a step
+    boundary first)."""
+    monkeypatch.setenv("DCCRG_BG_RECOMMIT", "1" if bg else "0")
+    g = make_grid()
+    _seed(g)
+    out = []
+
+    def flush():
+        if steps_between and g.bg_pending():
+            _step(g, steps_between)  # serve on the live plan first
+        g.bg_install(wait=True)
+        out.append(plan_fingerprint(g))
+
+    for c in (1, 2, 3):
+        g.refine_completely(c)
+    g.stop_refining()
+    flush()
+    for c in g.plan.cells[:6]:
+        g.refine_completely(int(c))
+    g.stop_refining()
+    flush()
+    g.balance_load()
+    out.append(plan_fingerprint(g))  # balance installs synchronously
+    lvl = g.mapping.get_refinement_level(g.plan.cells)
+    deepest = g.plan.cells[lvl == lvl.max()]
+    g.unrefine_completely(int(deepest[0]))
+    g.stop_refining()
+    flush()
+    return out
+
+
+def test_bg_plan_parity_across_refine_unrefine_balance(monkeypatch):
+    """THE tentpole pin: plans built on the background worker are
+    bitwise identical — layout and every hood table — to synchronous
+    builds, across refine/recommit/balance/unrefine epochs."""
+    sync = _adapt_balance_sequence(False, monkeypatch)
+    bg = _adapt_balance_sequence(True, monkeypatch)
+    assert sync == bg
+
+
+def test_bg_parity_with_serving_between(monkeypatch):
+    """Stepping on the live plan while the worker builds changes
+    nothing about the PLAN the swap installs."""
+    sync = _adapt_balance_sequence(False, monkeypatch)
+    bg = _adapt_balance_sequence(True, monkeypatch, steps_between=2)
+    assert sync == bg
+
+
+def test_bg_negative_pin(monkeypatch):
+    """Env unset: stop_refining never leaves a pending build — the
+    synchronous path, bitwise (trivially: it IS the same code)."""
+    monkeypatch.delenv("DCCRG_BG_RECOMMIT", raising=False)
+    g = make_grid()
+    for c in (1, 2, 3):
+        g.refine_completely(c)
+    g.stop_refining()
+    assert not g.bg_pending()
+
+
+# -- swap-only-at-boundary --------------------------------------------
+
+def test_swap_only_at_step_boundary(monkeypatch):
+    """Between the adapt call and the next step boundary the grid
+    serves the PREVIOUS (consistent) epoch — even when the build has
+    long finished — and the boundary installs exactly once."""
+    monkeypatch.setenv("DCCRG_BG_RECOMMIT", "1")
+    g = make_grid()
+    _seed(g)
+    n_before = len(g.plan.cells)
+    fp_before = plan_fingerprint(g)
+    for c in (1, 2, 3):
+        g.refine_completely(c)
+    new_cells = g.stop_refining()
+    assert g.bg_pending()
+    g._bg_build.wait()  # finished, NOT installed
+    assert g.bg_pending()
+    assert len(g.plan.cells) == n_before  # old epoch still serving
+    assert plan_fingerprint(g) == fp_before
+    assert not np.isin(new_cells, g.plan.cells).any()
+    _step(g)  # the boundary
+    assert not g.bg_pending()
+    assert len(g.plan.cells) == n_before + len(new_cells) - 3
+    assert np.isin(new_cells, g.plan.cells).all()
+
+
+def test_data_access_to_new_cells_is_a_boundary(monkeypatch):
+    """The adapt-then-project pattern stays oblivious to deferral: a
+    host data access naming a NEW child right after stop_refining is
+    itself a swap boundary — the pending plan installs (blocking) and
+    the access proceeds (examples/amr_advection.py runs unmodified
+    under DCCRG_BG_RECOMMIT=1)."""
+    monkeypatch.setenv("DCCRG_BG_RECOMMIT", "1")
+    g = make_grid()
+    _seed(g)
+    for c in (1, 2, 3):
+        g.refine_completely(c)
+    new_cells = g.stop_refining()
+    assert g.bg_pending()
+    vals = g.get("v", new_cells[:4])  # needs the new epoch: installs
+    assert not g.bg_pending()
+    assert np.all(vals == 0.0)  # fresh children zero-initialized
+    g.set("v", new_cells, np.ones(len(new_cells), dtype=np.float32))
+    assert np.all(g.get("v", new_cells) == 1.0)
+
+
+def test_fleet_quantum_boundary_polls(monkeypatch):
+    """GridBatch.step is a swap point too (the fleet's step
+    boundary): a scratch grid with no pending build steps unchanged —
+    the poll is a no-op, pinned not to disturb the dispatch."""
+    from dccrg_tpu.fleet import FleetJob, GridBatch
+
+    monkeypatch.setenv("DCCRG_BG_RECOMMIT", "1")
+    job = FleetJob("j0", length=(6, 6, 6), n_steps=4, seed=1,
+                   params=(0.03,))
+    batch = GridBatch(job, capacity=2)
+    slot = batch.admit(job)
+    assert not batch.grid.bg_pending()
+    batch.step(np.array([2, 0], dtype=np.int32))
+    assert batch.digest(slot)
+
+
+# -- txn aborts + worker crashes --------------------------------------
+
+def test_txn_abort_mid_build_discards_and_restores_bitwise(monkeypatch):
+    """An abort while a background build is in flight: the pending
+    build is discarded and the grid — live plan, snapshot plan, every
+    field byte — is bitwise its pre-transaction self. The restored
+    request sets then redo the adaptation to the same bitwise plan a
+    synchronous build produces."""
+    monkeypatch.setenv("DCCRG_BG_RECOMMIT", "1")
+    want = _adapt_balance_sequence(False, monkeypatch)[0]
+    monkeypatch.setenv("DCCRG_BG_RECOMMIT", "1")
+    g = make_grid()
+    _seed(g)
+    for c in (1, 2, 3):
+        g.refine_completely(c)
+    before = grid_state_bytes(g)
+    with pytest.raises(MutationAbortedError):
+        with grid_transaction(g, op="outer"):
+            g.stop_refining()
+            assert g.bg_pending()  # submitted inside the transaction
+            raise RuntimeError("abort with the build in flight")
+    assert not g.bg_pending()  # discarded, worker joined
+    assert grid_state_bytes(g) == before
+    # the requests survived the rollback: the retry reconverges to
+    # the synchronous build's exact plan
+    g.stop_refining()
+    g.bg_install(wait=True)
+    assert plan_fingerprint(g) == want
+
+
+def test_worker_crash_falls_back_to_inline(monkeypatch):
+    """An injected fault inside the background build (the existing
+    hybrid.recommit site) crashes the WORKER, not the run: the swap
+    point rebuilds inline and the plan still equals the synchronous
+    build bitwise."""
+    monkeypatch.setenv("DCCRG_BG_RECOMMIT", "1")
+    want = _adapt_balance_sequence(False, monkeypatch)[0]
+    monkeypatch.setenv("DCCRG_BG_RECOMMIT", "1")
+    g = make_grid()
+    _seed(g)
+    for c in (1, 2, 3):
+        g.refine_completely(c)
+    plan = FaultPlan(seed=3)
+    plan.mutation_error(site="hybrid.recommit", phase="tables")
+    with plan:
+        g.stop_refining()
+        g._bg_build.wait()
+        assert g._bg_build.error is not None  # the worker crashed
+        _step(g)  # boundary: inline fallback rebuild + install
+    assert plan.fired("hybrid.recommit") == 1
+    assert not g.bg_pending()
+    assert plan_fingerprint(g) == want
+    reg = telemetry.registry()
+    assert reg.counter_total("dccrg_recommit_bg_errors_total") == 1
+
+
+def test_swap_abort_leaves_live_epoch_bitwise(monkeypatch):
+    """A fault during the deferred install (the existing
+    grid.restructure site): the swap runs in its own transaction, so
+    the step loop keeps its pre-swap epoch bitwise and the failure
+    surfaces as MutationAbortedError at the boundary."""
+    monkeypatch.setenv("DCCRG_BG_RECOMMIT", "1")
+    g = make_grid()
+    _seed(g)
+    for c in (1, 2, 3):
+        g.refine_completely(c)
+    g.stop_refining()
+    g._bg_build.wait()
+    before = grid_state_bytes(g)
+    plan = FaultPlan(seed=4)
+    plan.mutation_error(site="grid.restructure", phase="planned")
+    with plan, pytest.raises(MutationAbortedError):
+        _step(g)
+    assert grid_state_bytes(g) == before
+    assert not g.bg_pending()
+    _step(g, 2)  # the old epoch still serves
+
+
+def test_balance_drains_pending_build_first(monkeypatch):
+    """A mutation that cannot defer (balance must land staged data on
+    the new plan) installs the pending build at its transaction entry
+    — never two builds racing one arena."""
+    monkeypatch.setenv("DCCRG_BG_RECOMMIT", "1")
+    g = make_grid()
+    _seed(g)
+    for c in (1, 2, 3):
+        g.refine_completely(c)
+    g.stop_refining()
+    assert g.bg_pending()
+    g.balance_load()  # entry barrier installs, then rebalances
+    assert not g.bg_pending()
+    from dccrg_tpu import verify
+    verify.verify_all(g, check_pins=False)
+
+
+# -- async checkpoint saves -------------------------------------------
+
+def _sha(path):
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _mk_uniform(seed=0):
+    g = (Grid(cell_data={"rho": jnp.float32, "aux": jnp.float32})
+         .set_initial_length((6, 6, 2))
+         .set_periodic(True, True, False)
+         .set_load_balancing_method("block")
+         .initialize())
+    cells = g.plan.cells
+    g.set("rho", cells, (cells.astype(np.float64) % 17).astype(np.float32))
+    g.set("aux", cells, np.ones(len(cells), dtype=np.float32))
+    g.update_copies_of_remote_neighbors()
+    return g
+
+
+def _rho_kernel(c, nbr, offs, mask):
+    return {"rho": jnp.float32(0.5) * c["rho"] + jnp.float32(0.125)
+            * jnp.sum(jnp.where(mask, nbr["rho"], jnp.float32(0)), axis=1)}
+
+
+def _rho_step(grid, _i):
+    grid.run_steps(_rho_kernel, ["rho"], ["rho"], 1)
+
+
+def test_async_store_saves_bitwise_identical(monkeypatch, tmp_path):
+    """Every file a DCCRG_ASYNC_SAVE=1 store publishes — keyframes,
+    dirty-field deltas, their CRC sidecars — is bitwise identical to
+    the synchronous store's, and the delta chain policy is unchanged
+    (the parent link resolves synchronously)."""
+    def run(async_on, d):
+        monkeypatch.setenv("DCCRG_ASYNC_SAVE", "1" if async_on else "0")
+        g = _mk_uniform()
+        store = CheckpointStore(str(d), stem="j")
+        for i in range(6):
+            _rho_step(g, i)
+            store.save(g, i + 1)
+        store.drain()
+        return {n: _sha(os.path.join(str(d), n))
+                for n in sorted(os.listdir(str(d)))}
+
+    sync = run(False, tmp_path / "sync")
+    asy = run(True, tmp_path / "async")
+    assert sync == asy
+    assert any(n.endswith(".dcd") for n in sync)  # deltas exercised
+    assert telemetry.registry().counter_total(
+        "dccrg_ckpt_async_saves_total") == 6
+
+
+def test_async_torn_write_surfaces_at_drain_and_recovers(monkeypatch,
+                                                         tmp_path):
+    """Torn-write fault injection through the existing
+    checkpoint.write site with retries exhausted: the failure
+    surfaces at the next drain barrier, the failed step's file never
+    exists under its final name, the chain state resets (next save is
+    a keyframe) and resume falls back to the last durable save."""
+    monkeypatch.setenv("DCCRG_ASYNC_SAVE", "1")
+    g = _mk_uniform()
+    store = CheckpointStore(str(tmp_path), stem="j")
+    store.save(g, 1)
+    store.drain()  # save 1 durable BEFORE the fault plan arms
+    plan = FaultPlan(seed=5)
+    plan.io_error(times=3)  # all 3 attempts of ONE save
+    with plan:
+        _rho_step(g, 0)
+        path2 = store.save(g, 2)
+        with pytest.raises(OSError):
+            store.drain()
+    assert not os.path.exists(path2)
+    assert store._parent is None  # nothing may chain to the failure
+    assert g._ckpt_dirty is None  # conservative: next save keyframes
+    _rho_step(g, 1)
+    path3 = store.save(g, 3)
+    store.drain()
+    assert path3.endswith(".dc")  # keyframe, not a delta
+    info = resume_latest(str(tmp_path), {"rho": jnp.float32,
+                                         "aux": jnp.float32},
+                         stem="j", load_balancing_method="block")
+    assert info is not None and info.step == 3
+    assert telemetry.registry().counter_total(
+        "dccrg_ckpt_async_errors_total") == 1
+
+
+def test_async_gc_race_drains_before_pruning(monkeypatch, tmp_path):
+    """The GC-race pin: retention GC against a store with a write in
+    flight passes the drain barrier first — it can never prune or
+    misjudge a half-published save."""
+    monkeypatch.setenv("DCCRG_ASYNC_SAVE", "1")
+    g = _mk_uniform()
+    store = CheckpointStore(str(tmp_path), stem="j")
+    for i in range(4):
+        _rho_step(g, i)
+        store.save(g, i + 1, force_keyframe=True)
+    # the 4th save may still be in flight: gc must drain, then keep
+    # the newest verifying chain
+    rep = store.gc(keep_last=1)
+    assert not store.pending()
+    kept = [p for _s, p in rep.kept]
+    assert store.path_for(4) in kept
+    assert resilience.verify_checkpoint(store.path_for(4)) == []
+
+
+def test_async_runner_trip_rollback_reconverges(monkeypatch, tmp_path):
+    """A NaN trip mid-run under DCCRG_ASYNC_SAVE=1: the rollback
+    drains the in-flight write first, and the recovered run's final
+    bytes equal the synchronous-mode run's exactly."""
+    def run(async_on, d):
+        monkeypatch.setenv("DCCRG_ASYNC_SAVE", "1" if async_on else "0")
+        d.mkdir()
+        g = _mk_uniform()
+        plan = FaultPlan(seed=6)
+        plan.nan_poison("rho", step=7)
+        with plan:
+            r = resilience.ResilientRunner(
+                g, _rho_step, str(d / "c.dc"), checkpoint_every=3,
+                check_every=1, backoff=0)
+            r.run(12)
+        return checkpoint_mod.state_digest(g), r.rollbacks
+
+    sync = run(False, tmp_path / "s")
+    asy = run(True, tmp_path / "a")
+    assert sync == asy
+    assert sync[1] == 1  # the trip actually happened
+
+
+def test_async_preempt_emergency_save_then_resume_bitwise(monkeypatch,
+                                                          tmp_path):
+    """Preemption with async saves on: the periodic writer drains,
+    the emergency keyframe is synchronous + CRC-verified, and the
+    resumed run reconverges bitwise with an uninterrupted
+    synchronous-mode run."""
+    monkeypatch.setenv("DCCRG_ASYNC_SAVE", "0")
+    ref = SupervisedRunner(_mk_uniform(), _rho_step,
+                           str(tmp_path / "ref"), check_every=100,
+                           checkpoint_every=3, backoff=0.0)
+    ref.run(12)
+    want = checkpoint_mod.state_digest(ref.grid)
+
+    monkeypatch.setenv("DCCRG_ASYNC_SAVE", "1")
+    sup = SupervisedRunner(_mk_uniform(), _rho_step,
+                           str(tmp_path / "pre"), check_every=100,
+                           checkpoint_every=3, backoff=0.0)
+    plan = FaultPlan(seed=7)
+    plan.preempt_signal(step=5)
+    with plan, pytest.raises(PreemptedError) as ei:
+        sup.run(12)
+    assert ei.value.clean
+    assert resilience.verify_checkpoint(ei.value.checkpoint) == []
+    info = resume_latest(str(tmp_path / "pre"),
+                         {"rho": jnp.float32, "aux": jnp.float32},
+                         load_balancing_method="block")
+    assert info is not None and not info.salvaged
+    info.grid.update_copies_of_remote_neighbors()
+    sup2 = SupervisedRunner(info.grid, _rho_step, str(tmp_path / "pre"),
+                            check_every=100, checkpoint_every=3,
+                            backoff=0.0, start_step=info.step)
+    sup2.run(12)
+    assert checkpoint_mod.state_digest(sup2.grid) == want
+
+
+def test_async_negative_pin(monkeypatch, tmp_path):
+    """Env unset: CheckpointStore.save never spawns a writer and
+    never defers — the synchronous path byte-for-byte (it IS the same
+    code), with no async counters touched."""
+    monkeypatch.delenv("DCCRG_ASYNC_SAVE", raising=False)
+    g = _mk_uniform()
+    store = CheckpointStore(str(tmp_path), stem="j")
+    store.save(g, 1)
+    assert not store.pending()
+    assert telemetry.registry().counter_total(
+        "dccrg_ckpt_async_saves_total") == 0
