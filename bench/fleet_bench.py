@@ -20,6 +20,17 @@ throughput; ``--ckpt-every K`` turns the fleet data plane back on.
 Run:  timeout -k 10 900 python bench/fleet_bench.py [--n 32]
       [--steps 20] [--jobs 1 8 32 100]
 
+``--hosts N`` instead runs the ELASTIC multi-host leg: N in-process
+rank-aware schedulers (shared InMemoryKV + checkpoint dir, real
+clock, tight heartbeat/lease bounds) serve one job set; host 1 is
+killed mid-serve (its tick driver stops — the in-process analogue of
+the mp harness's real ``kill -9``) and the leg measures the recovery
+wall: ``fleet_reclaim_seconds`` (kill -> the survivor's CAS takeover
+of the first orphan) and ``fleet_kill_downtime_seconds`` (kill ->
+the first reclaimed job's dispatch completes) — the two trend keys
+``bench/trend.py`` tracks for the elastic control plane, with
+bitwise solo-digest parity asserted for every job, victims included.
+
 JSON rows go to stdout like the other bench emitters; the summary row
 carries the runs/s table PERF.md quotes.
 """
@@ -113,6 +124,102 @@ def run_fleet(count, n, steps, ckpt_every, quantum):
     return wall, {name: r["digest"] for name, r in report.items()}
 
 
+def run_hosts(n_hosts, n, steps, quantum, heartbeat_s=0.1,
+              lease_s=0.4):
+    """The elastic multi-host leg: ``n_hosts`` in-process rank-aware
+    schedulers over one shared KV + checkpoint dir; host 1 dies
+    mid-serve and the survivors' lease-expiry reclaim is timed."""
+    from dccrg_tpu import coord, telemetry
+    from dccrg_tpu.fleet import run_solo
+    from dccrg_tpu.scheduler import FleetScheduler
+
+    count = max(2, 2 * n_hosts)
+    kv = coord.InMemoryKV()
+    workdir = tempfile.mkdtemp(prefix="dccrg_fleet_hosts_")
+    refs = {j.name: run_solo(j)
+            for j in make_jobs(count, n, steps, 4)}
+    try:
+        scheds = []
+        for rank in range(n_hosts):
+            m = coord.Membership(rank, n_hosts, kv=kv,
+                                 heartbeat_s=heartbeat_s,
+                                 lease_s=lease_s, clock=time.monotonic)
+            scheds.append(FleetScheduler(
+                workdir, make_jobs(count, n, steps, 4),
+                quantum=quantum or 4, membership=m))
+        names = [f"b{i:04d}" for i in range(count)]
+        reg = telemetry.registry()
+        base_reclaims = reg.counter_total("dccrg_fleet_reclaims_total")
+
+        def tick(s):
+            s.run(max_ticks=s.ticks + 1)
+
+        def _disp_total(name):
+            h = reg.histogram("dccrg_fleet_quantum_seconds", job=name)
+            return 0 if h is None else h.total
+
+        victim = scheds[1] if n_hosts > 1 else None
+        live = list(scheds)
+        orphans, disp_base = [], {}
+        t_kill = t_reclaim = t_first_dispatch = None
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            for s in live:
+                tick(s)
+            done = sum(1 for nm in names if nm in scheds[0].report)
+            if victim is not None and t_kill is None \
+                    and victim.leases.owned \
+                    and any(j.steps_done > 0
+                            for _b, _s2, j in victim.active_jobs()):
+                # the victim is mid-serve with real progress: kill it
+                # (ticks and heartbeats both cease — the in-process
+                # analogue of the mp harness's real kill -9)
+                t_kill = time.monotonic()
+                victim.membership.stop_auto()
+                orphans = sorted(victim.leases.owned)
+                disp_base = {nm: _disp_total(nm) for nm in orphans}
+                live = [s for s in scheds if s is not victim]
+            if t_kill is not None and t_reclaim is None \
+                    and reg.counter_total("dccrg_fleet_reclaims_total") \
+                    > base_reclaims:
+                t_reclaim = time.monotonic()
+            if t_reclaim is not None and t_first_dispatch is None \
+                    and any(_disp_total(nm) > disp_base[nm]
+                            for nm in orphans):
+                # a survivor finished a dispatch that ADVANCED a
+                # reclaimed job: serving resumed
+                t_first_dispatch = time.monotonic()
+            if done == count and (victim is None
+                                  or t_first_dispatch is not None):
+                break
+        report = {}
+        for s in live:
+            for nm, row in s.report.items():
+                if not row.get("remote"):
+                    report[nm] = row
+        assert sorted(report) == names, sorted(report)
+        for nm, row in report.items():
+            assert row["status"] == "done" and row["digest"] == refs[nm], nm
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    row = {
+        "hosts": n_hosts, "jobs": count, "cells_per_job": n ** 3,
+        "steps": steps,
+        "heartbeat_s": heartbeat_s, "lease_s": lease_s,
+        "fleet_reclaim_seconds": (
+            None if t_kill is None or t_reclaim is None
+            else round(t_reclaim - t_kill, 4)),
+        "fleet_kill_downtime_seconds": (
+            None if t_kill is None or t_first_dispatch is None
+            else round(t_first_dispatch - t_kill, 4)),
+        "orphans_reclaimed": len(orphans) if t_kill is not None else 0,
+        "bitwise_parity": True,
+    }
+    print(json.dumps(row), flush=True)
+    print(json.dumps({"summary": row}), flush=True)
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=32,
@@ -125,6 +232,10 @@ def main():
     ap.add_argument("--quantum", type=int, default=None)
     ap.add_argument("--ckpt-every", type=int, default=0,
                     help="fleet checkpoint cadence (0 = pure stepping)")
+    ap.add_argument("--hosts", type=int, default=None, metavar="N",
+                    help="elastic multi-host leg: N in-process "
+                         "rank-aware schedulers, host 1 killed "
+                         "mid-serve, reclaim latency measured")
     args = ap.parse_args()
 
     # hang-proof backend probe before any jax work (like the other
@@ -132,6 +243,10 @@ def main():
     from dccrg_tpu.resilience import safe_devices
 
     safe_devices(timeout=120, retries=1, platform="cpu")
+
+    if args.hosts is not None:
+        return run_hosts(args.hosts, min(args.n, 12), args.steps,
+                         args.quantum)
 
     cells = args.n ** 3
     rows = []

@@ -70,8 +70,8 @@ from .txn import (GridInvariantError, MutationAbortedError, MutationError,
                   grid_transaction)
 from .faults import FaultPlan
 from .coord import (BarrierTimeoutError, CheckpointCommitError,
-                    DistributedInitError, barrier, distributed_init,
-                    trip_consensus)
+                    DistributedInitError, Membership, PeerDeadError,
+                    barrier, distributed_init, trip_consensus)
 from .resilience import (CheckpointCorruptionError, DeviceProbeError,
                          NumericsError, ResilienceExhaustedError,
                          ResilientRunner, guarded_step, load_checkpoint,
@@ -80,7 +80,8 @@ from .supervise import (RESUMABLE_EXIT, CheckpointStore, PreemptedError,
                         StepTimeoutError, SupervisedRunner,
                         gc_checkpoints, resume_latest)
 from .fleet import FleetJob, GridBatch
-from .scheduler import FleetPreemptedError, FleetScheduler, SLOPolicy
+from .scheduler import (FleetPreemptedError, FleetScheduler,
+                        OwnershipLostError, SLOPolicy)
 from .integrity import IntegrityError, register_conserved
 from . import telemetry
 from .telemetry import LogHistogram
@@ -114,6 +115,9 @@ __all__ = [
     "BarrierTimeoutError",
     "CheckpointCommitError",
     "DistributedInitError",
+    "Membership",
+    "PeerDeadError",
+    "OwnershipLostError",
     "barrier",
     "distributed_init",
     "trip_consensus",

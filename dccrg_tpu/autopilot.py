@@ -290,6 +290,60 @@ def _rule_capacity_probe(before, inp):
     return new if new != int(before) else None
 
 
+def _rule_shed_cooldown(before, inp):
+    """Retune the SLO-shed cooldown from observed shed churn: a fresh
+    shed doubles the cooldown (every shed rebuild costs a compile and
+    resets the EWMA — back-to-back sheds are the feedback loop the
+    cooldown exists to damp), and a sustained clean streak halves it
+    back toward the configured baseline (a calm fleet earns its
+    responsiveness back)."""
+    before = int(before)
+    lo = max(1, int(inp.get("lo", 1)))
+    hi = int(inp.get("hi", 64))
+    if inp.get("new_sheds", 0) > 0:
+        new = min(hi, max(lo, before * 2))
+    elif (inp.get("shed_clean_streak", 0) >= inp.get("relax_after", 8)
+          and before > max(lo, int(inp.get("baseline", lo)))):
+        new = min(hi, max(lo, int(inp.get("baseline", lo)),
+                          before // 2))
+    else:
+        return None
+    return new if new != before else None
+
+
+def _rule_retry_budget(before, inp):
+    """Retune a job's trip-retry budget from ITS OWN trip history: a
+    job burning consecutive retries at the same step (a deterministic
+    blow-up the rollback cannot outrun) fails faster — each replay of
+    the doomed window is pure wasted wall — while a job whose trips
+    RECOVER (progress after every rollback, no same-step churn) earns
+    headroom for the next transient upset."""
+    before = int(before)
+    lo = max(1, int(inp.get("lo", 1)))
+    hi = int(inp.get("hi", 8))
+    repeat = int(inp.get("repeat_trips", 0))
+    recovered = int(inp.get("recovered", 0))
+    if repeat >= 2:
+        new = max(lo, min(hi, before - 1))
+    elif recovered > 0 and repeat == 0:
+        new = min(hi, max(lo, before + 1))
+    else:
+        return None
+    return new if new != before else None
+
+
+def _rule_fleet_reclaim(before, inp):
+    """Narrate an elastic-fleet job reclaim in the decision journal:
+    ``n`` jobs of a dead rank were taken over (lease expired, epoch
+    fence bumped). The 'knob' is the cumulative reclaim count — the
+    record exists so ``explain`` reconstructs WHO died, WHAT was
+    reclaimed and under WHICH lease bound from the journal alone."""
+    n = int(inp.get("n", 0))
+    if n <= 0:
+        return None
+    return int(before) + n
+
+
 #: rule name -> pure derivation. `replay` and the live controller
 #: share these by construction — one source of truth.
 RULES = {
@@ -303,6 +357,9 @@ RULES = {
     "capacity.learn": _rule_capacity_learn,
     "capacity.seed": _rule_capacity_seed,
     "capacity.probe": _rule_capacity_probe,
+    "shed.cooldown": _rule_shed_cooldown,
+    "retry.budget": _rule_retry_budget,
+    "fleet.reclaim": _rule_fleet_reclaim,
 }
 
 #: the "expected effect" text journaled with each rule's decisions
@@ -330,6 +387,15 @@ EXPECTED = {
     "capacity.probe": ("a clean run earns the seeded key headroom "
                        "back toward the configured default — the "
                        "learned floor decays instead of ratcheting"),
+    "shed.cooldown": ("damp shed churn: back-to-back shed rebuilds "
+                      "cost a compile each and re-poison the fresh "
+                      "EWMA; a calm fleet earns responsiveness back"),
+    "retry.budget": ("fail deterministic blow-ups faster, grant "
+                     "recovering jobs headroom for the next "
+                     "transient upset"),
+    "fleet.reclaim": ("a dead rank's jobs were reclaimed by lease "
+                      "expiry and re-admitted from their checkpoint "
+                      "stems on this rank"),
 }
 
 
@@ -389,6 +455,8 @@ class Autopilot:
             "checkpoint_every": (max(1, int(ckpt_bounds[0])),
                                  max(1, int(ckpt_bounds[1]))),
             "audit_every": (0, max(16, self.audit0)),
+            "shed_cooldown": (1, 64),
+            "max_retries": (1, 8),
         }
         self.trip_warm = float(trip_warm)
         self.trip_cool = float(trip_cool)
@@ -426,6 +494,18 @@ class Autopilot:
         self._save_cost_base = self._save_cost_totals()
         self._rollback_base = self._rollback_totals()
         self._last_suspects = 0
+        # shed-churn observation state (the shed.cooldown rule) — the
+        # counter is process-global, so baseline at construction like
+        # the trip/save-cost series
+        self._last_sheds = float(telemetry.registry().counter_total(
+            "dccrg_fleet_slo_sheds_total"))
+        self._shed_clean = 0
+        self._shed0 = None  # the configured cooldown, from first sight
+        # per-job trip-history watermarks (the retry.budget rule
+        # re-evaluates a job only when its trip count moved)
+        self._retry_seen: dict = {}
+        #: cumulative elastic-fleet reclaims narrated in the journal
+        self.reclaims = 0
         # journal-driven cross-run warm start of the QUANTUM knob
         # (the capacity.learn/probe discipline): load_history recovers
         # the last run's journaled quantum.learn, the first tick
@@ -588,7 +668,17 @@ class Autopilot:
             self._clean = 0
         else:
             self._clean += 1
+        sheds = float(telemetry.registry().counter_total(
+            "dccrg_fleet_slo_sheds_total"))
+        new_sheds = int(sheds - self._last_sheds)
+        self._last_sheds = sheds
+        if new_sheds > 0:
+            self._shed_clean = 0
+        else:
+            self._shed_clean += 1
         return {
+            "new_sheds": new_sheds,
+            "shed_clean_streak": self._shed_clean,
             "slo_slack_min_s": (None if slack_min is None
                                 else round(float(slack_min), 9)),
             "quantum_latency_s": (None if lat is None
@@ -621,6 +711,8 @@ class Autopilot:
             self._warm_start_quantum(sched)
         self._tune_quantum(sched, inp)
         self._tune_audit(sched, inp)
+        self._tune_shed(sched, inp)
+        self._tune_retries(sched, inp)
         if self._tick % self.adjust_every == 0:
             self._tune_checkpoints(sched, inp)
         telemetry.set_gauge("dccrg_autopilot_quantum", self.quantum)
@@ -693,6 +785,55 @@ class Autopilot:
         if a != self.audit_every:
             self.audit_every = a
             sched.audit_every = a
+
+    def _tune_shed(self, sched, inp) -> None:
+        # the PR-12 carried item: the shed cooldown rides the same
+        # pure-rule machinery as every other knob — live value is the
+        # truth, only a journaled firing writes back
+        before = max(1, int(sched.slo.shed_cooldown))
+        if self._shed0 is None:
+            self._shed0 = before  # the configured baseline
+        lo, hi = self.bounds["shed_cooldown"]
+        new = self._apply(
+            "shed.cooldown", "shed_cooldown", before,
+            dict(inp, lo=lo, hi=hi, baseline=self._shed0,
+                 relax_after=self.relax_after))
+        if new != before:
+            sched.slo.shed_cooldown = new
+
+    def _tune_retries(self, sched, inp) -> None:
+        # the PR-12 carried item: per-job retry budgets from each
+        # job's OWN trip history, re-evaluated only when that history
+        # moved (event-driven — no per-tick churn toward a bound)
+        lo, hi = self.bounds["max_retries"]
+        for _b, _s, job in sched.active_jobs():
+            trips = len(job.trips)
+            if self._retry_seen.get(job.name) == trips or trips == 0:
+                continue
+            self._retry_seen[job.name] = trips
+            before = max(1, int(job.max_retries))
+            # job.retries is the scheduler's consecutive same-step
+            # streak (reset on progress); recovered = trips the job
+            # progressed past
+            new = self._apply(
+                "retry.budget", f"max_retries[{job.name}]", before,
+                {"repeat_trips": int(job.retries),
+                 "recovered": max(0, trips - int(job.retries)),
+                 "trips_total": trips, "lo": lo, "hi": hi})
+            if new != before:
+                job.max_retries = new
+
+    def record_reclaim(self, dead_rank, jobs, lease_s) -> None:
+        """An elastic-fleet reclaim happened on this rank: journal it
+        through the ``fleet.reclaim`` rule so ``explain`` narrates who
+        died and what was taken over, and ``replay`` re-derives the
+        cumulative count."""
+        jobs = sorted(str(j) for j in jobs)
+        after = self._apply(
+            "fleet.reclaim", "reclaims", int(self.reclaims),
+            {"n": len(jobs), "jobs": jobs, "dead_rank": int(dead_rank),
+             "lease_s": float(lease_s)})
+        self.reclaims = int(after)
 
     def _tune_checkpoints(self, sched, inp) -> None:
         lo, hi = self.bounds["checkpoint_every"]

@@ -36,6 +36,25 @@ rank synchronization through:
   multi-process checkpoint commit (checkpoint._save_process_slice):
   raised by the committing rank when a slice is missing or fails its
   CRC, with the previous checkpoint still intact under the final name.
+- :class:`Membership` — elastic fleet membership: every rank writes a
+  heartbeat lease into the coordination KV store
+  (``DCCRG_HEARTBEAT_S`` cadence), and peers classify each other
+  live/suspect/dead from the OBSERVED lease age (the observer's own
+  clock ages a value it saw stop changing — no cross-host clock
+  comparison, ``DCCRG_LEASE_S`` is the death bound).
+  :meth:`Membership.poll` / :meth:`Membership.detect_dead_ranks` are
+  deadline-bounded through :func:`run_with_deadline` so a wedged KV
+  read can never block the step loop — on expiry the caller keeps the
+  last view. A :class:`Membership` registered via
+  :func:`set_membership` upgrades barrier timeouts: a barrier whose
+  peer is DEAD by lease raises :class:`PeerDeadError` *naming the
+  rank* (a :class:`BarrierTimeoutError` subclass, so every existing
+  handler keeps working) instead of timing out and blaming a tag.
+- :class:`InMemoryKV` / :class:`CoordKV` — the KV store the
+  membership leases and the scheduler's job leases ride.
+  ``create()`` is first-writer-wins (the coordination service's
+  ``allow_overwrite=False`` IS a compare-and-set), which is what
+  makes a double-reclaim race resolve to exactly one winner.
 
 Everything degrades to a no-op on a single controller, so
 single-process code pays one ``process_count()`` check per call.
@@ -105,6 +124,27 @@ class CheckpointCommitError(RuntimeError):
         self.ranks = sorted({int(r) for r in ranks})
 
 
+class PeerDeadError(BarrierTimeoutError):
+    """A coordination point failed because one or more PEER RANKS are
+    dead by membership lease (no heartbeat within ``DCCRG_LEASE_S``) —
+    the detecting side of a host failure. Subclasses
+    :class:`BarrierTimeoutError` so every existing timeout handler
+    keeps working, but ``ranks`` names the culprits instead of the
+    barrier tag having to take the blame."""
+
+    def __init__(self, tag: str, timeout: float, ranks, lease_s=None):
+        ranks = sorted({int(r) for r in ranks})
+        lease = "" if lease_s is None else f" within {lease_s:g}s"
+        RuntimeError.__init__(
+            self,
+            f"barrier {tag!r}: peer rank(s) {ranks} are DEAD by "
+            f"membership lease (no heartbeat observed{lease}); their "
+            "jobs are reclaimable by the survivors")
+        self.tag = tag
+        self.timeout = timeout
+        self.ranks = ranks
+
+
 def barrier_timeout(default: float = DEFAULT_BARRIER_TIMEOUT) -> float:
     """The ``DCCRG_BARRIER_TIMEOUT`` env knob: seconds before a
     coordination barrier gives up on its peers."""
@@ -170,6 +210,12 @@ def barrier(tag: str, timeout: float | None = None) -> None:
     hang = faults.take_barrier_hang(tag)
     import jax
 
+    # the membership fast path: a peer the heartbeat leases already
+    # declared dead will never reach this barrier — raise the typed
+    # error NAMING the rank now instead of burning the full timeout
+    # (in-process fleets register a membership too, so the check
+    # precedes the single-controller early return)
+    _raise_if_peer_dead(tag, timeout, poll=False)
     real = jax.process_count() > 1
     if not real and hang is None:
         return
@@ -188,6 +234,7 @@ def barrier(tag: str, timeout: float | None = None) -> None:
                 msg = str(e)
                 if ("DEADLINE_EXCEEDED" in msg or "Barrier failed" in msg
                         or "heartbeat timeout" in msg):
+                    _raise_if_peer_dead(tag, timeout, poll=True)
                     raise BarrierTimeoutError(tag, timeout) from e
                 raise
 
@@ -207,6 +254,7 @@ def barrier(tag: str, timeout: float | None = None) -> None:
     finished, _res, err = run_with_deadline(_sync, timeout,
                                             f"barrier:{tag}")
     if not finished:
+        _raise_if_peer_dead(tag, timeout, poll=True)
         raise BarrierTimeoutError(tag, timeout)
     if err is not None:
         raise err
@@ -309,3 +357,364 @@ def broadcast_fatal(grid, code: int, timeout: float | None = None) -> None:
             "fatal trip code %d could not be broadcast within %.0fs "
             "(the mesh itself is unreachable); peers must rely on "
             "their own barrier timeouts", code, timeout)
+
+
+# ---------------------------------------------------------------------
+# elastic membership: heartbeat leases over the coordination KV store
+# ---------------------------------------------------------------------
+
+DEFAULT_HEARTBEAT_S = 2.0
+DEFAULT_LEASE_S = 8.0
+
+
+def heartbeat_seconds(default: float = DEFAULT_HEARTBEAT_S) -> float:
+    """The ``DCCRG_HEARTBEAT_S`` env knob: seconds between a rank's
+    heartbeat-lease renewals in the coordination KV store."""
+    try:
+        v = float(os.environ.get("DCCRG_HEARTBEAT_S", "") or default)
+    except ValueError:
+        v = default
+    return max(0.01, v)
+
+
+def lease_seconds(default: float | None = None) -> float:
+    """The ``DCCRG_LEASE_S`` env knob: seconds without an observed
+    heartbeat before a peer rank is declared DEAD (and its job leases
+    reclaimable). Clamped to at least two heartbeats — a lease shorter
+    than that would flap on ordinary scheduling jitter."""
+    hb = heartbeat_seconds()
+    fallback = DEFAULT_LEASE_S if default is None else float(default)
+    try:
+        v = float(os.environ.get("DCCRG_LEASE_S", "") or fallback)
+    except ValueError:
+        v = fallback
+    return max(2.0 * hb, v)
+
+
+class InMemoryKV:
+    """Process-local KV store with the coordination service's
+    compare-and-set semantics (:meth:`create` is first-writer-wins).
+    The single-process default, and the store the fake-clock
+    lease/fencing tests share between in-process 'ranks'."""
+
+    def __init__(self):
+        self._data: dict = {}
+        self._lock = threading.Lock()
+
+    def set(self, key: str, value: str) -> None:
+        with self._lock:
+            self._data[str(key)] = str(value)
+
+    def create(self, key: str, value: str) -> bool:
+        """Create ``key`` iff absent; False when another writer won
+        the race (THE compare-and-set the lease fencing rides)."""
+        with self._lock:
+            if str(key) in self._data:
+                return False
+            self._data[str(key)] = str(value)
+            return True
+
+    def get(self, key: str):
+        with self._lock:
+            return self._data.get(str(key))
+
+    def dir_get(self, prefix: str):
+        """Every ``(key, value)`` under ``prefix`` as a dict — the
+        one-call census the lease machinery prefers over per-key
+        reads (an ABSENT key costs a full blocking-get timeout on the
+        real service; a prefix listing only returns what exists)."""
+        prefix = str(prefix)
+        with self._lock:
+            return {k: v for k, v in self._data.items()
+                    if k.startswith(prefix)}
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(str(key), None)
+
+
+class CoordKV:
+    """The real ``jax.distributed`` coordination-service KV store.
+    ``create()`` maps to ``key_value_set`` WITHOUT overwrite — the
+    service rejects an existing key, which is the first-writer-wins
+    compare-and-set exactly one reclaimer may win. Reads use a short
+    blocking get (this jaxlib has no try-get); every operation
+    swallows service errors into None/False — a dying coordination
+    service must degrade into observed staleness (the failure mode
+    the lease machinery already handles), never a crash."""
+
+    #: how long a read waits for a key that may simply not exist yet
+    GET_TIMEOUT_MS = 100
+
+    def __init__(self, client):
+        self._client = client
+
+    def set(self, key: str, value: str) -> None:
+        try:
+            self._client.key_value_set(str(key), str(value),
+                                       allow_overwrite=True)
+        except TypeError:  # pragma: no cover - older jaxlib signature
+            try:
+                self._client.key_value_delete(str(key))
+            except Exception:  # noqa: BLE001 - best effort
+                pass
+            try:
+                self._client.key_value_set(str(key), str(value))
+            except Exception:  # noqa: BLE001 - best effort
+                pass
+        except Exception:  # noqa: BLE001 - degrade to staleness
+            pass
+
+    def create(self, key: str, value: str) -> bool:
+        try:
+            # no allow_overwrite: the service refuses an existing key
+            self._client.key_value_set(str(key), str(value))
+            return True
+        except Exception:  # noqa: BLE001 - lost the CAS (or no service)
+            return False
+
+    def get(self, key: str):
+        try:
+            return self._client.blocking_key_value_get(
+                str(key), self.GET_TIMEOUT_MS)
+        except Exception:  # noqa: BLE001 - absent key / dead service
+            return None
+
+    def dir_get(self, prefix: str):
+        """One prefix listing instead of N blocking gets (an absent
+        key costs the full GET_TIMEOUT_MS; a listing returns only
+        what exists). None on service error — the caller falls back
+        to per-key reads."""
+        try:
+            return dict(self._client.key_value_dir_get(str(prefix)))
+        except Exception:  # noqa: BLE001 - degrade to per-key reads
+            return None
+
+    def delete(self, key: str) -> None:
+        try:
+            self._client.key_value_delete(str(key))
+        except Exception:  # noqa: BLE001 - best effort
+            pass
+
+
+_LOCAL_KV: "InMemoryKV | None" = None
+
+
+def default_kv():
+    """The KV store leases ride: the coordination service's when
+    ``jax.distributed`` is initialized, else one process-global
+    :class:`InMemoryKV` (single-host serving needs no coordination,
+    but the code paths stay identical)."""
+    client = _coordination_client()
+    if client is not None:
+        return CoordKV(client)
+    global _LOCAL_KV
+    if _LOCAL_KV is None:
+        _LOCAL_KV = InMemoryKV()
+    return _LOCAL_KV
+
+
+class Membership:
+    """Elastic fleet membership over heartbeat leases.
+
+    Every rank :meth:`heartbeat`\\ s a monotonically bumped counter
+    into the KV under ``<prefix>/<rank>`` at the ``heartbeat_s``
+    cadence. :meth:`poll` reads every peer's key under a deadline
+    (:func:`run_with_deadline` — a wedged KV read keeps the LAST view
+    instead of blocking the step loop) and classifies each peer by
+    how long ago the OBSERVER saw its value change:
+
+    - ``live``    — changed within ``suspect_s`` (2 heartbeats);
+    - ``suspect`` — stale past ``suspect_s`` but short of the lease;
+    - ``dead``    — stale for ``lease_s`` or more: the rank's job
+      leases are reclaimable, and barriers involving it raise
+      :class:`PeerDeadError` instead of blaming a tag.
+
+    Aging is strictly observer-clock (no cross-host clock
+    comparison), ``clock`` is injectable (the fake-clock tests), and
+    a peer that starts heartbeating again flips back to live — the
+    elastic-regrow half of the contract. Every poll exports
+    ``dccrg_fleet_membership{state}`` gauges and logs state
+    transitions."""
+
+    LIVE, SUSPECT, DEAD = "live", "suspect", "dead"
+
+    def __init__(self, rank: int, n_ranks: int, *, kv=None,
+                 heartbeat_s=None, lease_s=None, clock=time.monotonic,
+                 prefix: str = "dccrg/hb"):
+        self.rank = int(rank)
+        self.n_ranks = max(1, int(n_ranks))
+        self.kv = kv if kv is not None else default_kv()
+        self.heartbeat_s = (heartbeat_seconds() if heartbeat_s is None
+                            else max(0.01, float(heartbeat_s)))
+        self.lease_s = max(2.0 * self.heartbeat_s,
+                           lease_seconds() if lease_s is None
+                           else float(lease_s))
+        self.suspect_s = min(2.0 * self.heartbeat_s, self.lease_s / 2.0)
+        self.clock = clock
+        self.prefix = str(prefix)
+        self._beat = 0
+        self._last_beat_t = None
+        self._auto = None
+        now = self.clock()
+        # a peer that has NEVER heartbeat gets the same full-lease
+        # grace from construction as one that just stopped — a slow
+        # starter is not a corpse
+        self._seen = {r: [None, now] for r in range(self.n_ranks)
+                      if r != self.rank}
+        self._state = {r: self.LIVE for r in self._seen}
+
+    def _key(self, rank: int) -> str:
+        return f"{self.prefix}/{int(rank)}"
+
+    def heartbeat(self, force: bool = False) -> bool:
+        """Renew this rank's lease (throttled to ``heartbeat_s``
+        unless ``force``); returns whether a write happened."""
+        now = self.clock()
+        if (not force and self._last_beat_t is not None
+                and now - self._last_beat_t < self.heartbeat_s):
+            return False
+        self._beat += 1
+        self.kv.set(self._key(self.rank), f"{self._beat}")
+        self._last_beat_t = now
+        return True
+
+    def start_auto(self) -> None:
+        """Start the daemon heartbeat thread (idempotent): liveness
+        must not ride the serving loop's stalls — an XLA compile
+        blocks a tick for seconds, and a compile is not a death. A
+        SIGSTOP/SIGKILL freezes/kills this thread with the process,
+        so the beats stop exactly when the host actually stops. Only
+        meaningful under a real clock (fake-clock tests drive
+        :meth:`heartbeat` by hand and never call this)."""
+        if self._auto is not None:
+            return
+        stop = threading.Event()
+
+        def _beat():
+            while not stop.wait(self.heartbeat_s):
+                try:
+                    self.heartbeat(force=True)
+                except Exception:  # noqa: BLE001 - beats are best-effort
+                    pass
+
+        t = threading.Thread(target=_beat, daemon=True,
+                             name="dccrg-heartbeat")
+        t.start()
+        self._auto = (t, stop)
+
+    def stop_auto(self) -> None:
+        if self._auto is not None:
+            self._auto[1].set()
+            self._auto = None
+
+    def _classify(self, age: float) -> str:
+        if age >= self.lease_s:
+            return self.DEAD
+        if age > self.suspect_s:
+            return self.SUSPECT
+        return self.LIVE
+
+    def poll(self, timeout: float | None = None) -> dict:
+        """One deadline-bounded membership scan; returns
+        ``{rank: state}`` for every peer. The KV reads run under
+        :func:`run_with_deadline` (budget: ``timeout``, default one
+        heartbeat, floor 50 ms) — on expiry the previous observations
+        stand and keep aging, so a wedged store reads as staleness,
+        never as a blocked step loop."""
+        from . import telemetry
+
+        budget = (max(0.05, self.heartbeat_s) if timeout is None
+                  else max(0.01, float(timeout)))
+        peers = list(self._seen)
+
+        def _read():
+            return [self.kv.get(self._key(r)) for r in peers]
+
+        finished, vals, err = run_with_deadline(_read, budget,
+                                                "membership-poll")
+        now = self.clock()
+        if finished and err is None and vals is not None:
+            for r, v in zip(peers, vals):
+                rec = self._seen[r]
+                if v is not None and v != rec[0]:
+                    rec[0], rec[1] = v, now
+        else:
+            telemetry.inc("dccrg_membership_poll_failures_total")
+        for r, rec in self._seen.items():
+            st = self._classify(now - rec[1])
+            if st != self._state[r]:
+                logger.warning(
+                    "fleet membership: rank %d %s -> %s (lease age "
+                    "%.2fs, lease bound %.2fs)", r, self._state[r], st,
+                    now - rec[1], self.lease_s)
+                telemetry.inc("dccrg_fleet_membership_transitions_total",
+                              rank=str(r), state=st)
+                self._state[r] = st
+        counts = {self.LIVE: 1, self.SUSPECT: 0, self.DEAD: 0}  # self
+        for st in self._state.values():
+            counts[st] += 1
+        for st, n in counts.items():
+            telemetry.set_gauge("dccrg_fleet_membership", n, state=st)
+        return dict(self._state)
+
+    def detect_dead_ranks(self, timeout: float | None = None) -> list:
+        """Deadline-bounded refresh + the ranks currently DEAD by
+        lease. Never blocks past the poll budget."""
+        self.poll(timeout=timeout)
+        return self.dead_ranks()
+
+    def state(self, rank: int) -> str:
+        """``live``/``suspect``/``dead`` (self is always live)."""
+        if int(rank) == self.rank:
+            return self.LIVE
+        return self._state.get(int(rank), self.DEAD)
+
+    def lease_age(self, rank: int) -> float:
+        """Seconds since this observer saw ``rank``'s lease change."""
+        rec = self._seen.get(int(rank))
+        return 0.0 if rec is None else self.clock() - rec[1]
+
+    def dead_ranks(self) -> list:
+        return sorted(r for r, s in self._state.items()
+                      if s == self.DEAD)
+
+    def live_ranks(self) -> list:
+        """Every rank not currently dead, self included — the rank
+        set the rank-aware scheduler partitions work over."""
+        return sorted([self.rank] + [r for r, s in self._state.items()
+                                     if s != self.DEAD])
+
+
+#: the process-wide membership barrier timeouts consult — None (the
+#: default) changes nothing anywhere
+_MEMBERSHIP: list = [None]
+
+
+def set_membership(m: "Membership | None") -> "Membership | None":
+    """Register (or clear) the process-wide :class:`Membership` the
+    barrier path consults; returns the previous one. With a
+    registered membership, a barrier whose peer is DEAD by lease
+    raises :class:`PeerDeadError` naming the rank instead of a bare
+    :class:`BarrierTimeoutError` blaming the tag."""
+    prev = _MEMBERSHIP[0]
+    _MEMBERSHIP[0] = m
+    return prev
+
+
+def get_membership() -> "Membership | None":
+    return _MEMBERSHIP[0]
+
+
+def _raise_if_peer_dead(tag: str, timeout: float, poll: bool) -> None:
+    """Raise :class:`PeerDeadError` when the registered membership
+    (if any) knows of dead peers. ``poll=True`` refreshes the view
+    first (bounded — this runs on the timeout path, where the barrier
+    budget is already spent)."""
+    m = _MEMBERSHIP[0]
+    if m is None:
+        return
+    dead = (m.detect_dead_ranks(timeout=min(2.0, m.heartbeat_s * 2))
+            if poll else m.dead_ranks())
+    if dead:
+        raise PeerDeadError(tag, timeout, dead, lease_s=m.lease_s)

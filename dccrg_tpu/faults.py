@@ -135,7 +135,8 @@ class _Rule:
     def matches(self, site: str, ctx: dict) -> bool:
         if self.site != site or self.fired >= self.times:
             return False
-        for key in ("mode", "step", "phase", "tag", "rank", "job"):
+        for key in ("mode", "step", "phase", "tag", "rank", "job",
+                    "tick"):
             want = self.params.get(key)
             if want is None:
                 continue
@@ -352,6 +353,22 @@ class FaultPlan:
         sidecar lands). ``rank`` narrows to one rank's pass."""
         return self._add(site, "rank_death", times, phase=phase, rank=rank)
 
+    def host_death(self, rank=None, at_tick=None, times=1):
+        """This HOST dies at a fleet-scheduler tick boundary — the
+        elastic-fleet fault class (whole-rank loss mid-serve, outside
+        any checkpoint barrier). Queried — not raised — through
+        :func:`take_host_death` by
+        :class:`~dccrg_tpu.scheduler.FleetScheduler`, which raises
+        :class:`InjectedRankDeath` when it fires: in-process tests
+        catch it at the loop boundary and drive the SURVIVOR
+        scheduler's lease-expiry reclaim; the REAL harness
+        (tests/mp_harness.py ``host_death``) instead delivers an
+        actual ``kill -9`` to the worker rank's OS process — same
+        recovery contract, real corpse. ``rank``/``at_tick`` narrow
+        to one rank's pass / one tick boundary."""
+        return self._add("fleet.host", "host_death", times, rank=rank,
+                         tick=at_tick)
+
     def mutation_error(self, site="adapt.commit", times=1, phase=None):
         """Fault inside a structural mutation. Sites (each names where
         in the commit the failure lands; ``phase`` narrows to one):
@@ -446,6 +463,23 @@ def take_barrier_hang(tag: str):
     plan.log.append(("coord.barrier_hang", "hang", {"tag": tag}))
     hang = rule.params.get("hang_s")
     return math.inf if hang is None else float(hang)
+
+
+def take_host_death(rank: int, tick: int) -> bool:
+    """Consume a scheduled :meth:`~FaultPlan.host_death` for this
+    rank's tick boundary; True when one fired. Queried — not raised —
+    by the fleet scheduler so the caller decides how to die (raise
+    :class:`InjectedRankDeath` in-process; the mp harness maps it to a
+    hard OS exit)."""
+    plan = _active
+    if plan is None:
+        return False
+    rule = plan._take("fleet.host", {"rank": rank, "tick": tick})
+    if rule is None:
+        return False
+    plan.log.append(("fleet.host", "host_death",
+                     {"rank": rank, "tick": tick}))
+    return True
 
 
 def take_delta_parent_corrupt() -> bool:

@@ -1600,7 +1600,16 @@ class ResilientRunner:
 
         if self._ckpt_step is None:
             self._save()  # rollback target always exists
+        membership = coord.get_membership()
         while self.step < n_steps:
+            if membership is not None:
+                # elastic-fleet liveness: renew this rank's heartbeat
+                # lease at step boundaries (throttled to the heartbeat
+                # cadence), so peers classify a healthy-but-busy rank
+                # live instead of reclaiming its work — and a rank
+                # that stops beating surfaces to THEM as a typed
+                # PeerDeadError naming it, not a barrier-tag timeout
+                membership.heartbeat()
             code, details = 0, None
             try:
                 self.step_fn(self.grid, self.step)

@@ -54,7 +54,30 @@ per-run lifecycle machinery of :mod:`dccrg_tpu.supervise` PER JOB:
   :class:`FleetPreemptedError` surfaces with the resumable exit code
   75 — rerunning the scheduler over the same directory resumes every
   job from its checkpoint (``resume=True``), bitwise identical to an
-  uninterrupted fleet.
+  uninterrupted fleet;
+- **elastic multi-host fleet** (``rank_aware=True`` /
+  ``DCCRG_RANK_AWARE=1``): schedulers on several hosts serve ONE job
+  set over a shared checkpoint directory. Each rank heartbeats a
+  :class:`~dccrg_tpu.coord.Membership` lease, every admitted job
+  records an owner rank + **lease epoch** in the shared KV
+  (:class:`JobLeases`), and leases renew at tick boundaries. A rank
+  observing a peer's lease EXPIRED (no renewal for ``DCCRG_LEASE_S``
+  of the observer's own clock) **reclaims** the job: a
+  compare-and-set on the next epoch's claim key means exactly one
+  survivor wins, and the winner re-admits the job from its
+  checkpoint stem through the proven ``_load_newest``/``_admit_into``
+  path — bitwise identical to an uninterrupted run. Fencing: the
+  epoch is checked before EVERY save publish, so a paused-then-
+  resumed zombie owner gets a typed :class:`OwnershipLostError` and
+  drops the job locally (no rollback side effects, no stale
+  checkpoint ever lands over the reclaimer's chain). The pending
+  queue partitions across live ranks (deterministic hash +
+  load-balance by projected completion from the SLO EWMAs); a
+  shrunk fleet degrades to single-host serving with a logged
+  membership transition, and a rejoining rank re-enters the
+  partition at the next tick. OFF by default: without the flag no
+  membership/lease object exists and scheduling is bitwise identical
+  to the rank-unaware scheduler (the negative pin).
 """
 
 from __future__ import annotations
@@ -64,17 +87,279 @@ import itertools
 import logging
 import os
 import time
+import zlib
 from contextlib import nullcontext
 
 import numpy as np
 
 from . import autopilot as autopilot_mod
-from . import faults, integrity, resilience, supervise, telemetry
+from . import coord, faults, integrity, resilience, supervise, telemetry
 from .fleet import (SHADOW, FleetJob, GridBatch, max_batch_default,
                     quantum_default)
 from .grid import bucket_capacity
 
 logger = logging.getLogger("dccrg_tpu.scheduler")
+
+
+def rank_aware_default(default: bool = False) -> bool:
+    """The ``DCCRG_RANK_AWARE`` env knob: ``1`` makes the fleet
+    scheduler rank-aware (membership heartbeats, lease-based job
+    ownership, orphan reclaim). Off (default): no membership or lease
+    object exists and scheduling is bitwise identical to the
+    rank-unaware scheduler."""
+    v = os.environ.get("DCCRG_RANK_AWARE", "")
+    if v == "":
+        return default
+    return v not in ("0", "off", "false", "no")
+
+
+class OwnershipLostError(RuntimeError):
+    """This rank's lease on a fleet job was FENCED by a higher epoch:
+    a survivor reclaimed the job (this rank's renewals stopped for
+    ``DCCRG_LEASE_S`` — paused, partitioned, or presumed dead) and
+    owns its checkpoint stem now. The job must be dropped locally
+    WITHOUT rollback side effects — publishing anything over the
+    reclaimer's chain is exactly what the epoch fence exists to
+    prevent."""
+
+    def __init__(self, job, rank, held_epoch, current):
+        super().__init__(
+            f"lease on fleet job {job!r} lost: rank {rank} holds epoch "
+            f"{held_epoch}, but the shared KV records {current!r} — a "
+            "survivor reclaimed the job; dropping it locally (the "
+            "reclaimer's checkpoint chain is the live one)")
+        self.job = str(job)
+        self.rank = int(rank)
+        self.held_epoch = held_epoch
+        self.current = current
+
+
+class JobLeases:
+    """Lease-based job ownership with epoch fencing over the
+    coordination KV store (:func:`dccrg_tpu.coord.default_kv`).
+
+    KV layout per job name::
+
+        <prefix>/<name>          -> "<rank>:<epoch>:<beat>"
+        <prefix>/<name>@<epoch>  -> "<rank>"   (the reclaim claim)
+        <prefix>/done/<name>     -> "<status>:<rank>:<steps>:<digest>"
+
+    The lease value's ``beat`` bumps on every renewal; expiry is
+    judged by OBSERVER aging (the :class:`~dccrg_tpu.coord.Membership`
+    discipline — the observer's own clock ages a value it saw stop
+    changing, no cross-host clock comparison). Takeover is a
+    compare-and-set: :meth:`try_reclaim` CAS-creates the claim key
+    for the NEXT epoch, and the KV's first-writer-wins guarantees
+    exactly one survivor wins a given epoch. :meth:`check` is the
+    fencing gate consulted before every save publish and renewal —
+    a claim key above the held epoch (or a higher-epoch lease record)
+    raises the typed :class:`OwnershipLostError`, so a zombie whose
+    renew overwrote the lease VALUE still cannot publish: the claim
+    key it can never un-create convicts it."""
+
+    def __init__(self, kv, rank: int, *, lease_s=None,
+                 clock=time.monotonic, prefix: str = "dccrg/job"):
+        self.kv = kv
+        self.rank = int(rank)
+        self.lease_s = (coord.lease_seconds() if lease_s is None
+                        else float(lease_s))
+        self.clock = clock
+        self.prefix = str(prefix)
+        self.owned: dict = {}   # name -> held epoch
+        self._beat = 0
+        self._watch: dict = {}  # name -> [raw value, first-seen clock]
+
+    def _key(self, name) -> str:
+        return f"{self.prefix}/{name}"
+
+    def census(self):
+        """One-call snapshot of every lease/claim/done key under the
+        prefix, or None when the KV cannot list (callers then fall
+        back to per-key reads). On the real coordination service an
+        ABSENT key costs a full blocking-get timeout, so the tick
+        path reads the census once instead of per-key; publish-time
+        fencing (:meth:`check` from ``_save_job``/``_finish``) stays
+        on fresh per-key reads."""
+        dir_get = getattr(self.kv, "dir_get", None)
+        if dir_get is None:
+            return None
+        raw = dir_get(self.prefix)
+        if raw is None:
+            return None
+        # the service may list relative child names; normalize to
+        # full keys so lookups are uniform
+        p = self.prefix.rstrip("/") + "/"
+        return {(str(k) if str(k).startswith(p) else p + str(k)): v
+                for k, v in raw.items()}
+
+    def _read(self, key, census=None):
+        return census.get(key) if census is not None \
+            else self.kv.get(key)
+
+    @staticmethod
+    def _parse(raw):
+        try:
+            r, e, b = str(raw).split(":")
+            return int(r), int(e), int(b)
+        except (ValueError, TypeError, AttributeError):
+            return None
+
+    def _write(self, name, epoch) -> None:
+        self._beat += 1
+        self.kv.set(self._key(name),
+                    f"{self.rank}:{int(epoch)}:{self._beat}")
+
+    def acquire(self, name) -> int:
+        """Own ``name`` at admission; returns the held epoch. A fresh
+        job CAS-creates epoch 1; this rank's own surviving record (a
+        restarted scheduler, a requeue) is adopted after the fencing
+        check. A lease held by ANOTHER rank raises
+        :class:`OwnershipLostError` — expiry takeovers go through
+        :meth:`try_reclaim`, never through admission."""
+        name = str(name)
+        held = self.owned.get(name)
+        if held is not None:
+            self.check(name)
+            self._write(name, held)
+            return held
+        if self.kv.create(self._key(name), f"{self.rank}:1:0"):
+            self.owned[name] = 1
+            return 1
+        raw = self.kv.get(self._key(name))
+        cur = self._parse(raw)
+        if cur is not None and cur[0] == self.rank:
+            self.owned[name] = cur[1]
+            self.check(name)
+            self._write(name, cur[1])
+            return cur[1]
+        raise OwnershipLostError(name, self.rank, None, raw)
+
+    def check(self, name, census=None) -> None:
+        """The fencing gate (consulted before EVERY save publish):
+        raise :class:`OwnershipLostError` — and forget the lease
+        locally — when a reclaimer's claim key for the next epoch
+        exists or the lease record carries a higher epoch / another
+        rank at ours. ``census`` serves the reads on the tick path;
+        publish-time callers pass None for fresh per-key reads."""
+        name = str(name)
+        held = self.owned.get(name)
+        if held is None:
+            raise OwnershipLostError(
+                name, self.rank, None,
+                self._read(self._key(name), census))
+        claim = self._read(f"{self._key(name)}@{held + 1}", census)
+        if claim is not None:
+            self.owned.pop(name, None)
+            raise OwnershipLostError(
+                name, self.rank, held,
+                f"epoch {held + 1} claimed by rank {claim}")
+        cur = self._parse(self._read(self._key(name), census))
+        if cur is not None and (cur[1] > held
+                                or (cur[1] == held
+                                    and cur[0] != self.rank)):
+            self.owned.pop(name, None)
+            raise OwnershipLostError(name, self.rank, held,
+                                     f"{cur[0]}:{cur[1]}")
+
+    def renew(self, name, census=None) -> None:
+        """Renew one owned lease (tick boundaries); the fencing check
+        runs first, so a fenced zombie learns before it writes."""
+        self.check(name, census)
+        self._write(name, self.owned[str(name)])
+
+    def renew_owned(self, census=None) -> list:
+        """Renew every owned lease; returns the ``[(name, error)]``
+        fenced ones (reclaimed while this rank was paused)."""
+        lost = []
+        for name in sorted(self.owned):
+            try:
+                self.renew(name, census)
+            except OwnershipLostError as e:
+                lost.append((name, e))
+        return lost
+
+    def release(self, name) -> None:
+        """Stop renewing (the job finished; the done marker, not the
+        lease, is its terminal record)."""
+        self.owned.pop(str(name), None)
+
+    def holder(self, name, census=None):
+        """The rank the KV currently records as owner, or None."""
+        cur = self._parse(self._read(self._key(str(name)), census))
+        return None if cur is None else cur[0]
+
+    def expired_holder(self, name, census=None):
+        """Observer-aged expiry: the OTHER rank whose lease on
+        ``name`` has not changed for ``lease_s``, else None. A fresh
+        observer grants the current value a full lease of grace."""
+        name = str(name)
+        raw = self._read(self._key(name), census)
+        if raw is None:
+            return None
+        now = self.clock()
+        rec = self._watch.get(name)
+        if rec is None or rec[0] != raw:
+            self._watch[name] = rec = [raw, now]
+        cur = self._parse(raw)
+        if cur is None or cur[0] == self.rank:
+            return None
+        return cur[0] if now - rec[1] >= self.lease_s else None
+
+    def try_reclaim(self, name):
+        """Fenced takeover of an expired lease: CAS-create the claim
+        key for the NEXT epoch of the lease value this observer
+        actually watched expire (exactly one survivor can — the KV's
+        first-writer-wins IS the compare-and-set), then rewrite the
+        lease record at that epoch. Returns the new held epoch, or
+        None when another survivor won — a takeover that already
+        happened shows as a moved value, which must age a fresh full
+        lease before anyone may claim it again."""
+        name = str(name)
+        rec = self._watch.get(name)
+        raw = (rec[0] if rec is not None
+               else self.kv.get(self._key(name)))
+        cur = self._parse(raw)
+        if cur is None:
+            # the owner died before its lease record ever landed
+            if self.kv.create(self._key(name), f"{self.rank}:1:0"):
+                self.owned[name] = 1
+                return 1
+            return None
+        live = self.kv.get(self._key(name))
+        if live != raw:
+            # the record moved since expiry was judged (another
+            # survivor's takeover, or a late renew): not ours to take
+            if live is not None:
+                self._watch[name] = [live, self.clock()]
+            return None
+        now = self.clock()
+        nxt = cur[1] + 1
+        for _ in range(64):  # bound far above any real claim chain
+            if self.kv.create(f"{self._key(name)}@{nxt}",
+                              str(self.rank)):
+                break
+            # the claim key exists but the lease record we just read
+            # is UNMOVED: either its creator won microseconds ago and
+            # is about to rewrite the record, or it died in the two-
+            # write window (claim created, record never rewritten) —
+            # which would otherwise leave the job unreclaimable
+            # FOREVER (every survivor's CAS at this epoch loses).
+            # Give the claimant one full lease from first sight of
+            # its claim, then escalate past the orphaned epoch.
+            ck = f"{self._key(name)}@{nxt}"
+            rec = self._watch.get(ck)
+            if rec is None:
+                self._watch[ck] = [self.kv.get(ck), now]
+                return None
+            if now - rec[1] < self.lease_s:
+                return None
+            nxt += 1
+        else:
+            return None
+        self.owned[name] = nxt
+        self._watch.pop(name, None)
+        self._write(name, nxt)
+        return nxt
 
 
 class SLOPolicy:
@@ -229,7 +514,7 @@ class FleetScheduler:
                  resume=True, devices=None,
                  install_signal_handlers=False, audit_every=None,
                  quarantine_after=None, slo_policy=None,
-                 autopilot=None):
+                 autopilot=None, rank_aware=None, membership=None):
         self.dir = str(checkpoint_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.max_batch = (max_batch_default() if max_batch is None
@@ -283,6 +568,44 @@ class FleetScheduler:
         self._next_dev = 0
         self.report: dict = {}
         self.ticks = 0
+        # elastic multi-host fleet: OFF by default — membership and
+        # leases stay None and the serving loop takes ZERO new
+        # branches, so rank-unaware scheduling (and rank-aware with a
+        # single live rank) is bitwise identical to the pre-elastic
+        # scheduler (the negative pin in tests/test_fleet_elastic.py)
+        if rank_aware is None:
+            rank_aware = membership is not None or rank_aware_default()
+        self.rank_aware = bool(rank_aware)
+        self.membership = None
+        self.leases = None
+        self._remote: dict = {}  # name -> parked (prio, seq, job) entry
+        self._degraded = False
+        if self.rank_aware:
+            if membership is None:
+                import jax
+
+                membership = coord.Membership(int(jax.process_index()),
+                                              int(jax.process_count()))
+            self.membership = membership
+            self.leases = JobLeases(
+                membership.kv, membership.rank,
+                lease_s=membership.lease_s, clock=membership.clock)
+            import jax
+
+            if jax.process_count() > 1:
+                # barriers anywhere in this process now name a dead
+                # rank (PeerDeadError) instead of blaming a tag.
+                # Registered only on REAL multi-process runtimes — an
+                # in-process fake fleet (tests, bench --hosts) must
+                # not leak its toy membership into the process-global
+                # barrier path
+                coord.set_membership(membership)
+            membership.heartbeat(force=True)
+            if membership.clock is time.monotonic:
+                # real clock: beats ride a daemon thread, so a
+                # seconds-long XLA compile mid-tick is never read as
+                # a death (fake-clock tests beat by hand)
+                membership.start_auto()
         for j in jobs:
             self.add(j)
 
@@ -389,6 +712,19 @@ class FleetScheduler:
                 if batch is None:
                     deferred.append(item)
                     continue
+                if self.leases is not None:
+                    # ownership is recorded at ADMISSION: the lease
+                    # CAS arbitrates any transient partition
+                    # disagreement between ranks — the loser parks
+                    # the job and watches the winner's lease instead
+                    try:
+                        self.leases.acquire(job.name)
+                    except OwnershipLostError as e:
+                        logger.info(
+                            "fleet job %s: admission lost the lease "
+                            "race (%s); parking as remote", job.name, e)
+                        self._remote[job.name] = item
+                        continue
                 self._admit_into(batch, job)
                 admitted += 1
             for item in deferred:
@@ -430,7 +766,10 @@ class FleetScheduler:
         if restored is None:
             # the rollback target always exists (the ResilientRunner
             # invariant, per job): a step-0 keyframe before stepping
-            self._save_job(batch, slot, job, force_keyframe=True)
+            try:
+                self._save_job(batch, slot, job, force_keyframe=True)
+            except OwnershipLostError as e:
+                self._drop_lost(batch, slot, job, e)
 
     def _purge_stem(self, store, job) -> None:
         """Delete every checkpoint (and sidecar) of ``job``'s stem —
@@ -475,9 +814,205 @@ class FleetScheduler:
             return int(step)
         return None
 
+    # -- elastic multi-host: membership, leases, reclaim --------------
+
+    def _job_cost(self, job) -> float:
+        """Projected completion cost for the rank partition: remaining
+        quanta x the bucket key's SLO EWMA (1.0 per quantum when
+        unmeasured, so unmeasured fleets balance by quantum count)."""
+        lat = self.slo.quantum_latency(job.bucket_key())
+        remaining = max(1, job.n_steps - job.steps_done)
+        quanta = -(-remaining // max(1, self.quantum))  # ceil
+        return quanta * (lat if lat is not None else 1.0)
+
+    def _rank_tick(self) -> None:
+        """The rank-aware tick-boundary pass: heartbeat + membership
+        poll (deadline-bounded — never blocks the serving loop), owned
+        lease renewal (a fenced lease drops its job locally, the
+        zombie discipline), the remote scan (done markers, lease
+        aging, orphan reclaim) and the pending-queue partition."""
+        m = self.membership
+        with telemetry.span("fleet.membership"):
+            m.heartbeat()
+            m.poll()
+        live = m.live_ranks()
+        if len(live) == 1 and m.n_ranks > 1 and not self._degraded:
+            self._degraded = True
+            logger.warning(
+                "fleet membership: all %d peer rank(s) dead — "
+                "degrading to single-host serving on rank %d",
+                m.n_ranks - 1, m.rank)
+        elif self._degraded and len(live) > 1:
+            self._degraded = False
+            logger.warning(
+                "fleet membership: peer rank(s) rejoined — elastic "
+                "regrow to %d live rank(s)", len(live))
+        # one KV prefix listing serves every tick-path read (absent
+        # keys cost a full blocking-get timeout on the real service;
+        # publish-time fencing stays on fresh per-key reads)
+        census = self.leases.census()
+        for name, err in self.leases.renew_owned(census=census):
+            self._drop_lost_by_name(name, err)
+        holders = self._scan_remote(census)
+        self._partition_queue(live, holders, census)
+
+    def _drop_lost_by_name(self, name, err) -> None:
+        for b, s, j in self.active_jobs():
+            if j.name == name:
+                self._drop_lost(b, s, j, err)
+                return
+        job = self._by_name.get(name)
+        if job is not None:
+            self._drop_lost(None, None, job, err)
+
+    def _drop_lost(self, batch, slot, job, err) -> None:
+        """The zombie discipline: a fenced job is dropped locally
+        WITHOUT rollback side effects (no save, no load, no requeue —
+        the reclaimer's checkpoint chain is the live one) and tracked
+        as remote until its done marker appears."""
+        logger.warning("fleet job %s dropped: %s", job.name, err)
+        telemetry.inc("dccrg_fleet_ownership_lost_total", job=job.name)
+        if batch is not None and slot is not None \
+                and batch.slots[slot] is job:
+            batch.clear(slot)
+        job.status = "lost"
+        self.leases.release(job.name)
+        if job.name not in self._remote:
+            self._remote[job.name] = (-job.priority, next(self._seq),
+                                      job)
+
+    def _note_remote_done(self, name, job, raw) -> None:
+        parts = (str(raw).split(":", 3) + ["", "", "", ""])[:4]
+        status, rank_s, steps_s, digest = parts
+        job.status = status
+        job.digest = (digest or None) if status == "done" else None
+        self.report[name] = {
+            "status": status, "steps": int(steps_s or 0),
+            "digest": job.digest, "trips": 0, "sdc_trips": 0,
+            "retries_final": 0, "requeues": job.requeues,
+            "transient_retries": 0, "rollbacks": 0,
+            "slo_ms": job.slo_ms, "slo_met": None,
+            "owner_rank": int(rank_s or -1), "remote": True,
+        }
+
+    def _scan_remote(self, census=None) -> dict:
+        """One pass over the jobs other ranks own: resolve done
+        markers into report rows, age the live leases, and RECLAIM the
+        expired ones — the CAS claim key means exactly one survivor
+        wins, and the winner requeues the job locally so the next
+        admission pass re-admits it from its checkpoint stem. Returns
+        the ``{name: holder_rank}`` census of still-live remote
+        leases (the partition's load input)."""
+        ls = self.leases
+        holders = {}
+        for name, entry in list(self._remote.items()):
+            job = entry[2]
+            raw = ls._read(f"{ls.prefix}/done/{name}", census)
+            if raw is not None:
+                self._note_remote_done(name, job, raw)
+                del self._remote[name]
+                continue
+            holder = ls.holder(name, census)
+            if holder == ls.rank:
+                # a job THIS rank holds the lease on must never idle
+                # in the remote set (a reclaim raced the partition):
+                # requeue it locally — nobody else may serve it
+                del self._remote[name]
+                job.status = "queued"
+                heapq.heappush(self._queue, entry)
+                continue
+            if holder is None:
+                continue  # unclaimed: the partition decides below
+            dead = ls.expired_holder(name, census)
+            if dead is None or self.membership.state(dead) \
+                    != coord.Membership.DEAD:
+                # reclaim needs BOTH signals: the job lease expired
+                # AND the holder's failure domain is dead by
+                # membership — a live rank stalled in a long restore
+                # keeps its work (the epoch fence would make a
+                # spurious reclaim safe, but not free)
+                holders[name] = holder
+                continue
+            t0 = time.perf_counter()
+            with telemetry.span("fleet.reclaim"):
+                epoch = ls.try_reclaim(name)
+            if epoch is None:
+                continue  # another survivor won; visible next tick
+            age = round(ls.lease_s, 6)
+            logger.warning(
+                "fleet job %s: lease of rank %d expired (>= %gs "
+                "without renewal); RECLAIMED at epoch %d — re-"
+                "admitting from its checkpoint stem", name, dead,
+                ls.lease_s, epoch)
+            telemetry.inc("dccrg_fleet_reclaims_total", job=name)
+            telemetry.observe("dccrg_fleet_reclaim_seconds",
+                              time.perf_counter() - t0)
+            job.requeues += 1
+            job.status = "queued"
+            del self._remote[name]
+            heapq.heappush(self._queue, entry)
+            if self.autopilot is not None:
+                self.autopilot.record_reclaim(dead, [name], age)
+        return holders
+
+    def _partition_queue(self, live, holders, census=None) -> None:
+        """Deterministic rank assignment of every UNCLAIMED pending
+        job (queued here, or parked remote with no live lease):
+        greedy least-projected-load over the live ranks, biggest job
+        first, stable crc32 tiebreaks — every rank derives the same
+        map from the same observed inputs, and the admission-time
+        lease CAS arbitrates any transient disagreement (the loser
+        parks the job back as remote). Jobs another rank holds a LIVE
+        lease on are never touched. A single live rank keeps the
+        exact heap entries — bitwise the rank-unaware admission
+        order."""
+        pool = []
+        while self._queue:
+            pool.append(heapq.heappop(self._queue))
+        for name in list(self._remote):
+            if (name not in holders and self._remote[name][2].status
+                    == "queued"
+                    and self.leases.holder(name, census) is None):
+                pool.append(self._remote.pop(name))
+        if len(live) <= 1:
+            for entry in pool:
+                heapq.heappush(self._queue, entry)
+            return
+        loads = {r: 0.0 for r in live}
+        me = self.membership.rank
+        for name, holder in holders.items():
+            if holder in loads:
+                loads[holder] += self._job_cost(self._remote[name][2])
+        for _b, _s, j in self.active_jobs():
+            loads[me] += self._job_cost(j)
+        pool.sort(key=lambda e: (-self._job_cost(e[2]),
+                                 zlib.crc32(e[2].name.encode()),
+                                 e[2].name))
+        for entry in pool:
+            job = entry[2]
+            if job.name in self.leases.owned:
+                # a lease THIS rank already holds (a reclaim, a
+                # requeue) pins the job local — the partition only
+                # places unclaimed work
+                loads[me] += self._job_cost(job)
+                heapq.heappush(self._queue, entry)
+                continue
+            tgt = min(live, key=lambda r: (
+                loads[r], zlib.crc32(f"{job.name}:{r}".encode())))
+            loads[tgt] += self._job_cost(job)
+            if tgt == me:
+                heapq.heappush(self._queue, entry)
+            else:
+                self._remote[job.name] = entry
+
     # -- per-job checkpointing + retention ----------------------------
 
     def _save_job(self, batch, slot, job, force_keyframe=False) -> None:
+        if self.leases is not None:
+            # the epoch fence: NEVER publish into a stem a reclaimer
+            # owns — a stale owner surfaces the typed
+            # OwnershipLostError here, before any bytes move
+            self.leases.check(job.name)
         with telemetry.tags(job=job.name):
             g = batch.write_grid(slot)
             store = self.store_for(job)
@@ -540,7 +1075,11 @@ class FleetScheduler:
             # is intact — keyframe it (same premise as _batch_oom /
             # _preempt) so re-admission resumes from here instead of
             # replaying everything since the last periodic save
-            self._save_job(batch, slot, job, force_keyframe=True)
+            try:
+                self._save_job(batch, slot, job, force_keyframe=True)
+            except OwnershipLostError as e:
+                self._drop_lost(batch, slot, job, e)
+                return
             batch.clear(slot)
             job.requeues += 1
             self.add(job)
@@ -572,6 +1111,15 @@ class FleetScheduler:
         job.last_save_step = restored
 
     def _finish(self, batch, slot, job, status="done") -> None:
+        if self.leases is not None:
+            try:
+                # the done marker is a publish too: a fenced zombie
+                # completing a quantum must not write the terminal
+                # record over the job a reclaimer is still serving
+                self.leases.check(job.name)
+            except OwnershipLostError as e:
+                self._drop_lost(batch, slot, job, e)
+                return
         if status == "done":
             job.digest = batch.digest(slot)
         job.status = status
@@ -594,6 +1142,16 @@ class FleetScheduler:
             "rollbacks": job.rollbacks,
             "slo_ms": job.slo_ms, "slo_met": slo_met,
         }
+        if self.leases is not None:
+            # the terminal record peers wait on: the done marker
+            # replaces the lease (renewals stop; a done job is never
+            # reclaimed)
+            self.report[job.name]["owner_rank"] = self.membership.rank
+            self.leases.kv.set(
+                f"{self.leases.prefix}/done/{job.name}",
+                f"{status}:{self.membership.rank}:{job.steps_done}:"
+                f"{job.digest or '-'}")
+            self.leases.release(job.name)
 
     # -- one bucket quantum -------------------------------------------
 
@@ -728,7 +1286,10 @@ class FleetScheduler:
             elif (job.checkpoint_every > 0 and job.last_save_step
                   is not None and job.steps_done - job.last_save_step
                   >= job.checkpoint_every):
-                self._save_job(batch, slot, job)
+                try:
+                    self._save_job(batch, slot, job)
+                except OwnershipLostError as e:
+                    self._drop_lost(batch, slot, job, e)
 
     def _fault_cells(self, batch, cells):
         """Resolve a fault rule's ``cells=None`` to one seeded local
@@ -1043,7 +1604,11 @@ class FleetScheduler:
         from here instead of replaying since the last periodic save
         (shared by the batch-OOM and SLO-shed paths)."""
         for slot, job in victims:
-            self._save_job(batch, slot, job, force_keyframe=True)
+            try:
+                self._save_job(batch, slot, job, force_keyframe=True)
+            except OwnershipLostError as e:
+                self._drop_lost(batch, slot, job, e)
+                continue
             batch.clear(slot)
             job.requeues += 1
             self.add(job)
@@ -1146,8 +1711,12 @@ class FleetScheduler:
             for insts in self.buckets.values():
                 for batch in insts:
                     for slot, job in batch.jobs:
-                        self._save_job(batch, slot, job,
-                                       force_keyframe=True)
+                        try:
+                            self._save_job(batch, slot, job,
+                                           force_keyframe=True)
+                        except OwnershipLostError as e:
+                            self._drop_lost(batch, slot, job, e)
+                            continue
                         batch.clear(slot)
                         job.requeues += 1
                         self.add(job)
@@ -1192,6 +1761,16 @@ class FleetScheduler:
                 if (supervise.preempt_requested()
                         or faults.take_preempt(self.ticks)):
                     self._preempt()
+                if faults.active() is not None and faults.take_host_death(
+                        self.membership.rank if self.membership else 0,
+                        self.ticks):
+                    # the in-process honoring of FaultPlan.host_death
+                    # (the mp harness lets InjectedRankDeath hard-exit
+                    # the OS process — an actual dead host)
+                    raise faults.InjectedRankDeath(
+                        f"injected host death at tick {self.ticks}")
+                if self.rank_aware:
+                    self._rank_tick()
                 self._admit_pending()
                 active = [b for insts in self.buckets.values()
                           for b in insts if b.jobs]
@@ -1200,6 +1779,18 @@ class FleetScheduler:
                         raise RuntimeError(
                             "fleet wedged: queued jobs but no bucket "
                             "can admit them")
+                    if self.rank_aware and self._remote:
+                        # local work drained but the FLEET has not:
+                        # idle at a fraction of the heartbeat cadence,
+                        # watching the remote leases (the rank tick
+                        # above reclaims on expiry) and done markers
+                        self.ticks += 1
+                        if max_ticks is not None \
+                                and self.ticks >= int(max_ticks):
+                            break
+                        time.sleep(min(0.05,
+                                       self.membership.heartbeat_s / 4))
+                        continue
                     if self.autopilot is not None:
                         # a clean drain: seeded keys that never
                         # OOMed/shed earn their capacity floor back

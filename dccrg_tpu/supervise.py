@@ -1057,6 +1057,13 @@ class SupervisedRunner:
     # -- step dispatch: deadline + transient retry --------------------
 
     def _poll(self) -> bool:
+        m = coord.get_membership()
+        if m is not None:
+            # elastic-fleet liveness at the supervision poll boundary
+            # (throttled): a supervised run under a registered
+            # membership keeps its heartbeat lease fresh even when
+            # the inner runner loop is replaced/overridden
+            m.heartbeat()
         if faults.take_preempt(self._runner.step):
             request_preempt()
         return _PREEMPT.is_set()

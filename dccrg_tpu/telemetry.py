@@ -29,7 +29,13 @@ by tests/test_telemetry.py). Every hot boundary the codebase owns is
 instrumented: grid step / exchange start+wait, adapt/recommit epochs
 and arena swaps, checkpoint save/load/delta/GC phases, runner
 trips+rollbacks, integrity invariant checks and shadow audits, fleet
-admission/dispatch/quantum/preemption — and the zero-stall overlap
+admission/dispatch/quantum/preemption, the elastic multi-host control
+plane (``fleet.membership`` heartbeat+poll spans, ``fleet.reclaim``
+spans with ``dccrg_fleet_reclaims_total`` /
+``dccrg_fleet_reclaim_seconds``, the ``dccrg_fleet_membership{state}``
+live/suspect/dead gauges, ``dccrg_fleet_ownership_lost_total`` fenced
+zombies and ``dccrg_membership_poll_failures_total`` bounded-poll
+expiries) — and the zero-stall overlap
 machinery (background.py): ``recommit.bg`` wraps a background plan
 build, ``grid.recommit.swap`` the step-boundary install, and
 ``ckpt.async`` an overlapped checkpoint write, with the *residual*

@@ -25,6 +25,13 @@
 # 77 in that case. Seeds are deterministic (fuzz.py style): pass
 # --seed N to replay a run byte-identically.
 #
+# The elastic-fleet scenarios (host_death / zombie_fence /
+# host_rejoin: rank-aware FleetScheduler, membership heartbeat
+# leases, epoch-fenced job reclaim) run in the default 2-process
+# sweep above AND again at 3 REAL processes below — a 3-host fleet is
+# the smallest one where the reclaim race (two survivors, one CAS
+# winner) is real.
+#
 # Usage: tests/ci_mp_leg.sh [extra mp_harness args, e.g. --seed 3]
 set -e
 cd "$(dirname "$0")/.."
@@ -34,4 +41,18 @@ if [ "$rc" = "77" ]; then
     echo "ci_mp_leg: SKIP (jax.distributed unavailable on CPU here)"
     exit 0
 fi
-exit $rc
+if [ "$rc" != "0" ]; then
+    exit $rc
+fi
+for sc in host_death zombie_fence host_rejoin; do
+    rc=0
+    python tests/mp_harness.py --procs 3 --scenario "$sc" "$@" || rc=$?
+    if [ "$rc" = "77" ]; then
+        echo "ci_mp_leg: SKIP 3-proc $sc (jax.distributed unavailable)"
+        rc=0
+    fi
+    if [ "$rc" != "0" ]; then
+        exit $rc
+    fi
+done
+exit 0

@@ -57,6 +57,26 @@ drives the scenarios the faked splits cannot truthfully exercise:
   at the non-leader, see DELTA_KILL_PHASES) — the survivor must get
   a typed timeout, the previous keyframe+delta chain must stay
   bitwise intact, and ``resume_latest`` must resume from it.
+- ``host_death``    — the elastic multi-host fleet under a REAL
+  ``kill -9`` of a worker rank mid-serve: every rank runs a
+  rank-aware ``FleetScheduler`` (membership heartbeats + job leases
+  in the REAL coordination KV store) over one shared checkpoint
+  directory; the parent SIGKILLs rank 1 once it reports serving
+  progress. The survivors detect the death within the lease bound,
+  RECLAIM its jobs (CAS claim keys — exactly one winner each) and
+  re-admit them from their checkpoint stems; EVERY job's final
+  digest — the victims included — must be bitwise identical to an
+  uninterrupted solo reference run.
+- ``zombie_fence``  — the stale-owner fence: the parent SIGSTOPs
+  rank 1 mid-serve until its leases expire and a survivor reclaims
+  its jobs, then SIGCONTs it. The resumed zombie's renew must raise
+  a typed ``OwnershipLostError`` and drop the jobs locally WITHOUT
+  publishing (the reclaimer's chain verifies intact via
+  ``verify_chain``); every job still drains bitwise-solo.
+- ``host_rejoin``   — elastic regrow: after the zombie round trip, a
+  second wave of jobs enters every rank's queue once rank 1 is
+  observed live again, and the deterministic partition hands the
+  rejoined rank work it serves to completion.
 
 Runs are DETERMINISTIC: ``--seed`` drives the field values and fault
 placement the same way fuzz.py's seeds do — two runs with the same
@@ -94,7 +114,13 @@ DEATH_RC = 17
 RESUMABLE_RC = 75  # supervise.RESUMABLE_EXIT (EX_TEMPFAIL)
 SCENARIOS = ("save_restore", "psum", "barrier_timeout", "rank_kill",
              "consensus", "sdc_rank", "preempt", "delta_rank_kill",
-             "trace_merge")
+             "trace_merge", "host_death", "zombie_fence",
+             "host_rejoin")
+# elastic-fleet scenario knobs: tight heartbeat/lease bounds so the
+# whole detect->reclaim->drain recovery fits inside the ~10 s window
+# jax's coordination service grants survivors after a peer dies
+FLEET_HEARTBEAT_S = 0.25
+FLEET_LEASE_S = 1.0
 # child-side phase names of the parent-orchestrated preempt scenario
 PREEMPT_PHASES = ("preempt_ref", "preempt_kill", "preempt_resume")
 PREEMPT_STEPS = 8
@@ -797,6 +823,222 @@ def scenario_trace_merge(args):
     coord.barrier("trace_done", timeout=60)
 
 
+# -- elastic multi-host fleet scenarios -------------------------------
+
+FLEET_STEPS = 24
+
+
+def _fleet_job_specs(seed: int, count: int, steps: int = FLEET_STEPS,
+                     first: int = 0) -> list:
+    """The deterministic job-parameter rows every rank (and the solo
+    reference) builds its FleetJob objects from — job OBJECTS carry
+    scheduler-mutated state, so each consumer constructs its own."""
+    return [dict(name=f"fj{i}", length=(8, 8, 8), n_steps=int(steps),
+                 params=(0.05,), seed=seed * 101 + i,
+                 checkpoint_every=4)
+            for i in range(first, first + count)]
+
+
+def _fleet_jobs(specs) -> list:
+    from dccrg_tpu.fleet import FleetJob
+
+    return [FleetJob(**spec) for spec in specs]
+
+
+def _solo_refs(specs) -> dict:
+    """Uninterrupted single-host reference digests, computed from
+    fresh job objects BEFORE any fleet serving (they share the solo
+    compile; after a real kill the survivors race the coordination
+    service's reaper, so the slow part runs up front)."""
+    import jax
+
+    from dccrg_tpu.fleet import run_solo
+
+    dev = jax.local_devices()[0]
+    return {spec["name"]: run_solo(f, device=dev) for spec, f in
+            zip(specs, _fleet_jobs(specs))}
+
+
+def _fleet_sched(args, jobs, store):
+    import jax
+
+    from dccrg_tpu import coord
+    from dccrg_tpu.scheduler import FleetScheduler
+
+    m = coord.Membership(args.rank, args.procs,
+                         heartbeat_s=FLEET_HEARTBEAT_S,
+                         lease_s=FLEET_LEASE_S)
+    return FleetScheduler(store, jobs, quantum=4, membership=m,
+                          devices=[jax.local_devices()[0]])
+
+
+def _serve_fleet(args, sched, all_jobs, hook=None,
+                 deadline_s: float = 120.0) -> bool:
+    """Drive the rank-aware scheduler one tick at a time until every
+    job (local or remote) has a report row, writing a progress file
+    the parent cues its kill/stop signals from:
+    ``ticks:done:total:reclaims``."""
+    from dccrg_tpu import telemetry
+
+    prog = os.path.join(args.tmp, f"fleet_progress.rank{args.rank}")
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        sched.run(max_ticks=sched.ticks + 1)
+        if hook is not None:
+            hook(sched)
+        names = [j.name for j in all_jobs]
+        done = sum(1 for n in names if n in sched.report)
+        reclaims = int(telemetry.registry().counter_total(
+            "dccrg_fleet_reclaims_total"))
+        with open(prog, "w") as f:
+            f.write(f"{sched.ticks}:{done}:{len(names)}:{reclaims}")
+        if done == len(names) and getattr(hook, "complete", True):
+            # a hook that still expects work (the rejoin wave-2 cue)
+            # keeps the loop alive past a drained first wave
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _assert_fleet_solo(args, sched, specs, refs) -> None:
+    """EVERY job — locally served, reclaimed, or reported done by a
+    peer's marker — must carry the bitwise solo-reference digest."""
+    for spec in specs:
+        name = spec["name"]
+        row = sched.report.get(name)
+        assert row is not None and row["status"] == "done", (name, row)
+        assert row["digest"] == refs[name], (
+            name, row["digest"], refs[name])
+        where = ("remote" if row.get("remote")
+                 else f"rank{args.rank}")
+        print(f"[rank {args.rank}] DIGEST fleet {name} "
+              f"{row['digest']} ({where})", flush=True)
+
+
+def scenario_host_death(args):
+    """Child side of the host-death scenario (see module docstring):
+    serve the shared job set rank-aware; rank 1 never returns (the
+    parent's REAL ``kill -9`` lands once it reports progress); the
+    survivors must reclaim its jobs within the lease bound and drain
+    the whole fleet bitwise-solo."""
+    os.environ["DCCRG_BARRIER_TIMEOUT"] = "5"
+    specs = _fleet_job_specs(args.seed, count=4)
+    refs = _solo_refs(specs)
+    store = os.path.join(args.tmp, "fleet")
+    os.makedirs(store, exist_ok=True)
+    jobs = _fleet_jobs(specs)
+    sched = _fleet_sched(args, jobs, store)
+    ok = _serve_fleet(args, sched, jobs)
+    assert ok, f"fleet did not drain: {sched.report}"
+    _assert_fleet_solo(args, sched, specs, refs)
+    # at least one job was reclaimed from the killed rank's stems
+    # SOMEWHERE; each survivor asserts the global counter via its own
+    # report (a reclaimed job shows requeues > 0 and is non-remote)
+    reclaimed = [s["name"] for s in specs
+                 if not sched.report[s["name"]].get("remote")
+                 and sched.report[s["name"]]["requeues"] > 0]
+    print(f"[rank {args.rank}] RECLAIMED {sorted(reclaimed)}",
+          flush=True)
+
+
+def _zombie_serve(args, specs, wave2_specs=None):
+    """The shared body of zombie_fence / host_rejoin: serve with a
+    drop-spy installed; rank 1 gets SIGSTOPped by the parent until a
+    survivor reclaims its jobs, then SIGCONTed — its renew must fence
+    with a typed OwnershipLostError. Returns (sched, fenced names,
+    all job specs served)."""
+    from dccrg_tpu.scheduler import OwnershipLostError
+
+    os.environ["DCCRG_BARRIER_TIMEOUT"] = "5"
+    store = os.path.join(args.tmp, "fleet")
+    os.makedirs(store, exist_ok=True)
+    jobs = _fleet_jobs(specs)
+    all_specs = list(specs)
+    sched = _fleet_sched(args, jobs, store)
+    fenced = []
+    orig_drop = sched._drop_lost
+
+    def spy(batch, slot, job, err):
+        assert isinstance(err, OwnershipLostError), err
+        fenced.append(job.name)
+        orig_drop(batch, slot, job, err)
+
+    sched._drop_lost = spy
+    all_jobs = list(jobs)
+    hook = None
+    if wave2_specs is not None:
+        kv = sched.leases.kv
+        wave1_names = [s["name"] for s in specs]
+        added = []
+
+        def hook(s):  # noqa: F811 - the rejoin wave-2 cue
+            if added:
+                return
+            if args.rank == 0:
+                st = s.membership.state(1)
+                if st == "dead":
+                    hook.saw_dead = True
+                if (getattr(hook, "saw_dead", False) and st == "live"
+                        and all(n in s.report for n in wave1_names)):
+                    # rank 1 died, came back, and wave 1 drained:
+                    # cue the second wave fleet-wide
+                    kv.set("dccrg/wave2_go", "1")
+            if kv.get("dccrg/wave2_go") is not None:
+                for j in _fleet_jobs(wave2_specs):
+                    s.add(j)
+                    all_jobs.append(j)
+                all_specs.extend(wave2_specs)
+                added.append(True)
+                hook.complete = True
+
+        hook.complete = False
+
+    ok = _serve_fleet(args, sched, all_jobs, hook=hook)
+    assert ok, f"fleet did not drain: {sched.report}"
+    return sched, fenced, all_specs
+
+
+def scenario_zombie_fence(args):
+    """Child side of the stale-owner fence (see module docstring)."""
+    from dccrg_tpu import resilience, supervise
+
+    specs = _fleet_job_specs(args.seed, count=4, steps=48)
+    refs = _solo_refs(specs)
+    sched, fenced, _ = _zombie_serve(args, specs)
+    _assert_fleet_solo(args, sched, specs, refs)
+    if args.rank == 1:
+        assert fenced, "zombie rank was never fenced"
+        print(f"[rank 1] FENCED {sorted(set(fenced))}", flush=True)
+        store = os.path.join(args.tmp, "fleet")
+        for name in sorted(set(fenced)):
+            # the reclaimer's chain is intact — the zombie never
+            # published over it
+            entries = supervise.list_checkpoints(store, stem=name)
+            assert entries, name
+            newest = entries[0][1]  # list_checkpoints: newest first
+            assert resilience.verify_chain(newest), name
+
+
+def scenario_host_rejoin(args):
+    """Child side of the elastic-regrow scenario (see module
+    docstring): the zombie round trip, then a second wave the
+    partition must hand the rejoined rank."""
+    wave1 = _fleet_job_specs(args.seed, count=3, steps=48)
+    wave2 = _fleet_job_specs(args.seed, count=args.procs, first=3)
+    refs = _solo_refs(wave1 + wave2)
+    sched, _fenced, all_specs = _zombie_serve(args, wave1,
+                                              wave2_specs=wave2)
+    assert len(all_specs) == len(wave1) + len(wave2), \
+        "wave 2 was never cued"
+    _assert_fleet_solo(args, sched, all_specs, refs)
+    if args.rank == 1:
+        local2 = [s["name"] for s in wave2
+                  if not sched.report[s["name"]].get("remote")]
+        assert local2, ("rejoined rank served no wave-2 job",
+                        sched.report)
+        print(f"[rank 1] REJOIN_SERVED {sorted(local2)}", flush=True)
+
+
 CHILD_SCENARIOS = {
     "probe": scenario_probe,
     "save_restore": scenario_save_restore,
@@ -811,6 +1053,9 @@ CHILD_SCENARIOS = {
     "delta_restore": scenario_delta_restore,
     "delta_kill": scenario_delta_kill,
     "trace_merge": scenario_trace_merge,
+    "host_death": scenario_host_death,
+    "zombie_fence": scenario_zombie_fence,
+    "host_rejoin": scenario_host_rejoin,
 }
 
 
@@ -922,6 +1167,152 @@ def _run_scenario(scenario: str, args, expect_rcs=None, extra=()) -> str:
                 if " DIGEST " in line:
                     print(f"  {line}")
     return "ok" if ok else "fail"
+
+
+def _collect(procs, deadline) -> tuple:
+    """Deadline-bounded transcript/rc collection; stragglers are
+    killed (NOTHING in the parent may hang)."""
+    outs, rcs = [], []
+    for p in procs:
+        left = max(1.0, deadline - time.monotonic())
+        try:
+            out, _ = p.communicate(timeout=left)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            out += "\n<killed: scenario deadline>"
+        outs.append(out)
+        rcs.append(p.returncode)
+    return outs, rcs
+
+
+def _wait_progress(path, pred, deadline, procs=()) -> bool:
+    """Poll a child progress file until ``pred(text)`` holds (or the
+    deadline passes / every child already exited)."""
+    while time.monotonic() < deadline:
+        if procs and all(p.poll() is not None for p in procs):
+            return False
+        try:
+            with open(path) as f:
+                txt = f.read().strip()
+            if txt and pred(txt):
+                return True
+        except (OSError, ValueError, IndexError):
+            pass
+        time.sleep(0.05)
+    return False
+
+
+def _dump_fail(scenario, outs, rcs, note="") -> None:
+    print(f"--- {scenario}: rcs {rcs} {note} " + "-" * 20)
+    for r, out in enumerate(outs):
+        print(f"--- rank {r} " + "-" * 40)
+        print(out[-4000:])
+
+
+def _survivors_ok(scenario, args, rcs, skip_rank=None) -> bool:
+    tmp = os.path.join(args.tmp, scenario)
+    ok = True
+    for r, rc in enumerate(rcs):
+        if r == skip_rank:
+            continue
+        marker = os.path.join(tmp, f"{scenario}.rank{r}.ok")
+        ok = ok and (rc == 0 or os.path.exists(marker))
+    return ok
+
+
+def _relay_digests(outs) -> None:
+    for out in outs:
+        for line in out.splitlines():
+            if (" DIGEST " in line or " FENCED " in line
+                    or " RECLAIMED " in line
+                    or " REJOIN_SERVED " in line):
+                print(f"  {line}")
+
+
+def _run_host_death(args) -> str:
+    """The elastic-fleet kill scenario: spawn the rank-aware fleet,
+    wait until rank 1 reports REAL serving progress, deliver an
+    actual ``kill -9`` (SIGKILL — no handler, no goodbye), and
+    require every survivor to drain the whole fleet with bitwise-solo
+    digests (their own asserts) within the deadline."""
+    procs = _spawn("host_death", args)
+    tmp = os.path.join(args.tmp, "host_death")
+    deadline = time.monotonic() + args.timeout
+    prog1 = os.path.join(tmp, "fleet_progress.rank1")
+    killed = _wait_progress(
+        prog1, lambda t: int(t.split(":")[0]) >= 3, deadline, procs)
+    if killed:
+        procs[1].kill()  # SIGKILL: a REAL dead host, mid-serve
+    outs, rcs = _collect(procs, deadline)
+    if any(rc == SKIP_RC for rc in rcs):
+        return "skip"
+    ok = killed and _survivors_ok("host_death", args, rcs, skip_rank=1)
+    # the scenario's whole point is the kill->detect->reclaim path: a
+    # survivor must report a NON-EMPTY reclaim (if the SIGKILL landed
+    # while rank 1 happened to hold nothing, the run proved nothing)
+    if ok and not any("RECLAIMED ['" in out for out in outs):
+        ok = False
+    if not ok:
+        _dump_fail("host_death", outs, rcs,
+                   f"(SIGKILL sent: {killed})")
+        return "fail"
+    _relay_digests(outs)
+    return "ok"
+
+
+def _run_stop_cont(scenario, args) -> str:
+    """The zombie round trip shared by zombie_fence / host_rejoin:
+    SIGSTOP rank 1 once it serves, wait until a SURVIVOR'S progress
+    file shows a reclaim (lease expired -> CAS takeover), then
+    SIGCONT it — the children assert the fence / regrow."""
+    import signal as signal_mod
+
+    procs = _spawn(scenario, args)
+    tmp = os.path.join(args.tmp, scenario)
+    deadline = time.monotonic() + args.timeout
+    prog1 = os.path.join(tmp, "fleet_progress.rank1")
+    stopped = resumed = False
+    if _wait_progress(prog1, lambda t: int(t.split(":")[0]) >= 3,
+                      deadline, procs):
+        procs[1].send_signal(signal_mod.SIGSTOP)
+        stopped = True
+        # wait for reclaim evidence on any survivor (field 4 of the
+        # progress line), bounded well below the scenario deadline
+        def _reclaimed(txt):
+            return int(txt.split(":")[3]) >= 1
+        cue = time.monotonic() + 30.0
+        got = False
+        while time.monotonic() < min(cue, deadline) and not got:
+            for r in range(args.procs):
+                if r == 1:
+                    continue
+                p = os.path.join(tmp, f"fleet_progress.rank{r}")
+                try:
+                    with open(p) as f:
+                        if _reclaimed(f.read().strip()):
+                            got = True
+                            break
+                except (OSError, ValueError, IndexError):
+                    pass
+            time.sleep(0.05)
+        procs[1].send_signal(signal_mod.SIGCONT)
+        resumed = got
+    outs, rcs = _collect(procs, deadline)
+    if any(rc == SKIP_RC for rc in rcs):
+        return "skip"
+    ok = (stopped and resumed
+          and _survivors_ok(scenario, args, rcs, skip_rank=None))
+    if scenario == "zombie_fence" and ok:
+        ok = any("FENCED" in out for out in outs)
+    if scenario == "host_rejoin" and ok:
+        ok = any("REJOIN_SERVED" in out for out in outs)
+    if not ok:
+        _dump_fail(scenario, outs, rcs,
+                   f"(stopped: {stopped}, reclaim seen: {resumed})")
+        return "fail"
+    _relay_digests(outs)
+    return "ok"
 
 
 def _run_preempt_kill(args, store) -> str:
@@ -1045,6 +1436,12 @@ def parent_main(args) -> int:
         if sc == "delta_rank_kill":  # parent-orchestrated phase loop
             def run(_sc, args_, expect_rcs=None):  # noqa: ARG001
                 return _run_delta(args_)
+        if sc == "host_death":  # parent-orchestrated real SIGKILL
+            def run(_sc, args_, expect_rcs=None):  # noqa: ARG001
+                return _run_host_death(args_)
+        if sc in ("zombie_fence", "host_rejoin"):
+            def run(_sc, args_, expect_rcs=None, sc=sc):  # noqa: ARG001
+                return _run_stop_cont(sc, args_)
         verdict = run(sc, args, expect_rcs=expect)
         print(f"  {sc:<16} {verdict}")
         if verdict == "fail":

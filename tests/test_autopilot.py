@@ -95,6 +95,13 @@ def test_rules_pure_and_json_faithful():
                                  "configured": 8}),
         "quantum.warm_start": (8, {"learned_quantum": 2, "lo": 1,
                                    "hi": 64, "configured": 8}),
+        "shed.cooldown": (4, {"new_sheds": 1, "lo": 1, "hi": 64,
+                              "baseline": 4, "relax_after": 8,
+                              "clean_streak": 0}),
+        "retry.budget": (3, {"repeat_trips": 2, "recovered": 0,
+                             "lo": 1, "hi": 8}),
+        "fleet.reclaim": (0, {"n": 2, "jobs": ["a", "b"],
+                              "dead_rank": 1, "lease_s": 8.0}),
     }
     assert set(cases) == set(RULES)
     for rule, (before, inp) in cases.items():
@@ -173,6 +180,18 @@ def test_hard_bounds_property():
         assert got is None or lo <= got <= hi, inp
         got = RULES["quantum.learn"](maybe(before), inp)
         assert got is None or got >= 1
+        got = RULES["shed.cooldown"](
+            before, dict(inp, new_sheds=int(rng.integers(-1, 3))))
+        assert got is None or lo <= got <= hi, inp
+        got = RULES["retry.budget"](
+            before, dict(inp, repeat_trips=int(rng.integers(0, 6)),
+                         recovered=int(rng.integers(0, 6))))
+        assert got is None or lo <= got <= hi, inp
+        got = RULES["fleet.reclaim"](
+            int(rng.integers(0, 100)),
+            dict(inp, n=int(rng.integers(-1, 4)), jobs=[], dead_rank=1,
+                 lease_s=8.0))
+        assert got is None or got >= 0
 
 
 # -- knob convergence under injected histories ------------------------
@@ -223,6 +242,84 @@ def test_quantum_lengthens_with_comfortable_slack(tmp_path):
     assert sched.quantum == ap.bounds["quantum"][1] == 32
     rules = {r["rule"] for r in ap.decisions}
     assert rules == {"quantum.lengthen"}
+
+
+def test_shed_cooldown_follows_shed_churn(tmp_path):
+    """The PR-12 carried item, shed half: a fresh SLO shed doubles
+    the shed cooldown (damping the shed -> compile -> EWMA-poison
+    feedback loop); a sustained shed-free streak halves it back to
+    the configured baseline — and never past the envelope."""
+    jobs = _jobs(2)
+    ap = Autopilot(quantum=4, clock=lambda: 0.0, relax_after=2)
+    sched, pol = _sched(tmp_path, jobs, ap, quantum=4)
+    sched._admit_pending()
+    assert pol.shed_cooldown == 4
+    telemetry.inc("dccrg_fleet_slo_sheds_total", job="x")
+    _tick(sched, ap)
+    assert pol.shed_cooldown == 8
+    telemetry.inc("dccrg_fleet_slo_sheds_total", job="y")
+    _tick(sched, ap)
+    assert pol.shed_cooldown == 16
+    _tick(sched, ap, 2)  # shed-free: halve back toward the baseline
+    assert pol.shed_cooldown == 8
+    _tick(sched, ap, 2)
+    assert pol.shed_cooldown == 4
+    _tick(sched, ap, 6)
+    assert pol.shed_cooldown == 4  # the baseline, never past
+    assert {r["rule"] for r in ap.decisions} == {"shed.cooldown"}
+    lo, hi = ap.bounds["shed_cooldown"]
+    for rec in ap.decisions:
+        assert lo <= rec["after"] <= hi
+
+
+def test_retry_budget_follows_trip_history(tmp_path):
+    """The PR-12 carried item, retry half: a job churning retries at
+    the SAME step (a deterministic blow-up the rollback cannot
+    outrun) gets its budget cut — fail fast — while a job whose trips
+    recover earns headroom; both bounded, both event-driven (no move
+    without fresh trip history)."""
+    jobs = _jobs(2)
+    ap = Autopilot(quantum=4, clock=lambda: 0.0)
+    sched, _pol = _sched(tmp_path, jobs, ap, quantum=4)
+    sched._admit_pending()
+    doomed, healthy = jobs
+    doomed.trips = [("nan", 5), ("nan", 5), ("nan", 5)]
+    doomed.retries = 3  # the scheduler's consecutive same-step streak
+    healthy.trips = [("nan", 2)]
+    healthy.retries = 0  # progressed past its one trip
+    _tick(sched, ap)
+    assert doomed.max_retries == 2   # 3 -> 2: fail faster
+    assert healthy.max_retries == 4  # 3 -> 4: headroom
+    _tick(sched, ap, 4)  # no fresh history: no further moves
+    assert doomed.max_retries == 2 and healthy.max_retries == 4
+    for _ in range(6):  # churn on: cut to the floor, never through
+        doomed.trips.append(("nan", 5))
+        doomed.retries += 1
+        _tick(sched, ap)
+    assert doomed.max_retries == ap.bounds["max_retries"][0] == 1
+    assert {r["rule"] for r in ap.decisions} == {"retry.budget"}
+    # the journal replays clean (the rules are pure)
+    assert replay(list(ap.decisions)) == []
+
+
+def test_reclaim_records_narrate_and_replay(tmp_path):
+    """Elastic-fleet reclaims are decision-journal records: explain
+    names the dead rank and the reclaimed jobs from the journal
+    alone, and replay re-derives the cumulative count."""
+    jf = tmp_path / "rec.jsonl"
+    ap = Autopilot(quantum=4, clock=lambda: 0.0,
+                   decision_file=str(jf), load_history=False)
+    ap.record_reclaim(1, ["jB", "jA"], 8.0)
+    ap.record_reclaim(2, ["jC"], 8.0)
+    assert ap.reclaims == 3
+    recs = read_journal(str(jf))
+    assert [r["rule"] for r in recs] == ["fleet.reclaim"] * 2
+    assert recs[0]["inputs"]["jobs"] == ["jA", "jB"]
+    assert recs[0]["inputs"]["dead_rank"] == 1
+    assert (recs[0]["before"], recs[0]["after"]) == (0, 2)
+    assert replay(recs) == []
+    line = explain_decision(recs[0])
+    assert "fleet.reclaim" in line and "dead_rank=1" in line
 
 
 def test_checkpoint_cadence_follows_trip_history(tmp_path):

@@ -1,0 +1,567 @@
+"""Elastic multi-host fleet: membership, lease ownership, fencing.
+
+The pins, all single-process with fake clocks and an
+:class:`~dccrg_tpu.coord.InMemoryKV` shared between in-process
+'ranks' (the REAL multi-process proofs — an actual ``kill -9``, a
+SIGSTOP zombie, a rejoin — live in tests/mp_harness.py):
+
+- membership classification from observed lease age
+  (live -> suspect -> dead -> live on rejoin) with the
+  ``dccrg_fleet_membership{state}`` gauges, and a poll that NEVER
+  blocks past its deadline even over a wedged KV store;
+- a registered membership upgrades a barrier timeout into a typed
+  :class:`~dccrg_tpu.coord.PeerDeadError` NAMING the dead rank;
+- the lease/fencing edge cases: expiry exactly at a renew boundary,
+  the reclaim-vs-late-renew race (epoch fencing wins), a
+  double-reclaim by two survivors (KV compare-and-set: exactly one
+  wins);
+- the negative pins: the rank-unaware default constructs NO
+  membership/lease machinery, and a rank-aware single-host scheduler
+  produces bitwise-identical checkpoint files, job digests and
+  reports to the plain scheduler;
+- the in-process recovery flow: a dead 'rank' scheduler's jobs are
+  reclaimed by the survivor, re-admitted from their checkpoint stems,
+  and every job's final digest equals the uninterrupted solo run
+  bitwise; a resumed zombie cannot publish (typed
+  :class:`~dccrg_tpu.scheduler.OwnershipLostError`, chain intact);
+- ``FaultPlan.host_death`` honored in-process at the scheduler tick
+  boundary.
+"""
+
+import glob
+import hashlib
+import os
+import time
+
+import pytest
+
+from dccrg_tpu import coord, resilience, telemetry
+from dccrg_tpu.faults import FaultPlan, InjectedRankDeath
+from dccrg_tpu.fleet import FleetJob, run_solo
+from dccrg_tpu.scheduler import (FleetScheduler, JobLeases,
+                                 OwnershipLostError, rank_aware_default)
+
+pytestmark = pytest.mark.elastic
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("DCCRG_RANK_AWARE", raising=False)
+    monkeypatch.delenv("DCCRG_HEARTBEAT_S", raising=False)
+    monkeypatch.delenv("DCCRG_LEASE_S", raising=False)
+    prev = coord.set_membership(None)
+    # the registry is process-global: counters (reclaims per job name)
+    # would otherwise leak across tests reusing the same job names
+    telemetry.registry().reset()
+    yield
+    coord.set_membership(prev)
+    telemetry.registry().reset()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def _jobs(count=4, steps=8, **kw):
+    return [FleetJob(f"ej{i}", length=(8, 8, 8), n_steps=steps,
+                     params=(0.05,), seed=11 * i, checkpoint_every=2,
+                     **kw)
+            for i in range(count)]
+
+
+def _solo_digests(count=4, steps=8):
+    return {j.name: run_solo(j) for j in _jobs(count, steps)}
+
+
+def _pair(tmp_path, kv, clock, count=4, steps=8, n_ranks=2,
+          quantum=2):
+    """Two in-process 'rank' schedulers over one shared dir + KV."""
+    scheds = []
+    for rank in range(n_ranks):
+        m = coord.Membership(rank, n_ranks, kv=kv, heartbeat_s=1.0,
+                             lease_s=4.0, clock=clock)
+        scheds.append(FleetScheduler(
+            str(tmp_path / "store"), _jobs(count, steps),
+            quantum=quantum, membership=m))
+    return scheds
+
+
+def _tick(sched):
+    sched.run(max_ticks=sched.ticks + 1)
+
+
+# -- membership -------------------------------------------------------
+
+def test_membership_classification_and_gauges():
+    """live -> suspect -> dead from observed lease age; a resumed
+    heartbeat flips back to live (elastic regrow); the state gauges
+    export on every poll."""
+    kv = coord.InMemoryKV()
+    clk = FakeClock()
+    a = coord.Membership(0, 2, kv=kv, heartbeat_s=1.0, lease_s=4.0,
+                         clock=clk)
+    b = coord.Membership(1, 2, kv=kv, heartbeat_s=1.0, lease_s=4.0,
+                         clock=clk)
+    a.heartbeat(force=True)
+    b.heartbeat(force=True)
+    assert a.poll() == {1: "live"}
+    clk.advance(2.5)  # > suspect_s (2 heartbeats), < lease
+    assert a.poll() == {1: "suspect"}
+    clk.advance(2.0)  # past the lease bound
+    assert a.poll() == {1: "dead"}
+    assert a.dead_ranks() == [1] and a.live_ranks() == [0]
+    assert a.detect_dead_ranks() == [1]
+    b.heartbeat(force=True)  # the rank comes back
+    assert a.poll() == {1: "live"}
+    assert a.live_ranks() == [0, 1]
+    reg = telemetry.registry()
+    assert reg.gauges[("dccrg_fleet_membership",
+                       (("state", "live"),))] == 2.0
+    assert reg.gauges[("dccrg_fleet_membership",
+                       (("state", "dead"),))] == 0.0
+    assert reg.counter_value("dccrg_fleet_membership_transitions_total",
+                             rank="1", state="dead") == 1
+
+
+def test_membership_grace_for_slow_starters():
+    """A peer that has NEVER heartbeat gets a full lease of grace
+    from construction — a slow starter is not a corpse."""
+    kv = coord.InMemoryKV()
+    clk = FakeClock(100.0)
+    a = coord.Membership(0, 2, kv=kv, heartbeat_s=1.0, lease_s=4.0,
+                         clock=clk)
+    assert a.poll() == {1: "live"}
+    clk.advance(3.9)
+    assert a.poll() == {1: "suspect"}  # aging, but inside the lease
+    clk.advance(0.2)
+    assert a.poll() == {1: "dead"}
+
+
+def test_membership_poll_never_blocks():
+    """A wedged KV read cannot block the step loop: the poll is
+    deadline-bounded (run_with_deadline) and the previous view keeps
+    aging instead."""
+    class WedgedKV(coord.InMemoryKV):
+        def get(self, key):
+            time.sleep(5.0)
+            return super().get(key)
+
+    clk = FakeClock()
+    a = coord.Membership(0, 2, kv=WedgedKV(), heartbeat_s=1.0,
+                         lease_s=4.0, clock=clk)
+    t0 = time.monotonic()
+    states = a.poll(timeout=0.05)
+    assert time.monotonic() - t0 < 2.0  # bounded, nowhere near 5 s
+    assert states == {1: "live"}  # the stale (construction) view
+    assert telemetry.registry().counter_value(
+        "dccrg_membership_poll_failures_total") >= 1
+
+
+def test_peer_dead_error_names_the_rank():
+    """The detecting side of a host death: with a registered
+    membership, a barrier raises a typed PeerDeadError naming the
+    dead rank (still a BarrierTimeoutError — existing handlers keep
+    working) instead of timing out and blaming the tag."""
+    kv = coord.InMemoryKV()
+    clk = FakeClock()
+    a = coord.Membership(0, 2, kv=kv, heartbeat_s=1.0, lease_s=4.0,
+                         clock=clk)
+    clk.advance(10.0)
+    a.poll()
+    assert a.dead_ranks() == [1]
+    coord.set_membership(a)
+    try:
+        with pytest.raises(coord.PeerDeadError) as ei:
+            coord.barrier("elastic-test", timeout=0.5)
+        assert ei.value.ranks == [1]
+        assert "rank(s) [1]" in str(ei.value)
+        assert isinstance(ei.value, coord.BarrierTimeoutError)
+        assert ei.value.tag == "elastic-test"
+    finally:
+        coord.set_membership(None)
+    # without the membership the same barrier is a plain no-op
+    coord.barrier("elastic-test", timeout=0.5)
+
+
+# -- lease / fencing edge cases ---------------------------------------
+
+def test_lease_expiry_exactly_at_renew_boundary():
+    """The contract at the boundary: age >= lease_s IS expired. A
+    renew landing at exactly the lease bound races the reclaim, and
+    the epoch fence decides — whoever CAS-creates the next epoch's
+    claim key wins, the other side gets the typed error."""
+    kv = coord.InMemoryKV()
+    clk = FakeClock()
+    owner = JobLeases(kv, 0, lease_s=4.0, clock=clk)
+    obs = JobLeases(kv, 1, lease_s=4.0, clock=clk)
+    owner.acquire("j")
+    assert obs.expired_holder("j") is None  # fresh
+    clk.advance(3.999)
+    assert obs.expired_holder("j") is None  # still inside the lease
+    clk.advance(0.001)  # age == lease_s exactly
+    assert obs.expired_holder("j") == 0
+    # the reclaim wins the boundary race...
+    assert obs.try_reclaim("j") == 2
+    # ...and the owner's same-instant renew is fenced, typed
+    with pytest.raises(OwnershipLostError) as ei:
+        owner.renew("j")
+    assert ei.value.job == "j" and ei.value.held_epoch == 1
+    assert "epoch 2" in str(ei.value.current)
+
+
+def test_reclaim_vs_late_renew_race_fencing_wins():
+    """The zombie's renew may even OVERWRITE the lease value after
+    the reclaim — the claim key it can never un-create still convicts
+    it before any publish."""
+    kv = coord.InMemoryKV()
+    clk = FakeClock()
+    owner = JobLeases(kv, 0, lease_s=4.0, clock=clk)
+    obs = JobLeases(kv, 1, lease_s=4.0, clock=clk)
+    owner.acquire("j")
+    assert obs.expired_holder("j") is None  # the watch starts here
+    clk.advance(4.5)
+    assert obs.expired_holder("j") == 0
+    assert obs.try_reclaim("j") == 2
+    # the zombie scribbles the lease VALUE directly (modeling the
+    # worst-case write racing past the check)
+    owner._write("j", 1)
+    # the fencing gate still convicts it before any save publish
+    with pytest.raises(OwnershipLostError):
+        owner.check("j")
+    assert "j" not in owner.owned  # forgotten locally
+    # and the reclaimer still holds a verifiable claim
+    assert obs.owned["j"] == 2
+    obs.check("j")  # no raise
+
+
+def test_double_reclaim_exactly_one_wins():
+    """Two survivors observe the same expired epoch and race the
+    takeover: the KV compare-and-set (create of the claim key) lets
+    exactly one win; the loser returns None and backs off."""
+    kv = coord.InMemoryKV()
+    clk = FakeClock()
+    owner = JobLeases(kv, 0, lease_s=4.0, clock=clk)
+    s1 = JobLeases(kv, 1, lease_s=4.0, clock=clk)
+    s2 = JobLeases(kv, 2, lease_s=4.0, clock=clk)
+    owner.acquire("j")
+    s1.expired_holder("j")  # both watches start at acquisition
+    s2.expired_holder("j")
+    clk.advance(9.0)
+    assert s1.expired_holder("j") == 0
+    assert s2.expired_holder("j") == 0
+    wins = [s1.try_reclaim("j"), s2.try_reclaim("j")]
+    assert sorted(w is not None for w in wins) == [False, True]
+    winner = s1 if wins[0] is not None else s2
+    loser = s2 if wins[0] is not None else s1
+    assert winner.owned["j"] == 2
+    assert "j" not in loser.owned
+
+
+def test_orphaned_claim_is_escalated_past():
+    """A reclaimer dying BETWEEN its claim-key CAS and the lease-
+    record rewrite must not leave the job unreclaimable forever:
+    after the orphaned claim has sat a full lease with the record
+    unmoved, a survivor escalates past it to the next epoch."""
+    kv = coord.InMemoryKV()
+    clk = FakeClock()
+    owner = JobLeases(kv, 0, lease_s=4.0, clock=clk)
+    dying = JobLeases(kv, 1, lease_s=4.0, clock=clk)
+    surv = JobLeases(kv, 2, lease_s=4.0, clock=clk)
+    owner.acquire("j")
+    surv.expired_holder("j")  # the survivor's watch starts here
+    clk.advance(5.0)
+    # the dying reclaimer wins the claim CAS... and dies before the
+    # record rewrite (exactly the two-write window)
+    assert kv.create(f"{dying.prefix}/j@2", "1")
+    assert surv.expired_holder("j") == 0
+    # first attempt: the claim is fresh — the claimant gets a full
+    # lease of grace (it might be mid-rewrite)
+    assert surv.try_reclaim("j") is None
+    clk.advance(2.0)
+    assert surv.try_reclaim("j") is None  # still inside the grace
+    clk.advance(2.5)  # the orphaned claim aged a full lease
+    assert surv.try_reclaim("j") == 3  # escalated past the orphan
+    assert surv.owned["j"] == 3
+    # the fence still convicts both the original owner and a resumed
+    # claimant
+    with pytest.raises(OwnershipLostError):
+        owner.check("j")
+    dying.owned["j"] = 2  # the claimant resumes believing it won
+    with pytest.raises(OwnershipLostError):
+        dying.check("j")
+
+
+def test_finish_done_marker_is_fenced(tmp_path):
+    """A fenced zombie completing a quantum must not write the done
+    marker over the job a reclaimer is serving — _finish consults the
+    same fencing gate as the save publishes."""
+    kv = coord.InMemoryKV()
+    clk = FakeClock()
+    a, b = _pair(tmp_path, kv, clk, count=2, steps=8)
+    for _ in range(2):
+        clk.advance(0.5)
+        _tick(a)
+        _tick(b)
+    a_jobs = sorted(a.leases.owned)
+    assert a_jobs
+    # b reclaims everything while a is paused
+    for _ in range(20):
+        clk.advance(0.6)
+        _tick(b)
+        if len(b.report) == 2:
+            break
+    assert len(b.report) == 2
+    done_key = f"{b.leases.prefix}/done/{a_jobs[0]}"
+    marker = kv.get(done_key)
+    assert marker is not None and marker.startswith("done:1:")
+    # the zombie wakes holding state at n_steps and tries to finish:
+    # the fence drops the job instead of publishing a marker
+    victim = a._by_name[a_jobs[0]]
+    for batch, slot, job in a.active_jobs():
+        if job is victim:
+            a._finish(batch, slot, job)
+            break
+    assert victim.status == "lost"
+    assert kv.get(done_key) == marker, "zombie overwrote the marker"
+
+
+def test_acquire_adopts_own_record_and_rejects_foreign():
+    """A restarted scheduler on the same rank adopts its own lease
+    record; admission never steals a lease another rank holds (that
+    is try_reclaim's job, gated on expiry)."""
+    kv = coord.InMemoryKV()
+    clk = FakeClock()
+    a = JobLeases(kv, 0, lease_s=4.0, clock=clk)
+    a.acquire("j")
+    a2 = JobLeases(kv, 0, lease_s=4.0, clock=clk)  # same-rank restart
+    assert a2.acquire("j") == 1
+    b = JobLeases(kv, 1, lease_s=4.0, clock=clk)
+    with pytest.raises(OwnershipLostError):
+        b.acquire("j")
+
+
+# -- negative pins ----------------------------------------------------
+
+def _run_one(tmp_path, sub, **kw):
+    d = tmp_path / sub
+    sched = FleetScheduler(str(d), _jobs(3), quantum=2, **kw)
+    report = sched.run()
+    files = {}
+    for p in sorted(glob.glob(os.path.join(str(d), "*"))):
+        with open(p, "rb") as f:
+            files[os.path.basename(p)] = hashlib.sha256(
+                f.read()).hexdigest()
+    return report, files
+
+
+def test_rank_unaware_default_is_off_and_unchanged(tmp_path):
+    """The negative pin, structural half: the default constructor
+    builds NO membership/lease machinery (env unset), and the env
+    knob parses as documented."""
+    sched = FleetScheduler(str(tmp_path / "x"), [])
+    assert sched.rank_aware is False
+    assert sched.membership is None and sched.leases is None
+    assert rank_aware_default() is False
+    os.environ["DCCRG_RANK_AWARE"] = "1"
+    try:
+        assert rank_aware_default() is True
+    finally:
+        del os.environ["DCCRG_RANK_AWARE"]
+
+
+def test_single_host_rank_aware_bitwise_pin(tmp_path):
+    """The acceptance pin: rank-aware ON but single-process produces
+    bitwise-identical checkpoint files, job digests and reports to
+    the rank-unaware scheduler — and both match the solo baseline."""
+    ref_report, ref_files = _run_one(tmp_path, "plain")
+    m = coord.Membership(0, 1, kv=coord.InMemoryKV(), heartbeat_s=1.0,
+                         lease_s=4.0, clock=FakeClock())
+    aware_report, aware_files = _run_one(tmp_path, "aware",
+                                         membership=m)
+    solo = {j.name: run_solo(j) for j in _jobs(3)}
+    for name, row in ref_report.items():
+        assert row["digest"] == solo[name]
+    # same decisions -> same rows (the aware run adds only the
+    # owner_rank annotation) and bitwise-identical files
+    for name in ref_report:
+        aware = dict(aware_report[name])
+        assert aware.pop("owner_rank") == 0
+        assert aware == ref_report[name]
+    assert aware_files == ref_files
+    assert any(n.endswith(".dc") for n in ref_files)  # non-trivial
+
+
+# -- the in-process recovery flow -------------------------------------
+
+def test_reclaim_readmits_from_stem_bitwise(tmp_path):
+    """A dead 'rank' scheduler's jobs are reclaimed by the survivor
+    after the lease bound, re-admitted from their checkpoint stems,
+    and EVERY job's final digest equals the uninterrupted solo run
+    bitwise (victims included)."""
+    kv = coord.InMemoryKV()
+    clk = FakeClock()
+    a, b = _pair(tmp_path, kv, clk)
+    for _ in range(3):  # both serve: leases + stems established
+        clk.advance(0.5)
+        _tick(a)
+        _tick(b)
+    a_jobs = sorted(a.leases.owned)
+    b_jobs = sorted(b.leases.owned)
+    assert a_jobs and b_jobs, "partition left one rank idle"
+    assert sorted(a_jobs + b_jobs) == [f"ej{i}" for i in range(4)]
+    # rank 0 'dies': stop driving it; the survivor detects the lease
+    # expiry + membership death and reclaims
+    for _ in range(20):
+        clk.advance(0.6)
+        _tick(b)
+        if len(b.report) == 4:
+            break
+    assert len(b.report) == 4, b.report
+    solo = _solo_digests()
+    for name, row in b.report.items():
+        assert row["status"] == "done", (name, row)
+        assert row["digest"] == solo[name], name
+    reclaimed = [n for n in a_jobs
+                 if not b.report[n].get("remote")
+                 and b.report[n]["requeues"] > 0]
+    assert sorted(reclaimed) == a_jobs
+    assert telemetry.registry().counter_value(
+        "dccrg_fleet_reclaims_total", job=a_jobs[0]) == 1
+
+
+def test_zombie_owner_cannot_publish(tmp_path):
+    """The resumed zombie: its renew raises the typed
+    OwnershipLostError, the jobs drop locally WITHOUT touching a
+    single file of the reclaimer's chain (verify_chain intact), and
+    the zombie's next ticks serve nothing it no longer owns."""
+    kv = coord.InMemoryKV()
+    clk = FakeClock()
+    a, b = _pair(tmp_path, kv, clk, steps=12)
+    for _ in range(2):
+        clk.advance(0.5)
+        _tick(a)
+        _tick(b)
+    a_jobs = sorted(a.leases.owned)
+    assert a_jobs
+    # a pauses; b reclaims + finishes everything
+    for _ in range(25):
+        clk.advance(0.6)
+        _tick(b)
+        if len(b.report) == 4:
+            break
+    assert len(b.report) == 4
+    store = str(tmp_path / "store")
+    before = {}
+    for p in sorted(glob.glob(os.path.join(store, "*"))):
+        with open(p, "rb") as f:
+            before[p] = f.read()
+    # the zombie wakes: the fencing gate convicts it BEFORE any bytes
+    # move (the epoch check precedes every save publish)
+    with pytest.raises(OwnershipLostError):
+        a.leases.check(a_jobs[0])
+    clk.advance(0.1)
+    _tick(a)  # renew_owned fences the rest; drops are side-effect-free
+    for n in a_jobs:
+        assert a._by_name[n].status in ("lost", "done"), (
+            n, a._by_name[n].status)
+    after = {}
+    for p in sorted(glob.glob(os.path.join(store, "*"))):
+        with open(p, "rb") as f:
+            after[p] = f.read()
+    assert before == after, "the zombie touched the reclaimer's files"
+    from dccrg_tpu import supervise
+
+    for n in a_jobs:
+        newest = supervise.list_checkpoints(store, stem=n)[0][1]
+        assert resilience.verify_chain(newest)
+    # the zombie's own view converges through the done markers
+    clk.advance(0.1)
+    _tick(a)
+    assert len(a.report) == 4
+    for n in a_jobs:
+        assert a.report[n]["status"] == "done"
+        assert a.report[n].get("remote") and a.report[n]["owner_rank"] == 1
+
+
+def test_rejoining_rank_reenters_partition(tmp_path):
+    """Elastic regrow in-process: after being fenced out, the zombie
+    rank heartbeats again, peers see it live, and NEWLY queued jobs
+    partition onto it at the next tick."""
+    kv = coord.InMemoryKV()
+    clk = FakeClock()
+    a, b = _pair(tmp_path, kv, clk, count=2, steps=4)
+    for _ in range(12):
+        clk.advance(0.6)
+        _tick(a)
+        _tick(b)
+        if len(a.report) == 2 and len(b.report) == 2:
+            break
+    assert len(a.report) == 2 and len(b.report) == 2
+    # a goes dark long enough to be declared dead...
+    for _ in range(10):
+        clk.advance(0.6)
+        _tick(b)
+    assert b.membership.state(0) == "dead"
+    # ...then rejoins; the next wave lands on BOTH ranks
+    wave2 = [FleetJob(f"w2_{i}", length=(8, 8, 8), n_steps=4,
+                      params=(0.05,), seed=90 + i, checkpoint_every=2)
+             for i in range(2)]
+    for j in wave2:
+        a.add(j)
+    for j in [FleetJob(f"w2_{i}", length=(8, 8, 8), n_steps=4,
+                       params=(0.05,), seed=90 + i,
+                       checkpoint_every=2) for i in range(2)]:
+        b.add(j)
+    for _ in range(12):
+        clk.advance(0.6)
+        _tick(a)
+        _tick(b)
+        if all(f"w2_{i}" in a.report and f"w2_{i}" in b.report
+               for i in range(2)):
+            break
+    assert b.membership.state(0) == "live"
+    local_a = [n for n in ("w2_0", "w2_1")
+               if not a.report[n].get("remote")]
+    local_b = [n for n in ("w2_0", "w2_1")
+               if not b.report[n].get("remote")]
+    assert local_a and local_b, (local_a, local_b)
+    assert sorted(local_a + local_b) == ["w2_0", "w2_1"]
+
+
+def test_host_death_fault_fires_in_process(tmp_path):
+    """FaultPlan.host_death honored at the scheduler tick boundary:
+    the doomed rank raises InjectedRankDeath exactly at its tick; the
+    survivor reclaims and drains the fleet."""
+    kv = coord.InMemoryKV()
+    clk = FakeClock()
+    a, b = _pair(tmp_path, kv, clk)
+    plan = FaultPlan(seed=3)
+    plan.host_death(rank=0, at_tick=2)
+    died = False
+    with plan:
+        for _ in range(4):
+            clk.advance(0.5)
+            try:
+                _tick(a)
+            except InjectedRankDeath:
+                died = True
+                break
+            _tick(b)
+    assert died and plan.fired("fleet.host") == 1
+    with plan:  # rank 1's ticks never match the rank=0 rule
+        for _ in range(22):
+            clk.advance(0.6)
+            _tick(b)
+            if len(b.report) == 4:
+                break
+    assert len(b.report) == 4
+    solo = _solo_digests()
+    for name, row in b.report.items():
+        assert row["status"] == "done" and row["digest"] == solo[name]

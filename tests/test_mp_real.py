@@ -19,7 +19,8 @@ HARNESS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "mp_harness.py")
 SCENARIOS = ("save_restore", "psum", "barrier_timeout", "rank_kill",
              "consensus", "sdc_rank", "preempt", "delta_rank_kill",
-             "trace_merge")
+             "trace_merge", "host_death", "zombie_fence",
+             "host_rejoin")
 
 
 def _run(scenario, seed=0, timeout=300):
