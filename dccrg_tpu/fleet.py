@@ -105,6 +105,42 @@ def register_kernel(name: str, fn) -> None:
     FLEET_KERNELS[str(name)] = fn
 
 
+# per-kernel job defaults: schema, field lists, params and a seeded
+# default init — what lets a job file (or a bare FleetJob("x",
+# kernel="mhd")) name a model-zoo kernel without spelling out its
+# 8-field schema. Registered by dccrg_tpu.models on import.
+FLEET_KERNEL_SPECS: dict = {}
+
+
+def register_kernel_spec(name: str, *, cell_data, fields_in,
+                         fields_out, params=(0.1,), init=None) -> None:
+    """Register the job defaults of a named kernel: its ``cell_data``
+    schema, ``fields_in``/``fields_out`` lists, default ``params``
+    and (optionally) a seeded default init ``fn(grid, seed)`` used in
+    place of :func:`seeded_random_init` (kernels with positivity or
+    stability preconditions — MHD needs positive pressure — register
+    one so the generic random fill never feeds them garbage)."""
+    FLEET_KERNEL_SPECS[str(name)] = {
+        "cell_data": dict(cell_data),
+        "fields_in": tuple(fields_in),
+        "fields_out": tuple(fields_out),
+        "params": tuple(float(p) for p in params),
+        "init": init,
+    }
+
+
+def _kernel_spec(name: str):
+    """The registered spec for a kernel name, lazily importing the
+    model zoo once on a miss (importing ``dccrg_tpu.models`` is what
+    registers the zoo kernels)."""
+    spec = FLEET_KERNEL_SPECS.get(name)
+    if spec is None and name not in FLEET_KERNELS:
+        from . import models  # noqa: F401 - registers the zoo
+
+        spec = FLEET_KERNEL_SPECS.get(name)
+    return spec
+
+
 def _diffuse_kernel(c, nbr, offs, mask, dt):
     """Explicit neighbor-coupling relaxation of ``rho`` (the bench/
     fuzz workhorse): rho += dt * sum_nbr (rho_nbr - rho)."""
@@ -196,8 +232,8 @@ class FleetJob:
     unique within a scheduler."""
 
     def __init__(self, name, *, length=(16, 16, 16), kernel="diffuse",
-                 n_steps=10, cell_data=None, fields_in=("rho",),
-                 fields_out=("rho",), params=(0.1,), priority=0,
+                 n_steps=10, cell_data=None, fields_in=None,
+                 fields_out=None, params=None, priority=0,
                  periodic=(True, True, True), hood_len=1,
                  checkpoint_every=8, max_retries=3, seed=0, init=None,
                  redundancy=1, slo_ms=None):
@@ -205,8 +241,20 @@ class FleetJob:
         self.length = tuple(int(v) for v in length)
         self.kernel = kernel
         self.n_steps = int(n_steps)
-        cell_data = cell_data if cell_data is not None else {
-            "rho": jnp.float32}
+        # a registered kernel spec (the model zoo) supplies schema,
+        # field-list and param defaults the caller left unset; kernels
+        # without one keep the classic single-rho defaults
+        spec = None if callable(kernel) else _kernel_spec(str(kernel))
+        if cell_data is None:
+            cell_data = (spec["cell_data"] if spec is not None
+                         else {"rho": jnp.float32})
+        if fields_in is None:
+            fields_in = spec["fields_in"] if spec is not None else ("rho",)
+        if fields_out is None:
+            fields_out = (spec["fields_out"] if spec is not None
+                          else ("rho",))
+        if params is None:
+            params = spec["params"] if spec is not None else (0.1,)
         self.cell_data = {}
         for fname, spec in cell_data.items():
             if isinstance(spec, tuple):
@@ -259,6 +307,9 @@ class FleetJob:
             return self.kernel
         fn = FLEET_KERNELS.get(str(self.kernel))
         if fn is None:
+            _kernel_spec(str(self.kernel))  # zoo registration on miss
+            fn = FLEET_KERNELS.get(str(self.kernel))
+        if fn is None:
             raise KeyError(
                 f"job {self.name!r}: unknown kernel {self.kernel!r} "
                 f"(registered: {sorted(FLEET_KERNELS)})")
@@ -289,7 +340,11 @@ class FleetJob:
         if self.init is not None:
             self.init(grid)
         else:
-            seeded_random_init(grid, self.seed)
+            spec = (None if callable(self.kernel)
+                    else FLEET_KERNEL_SPECS.get(str(self.kernel)))
+            fn = spec.get("init") if spec is not None else None
+            (fn if fn is not None else seeded_random_init)(
+                grid, self.seed)
         grid.update_copies_of_remote_neighbors()
 
 
@@ -760,8 +815,10 @@ def _jobs_from_spec(spec: dict) -> list:
         length = (tuple(row["length"]) if "length" in row
                   else (int(row.get("n", 16)),) * 3)
         params = row.get("params")
-        if params is None:
-            params = [float(row.get("dt", 0.1))]
+        if params is None and "dt" in row:
+            params = [float(row["dt"])]
+        # params None falls through to the kernel's registered spec
+        # default (the model zoo) or the classic (0.1,) in FleetJob
         jobs.append(FleetJob(
             row["name"], length=length,
             kernel=row.get("kernel", "diffuse"),
@@ -894,4 +951,11 @@ if __name__ == "__main__":  # pragma: no cover - exercised via CLI
     # CPU backend unless the caller opted out
     if os.environ.get("DCCRG_FLEET_BACKEND", "cpu") == "cpu":
         jax.config.update("jax_platforms", "cpu")
-    sys.exit(_main())
+    # `python -m dccrg_tpu.fleet` loads this FILE as __main__ — a
+    # second module instance with its own registry dicts. The model
+    # zoo registers into the canonical `dccrg_tpu.fleet` module, so
+    # run the CLI through that instance or a zoo kernel named by the
+    # job file would be "unknown" here
+    from dccrg_tpu import fleet as _canonical
+
+    sys.exit(_canonical._main())

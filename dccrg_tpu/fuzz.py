@@ -102,7 +102,7 @@ class GridFuzzer:
     _BALANCE_METHODS = ("morton", "hilbert", "rcb", "block")
 
     def __init__(self, seed, *, ops=40, length=(4, 4, 2), max_lvl=1,
-                 n_dev=2, fault_rate=0.0, devices=None):
+                 n_dev=2, fault_rate=0.0, devices=None, schema="scalar"):
         from jax.sharding import Mesh
 
         self.seed = int(seed)
@@ -112,12 +112,28 @@ class GridFuzzer:
         devs = list(devices if devices is not None else _default_devices())
         self.mesh = Mesh(np.array(devs[:min(int(n_dev), len(devs))]),
                          ("dev",))
+        # "aux" is a static payload the ops never write: with it in
+        # the schema the dirty set {rho} is a proper subset, so the
+        # incremental-checkpoint oracle exercises REAL delta saves
+        # (a single-field grid would keyframe every time).
+        # schema="mhd" swaps in the model zoo's 8-field MHD schema
+        # (rho stays the op target), so every mutation/txn/fault site
+        # — refine projection, balance moves, delta chains, rollback
+        # snapshots — runs over the new models' multi-field state,
+        # and the multi-field exchange op gets proper field subsets
+        # with genuinely different payloads
+        if schema == "mhd":
+            from .models.mhd import mhd_cell_data
+
+            cell_data = dict(mhd_cell_data(np.float32))
+            cell_data["aux"] = ((2,), np.float32)
+        elif schema == "scalar":
+            cell_data = {"rho": np.float32, "aux": ((2,), np.float32)}
+        else:
+            raise ValueError(f"unknown fuzz schema {schema!r}")
+        self.schema = schema
         self.grid = (
-            # "aux" is a static payload the ops never write: with it in
-            # the schema the dirty set {rho} is a proper subset, so the
-            # incremental-checkpoint oracle exercises REAL delta saves
-            # (a single-field grid would keyframe every time)
-            Grid(cell_data={"rho": np.float32, "aux": ((2,), np.float32)})
+            Grid(cell_data=cell_data)
             .set_initial_length(length)
             .set_maximum_refinement_level(int(max_lvl))
             .set_periodic(True, True, True)
@@ -129,8 +145,12 @@ class GridFuzzer:
         cells = self.grid.get_cells()
         vals = self.rng.random(len(cells)).astype(np.float32)
         self.grid.set("rho", cells, vals)
-        self.grid.set("aux", cells,
-                      self.rng.random((len(cells), 2)).astype(np.float32))
+        for name in sorted(self.grid.fields):
+            if name == "rho":
+                continue
+            shape, fdt = self.grid.fields[name]
+            self.grid.set(name, cells, self.rng.random(
+                (len(cells),) + shape).astype(fdt))
         # the oracle: independent host mirror of every cell's value
         self.oracle = {int(c): np.float32(v) for c, v in zip(cells, vals)}
         self.log = []
@@ -319,26 +339,64 @@ class GridFuzzer:
         return ""
 
     def _op_exchange(self):
-        """Halo exchange; every ghost row must then hold the owner's
-        value (read straight from the sharded arrays)."""
+        """Halo exchange over a RANDOM field subset (the per-field
+        ``fields=`` boundary) vs the pure-numpy ghost oracle: every
+        exchanged field's ghost rows must hold the owner's bytes
+        (bitwise — the exchange is a copy), ``rho`` additionally
+        checks against the value oracle, and every field NOT in the
+        subset must keep its pre-exchange bytes bitwise (a fused
+        multi-field program must never move an unrequested field)."""
         g = self.grid
-        g.update_copies_of_remote_neighbors()
-        host = np.asarray(g.data["rho"])
+        names = sorted(g.fields)
+        if len(names) > 1 and self.rng.random() < 0.6:
+            k = int(self.rng.integers(1, len(names)))
+            pick = sorted(str(n) for n in self.rng.choice(
+                names, size=k, replace=False))
+        else:
+            pick = names
+        frozen = {n: np.asarray(g.data[n]).tobytes()
+                  for n in names if n not in pick}
+        g.update_copies_of_remote_neighbors(fields=pick)
         L = g.plan.L
-        for d in range(g.n_dev):
-            gids = g.plan.ghost_ids[d]
-            if not len(gids):
+        for n in pick:
+            host = np.asarray(g.data[n])
+            for d in range(g.n_dev):
+                gids = g.plan.ghost_ids[d]
+                if not len(gids):
+                    continue
+                want = np.asarray(g.get(n, gids))  # the owners' bytes
+                got = host[d, L:L + len(gids)]
+                if got.tobytes() != want.tobytes():
+                    bad = (got != want).reshape(len(gids), -1).any(axis=1)
+                    raise FuzzFailure(
+                        f"ghost rows of field {n!r} on device {d} are "
+                        f"not the owner's bytes after exchange "
+                        f"(fields={pick})", seed=self.seed,
+                        op_index=self.ops_run + 1,
+                        cells=np.asarray(gids)[bad][:16], log=self.log)
+            if n != "rho":
                 continue
-            want = np.array([self.oracle[int(c)] for c in gids],
-                            dtype=np.float32)
-            got = host[d, L:L + len(gids)]
-            close = np.isclose(got, want, rtol=1e-4, atol=1e-5)
-            if not close.all():
+            for d in range(g.n_dev):
+                gids = g.plan.ghost_ids[d]
+                if not len(gids):
+                    continue
+                want = np.array([self.oracle[int(c)] for c in gids],
+                                dtype=np.float32)
+                got = host[d, L:L + len(gids)]
+                close = np.isclose(got, want, rtol=1e-4, atol=1e-5)
+                if not close.all():
+                    raise FuzzFailure(
+                        f"ghost rows on device {d} diverged after "
+                        f"exchange", seed=self.seed,
+                        op_index=self.ops_run + 1,
+                        cells=gids[~close][:16], log=self.log)
+        for n, before in frozen.items():
+            if np.asarray(g.data[n]).tobytes() != before:
                 raise FuzzFailure(
-                    f"ghost rows on device {d} diverged after exchange",
-                    seed=self.seed, op_index=self.ops_run + 1,
-                    cells=gids[~close][:16], log=self.log)
-        return ""
+                    f"field {n!r} changed bytes though the exchange "
+                    f"moved only {pick}", seed=self.seed,
+                    op_index=self.ops_run + 1, log=self.log)
+        return ",".join(pick) if pick != names else "all"
 
     def _op_checkpoint(self):
         """Save/load round trip into the live grid — bytes must be
@@ -588,6 +646,12 @@ def _main(argv=None) -> int:
     ap.add_argument("--length", type=int, nargs=3, default=(4, 4, 2))
     ap.add_argument("--max-level", type=int, default=1)
     ap.add_argument("--devices", type=int, default=2)
+    ap.add_argument("--schema", choices=("scalar", "mhd"),
+                    default="scalar",
+                    help="cell-data schema: the classic scalar rho "
+                         "(+aux) or the model zoo's 8-field MHD "
+                         "schema (txn/fault sites then exercise the "
+                         "multi-field mutation paths)")
     ap.add_argument("--fleet", type=int, default=None, metavar="K",
                     help="run K seeded fleet-isolation scenarios "
                          "(one poisoned batch slot; every job must "
@@ -622,7 +686,7 @@ def _main(argv=None) -> int:
             fz = GridFuzzer(
                 s, ops=args.ops, length=tuple(args.length),
                 max_lvl=args.max_level, n_dev=args.devices,
-                fault_rate=args.fault_rate,
+                fault_rate=args.fault_rate, schema=args.schema,
             ).run()
         except FuzzFailure as e:
             print(f"FAIL {e}")

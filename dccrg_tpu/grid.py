@@ -357,12 +357,21 @@ class SlotwiseKernel:
     refinement, the overlap outer re-pass — and the CPU path use the
     same object unchanged. The slots accumulate sequentially, so
     results match the dense contract's axis-1 reduction only to
-    float re-association."""
+    float re-association.
 
-    def __init__(self, init, slot, finish):
+    ``ghost_deps`` optionally declares per-output ghost dependencies
+    (``{out_field: (in_fields whose NEIGHBOR values the computation
+    of out_field reads)}``) — the per-field ghost-split contract (see
+    :func:`ghost_split_enabled`). A missing output defaults to "all
+    of fields_in" (the conservative full re-pass)."""
+
+    def __init__(self, init, slot, finish, ghost_deps=None):
         self.init = init
         self.slot = slot
         self.finish = finish
+        if ghost_deps is not None:
+            self.ghost_deps = {k: tuple(v)
+                               for k, v in dict(ghost_deps).items()}
 
     def __call__(self, cell_fields, nbr_fields, offs, mask, *extra):
         return _run_slotwise(
@@ -371,6 +380,21 @@ class SlotwiseKernel:
             (lambda j: offs[:, j]) if offs.ndim == 3 else
             (lambda j: offs[j]),
             lambda j: mask[..., j], mask.shape[-1], extra)
+
+
+def ghost_split_enabled(default: bool = True) -> bool:
+    """The ``DCCRG_GHOST_SPLIT`` env knob: per-field ghost-split for
+    the overlapped step's outer re-pass (default on). A kernel that
+    declares ``ghost_deps`` then re-runs only the outer rows feeding
+    the fields that actually exchanged, and scatters only the output
+    fields whose declared ghost reads intersect the exchanged set.
+    ``0`` compiles the pre-split program bit-identically (the
+    negative pin — same discipline as ``DCCRG_INTEGRITY=0``); kernels
+    without a declaration are never split either way."""
+    v = os.environ.get("DCCRG_GHOST_SPLIT", "")
+    if v == "":
+        return default
+    return v not in ("0", "off", "false", "no")
 
 
 def default_mesh(devices=None) -> Mesh:
@@ -2504,6 +2528,195 @@ class Grid:
         hood._outer_host = (orow, onr)
         return hood._outer_host
 
+    def _refreshed_ghost_mask(self, neighborhood_id, names):
+        """``[n_dev, R]`` bool: ghost rows that RECEIVE fresh bytes
+        when ``names`` exchange — per-field post-transfer-predicate
+        receive rows. The zero pad row is excluded (the exchange
+        rewrites it to the 0 it already holds)."""
+        R = self.plan.R
+        m = np.zeros((self.n_dev, R), dtype=bool)
+        for n in names:
+            c = self._field_pair_compact(neighborhood_id, n)
+            m[c["q"], c["rrow"]] = True
+        m[:, R - 1] = False
+        return m
+
+    def _split_outer_tables(self, neighborhood_id, hood, use_roll,
+                            r_shifts, roll, relevant):
+        """Ghost-split outer tables: like :meth:`_outer_tables` but
+        restricted to the local rows whose gather actually READS a
+        ghost row refreshed by exchanging ``relevant`` — the rows a
+        step exchanging only those fields can invalidate. Rows that
+        are outer only through the to-lists, rows whose ghost
+        neighbors are all transfer-predicate-filtered, and (on AMR
+        hybrid plans) rows whose ghost reads ride the hard tables'
+        own unconditional re-pass never qualify. Returns ``(orow
+        [n_dev, W], onr [n_dev, W, S], rows_total)`` or None when no
+        row qualifies; memoized per ``relevant`` on the hood."""
+        cache = getattr(hood, "_split_outer", None)
+        if cache is None:
+            cache = hood._split_outer = {}
+        # the gather mode is part of the key: roll callers (the step
+        # loop on accelerators) and table callers (_make_outer_repass)
+        # build format-incompatible onr tables for the same rows
+        key = (bool(use_roll), tuple(relevant))
+        if key in cache:
+            return cache[key]
+        plan = self.plan
+        L, R = plan.L, plan.R
+        n_local = np.asarray(plan.n_local, dtype=np.int64)
+        refreshed = self._refreshed_ghost_mask(neighborhood_id, relevant)
+        row_sets = []
+        if use_roll:
+            # ghost reads are always roll-plan fixups (the shifts only
+            # reach local rows), so membership falls out of the fixup
+            # tables alone; pad fixup entries are (0, 0) — row 0 is
+            # local, never a refreshed ghost, so pads never select
+            wr = np.asarray(roll[1])
+            ws = np.asarray(roll[2])
+            for d in range(self.n_dev):
+                sel = refreshed[d][ws[d]]
+                rows = np.unique(wr[d][sel]).astype(np.int64)
+                row_sets.append(rows[rows < n_local[d]])
+        else:
+            nbr = np.asarray(hood.nbr_rows)
+            msk = np.asarray(hood.nbr_mask)
+            for d in range(self.n_dev):
+                k = int(n_local[d])
+                hit = (msk[d, :k] & refreshed[d][nbr[d, :k]]).any(axis=1)
+                row_sets.append(np.nonzero(hit)[0].astype(np.int64))
+        rows_total = int(sum(len(r) for r in row_sets))
+        if rows_total == 0:
+            cache[key] = None
+            return None
+        W = self._sticky_cap(("gsplitW", neighborhood_id, key),
+                             int(max(len(r) for r in row_sets)))
+        orow = np.full((self.n_dev, W), R - 1, dtype=np.int32)
+        for d, rows in enumerate(row_sets):
+            orow[d, :len(rows)] = rows
+        if use_roll:
+            shifts = np.asarray(r_shifts, dtype=np.int64)
+            S = len(shifts)
+            onr64 = orow.astype(np.int64)[:, :, None] + shifts[None, None, :]
+            wr = np.asarray(roll[1])
+            ws = np.asarray(roll[2])
+            for d, rows in enumerate(row_sets):
+                if not len(rows):
+                    continue
+                for j in range(S):
+                    wrow = wr[d, j]
+                    pos = np.searchsorted(rows, wrow)
+                    sel = (pos < len(rows)) & (
+                        rows[np.minimum(pos, len(rows) - 1)] == wrow)
+                    onr64[d, pos[sel], j] = ws[d, j][sel]
+            onr = np.clip(onr64, 0, R - 1).astype(np.int32)
+            for d, rows in enumerate(row_sets):
+                onr[d, len(rows):] = R - 1
+        else:
+            nbr = np.asarray(hood.nbr_rows)
+            S = nbr.shape[2]
+            onr = np.full((self.n_dev, W, S), R - 1, dtype=np.int32)
+            for d, rows in enumerate(row_sets):
+                onr[d, :len(rows)] = nbr[d, rows]
+        cache[key] = (orow, onr, rows_total)
+        return cache[key]
+
+    def _make_outer_repass(self, kernel, fields_in, fields_out,
+                           neighborhood_id, exchange_names):
+        """A compiled fix-the-refreshed-rows pass for split-overlap
+        treatments of stencils OUTSIDE the fused step loop (the
+        Poisson fused-CG matvec): recomputes ``kernel`` at exactly the
+        local rows whose gather reads a ghost row refreshed by
+        exchanging ``exchange_names``, scattering the results into
+        already-computed bulk outputs. The caller runs the bulk
+        stencil on PRE-exchange state (rows not returned here read no
+        refreshed ghosts, so their bulk results are final), lands the
+        halos, then calls this pass.
+
+        Returns ``(fn, tables)`` with ``out = fn(*tables,
+        *fields_in_arrays, *bulk_out_arrays)`` (full ``[n_dev, R,
+        ...]`` arrays in and out), or None when the plan is
+        unsupported (AMR hybrid hard tables — those rows ride their
+        own unconditional re-pass) or no row qualifies."""
+        hood = self.plan.hoods[neighborhood_id]
+        if hood.hard_nbr_rows is not None:
+            return None
+        try:
+            msk = np.asarray(hood.nbr_mask)
+        except Exception:  # noqa: BLE001 - table-free plan shapes
+            return None
+        if msk is None or getattr(msk, "ndim", 0) != 3:
+            return None
+        exch = tuple(sorted(exchange_names))
+        st = self._split_outer_tables(neighborhood_id, hood, False,
+                                      None, None, exch)
+        if st is None:
+            return None
+        orow_h, onr_h, _rows = st
+        L, R = self.plan.L, self.plan.R
+        n_dev, W = orow_h.shape
+        S = onr_h.shape[2]
+        n_local = np.asarray(self.plan.n_local, dtype=np.int64)
+        omask_h = np.zeros((n_dev, W, S), dtype=bool)
+        kper = []
+        for d in range(n_dev):
+            rows = orow_h[d][orow_h[d] < n_local[d]]
+            kper.append(rows)
+            omask_h[d, :len(rows)] = msk[d, rows]
+        if hood.offs_const is not None:
+            off = np.asarray(hood.offs_const)
+            ooffs_h = (omask_h[..., None]
+                       * off[None, None, :, :]).astype(np.int32)
+            if hood.scale_rows is not None:
+                sc = np.asarray(hood.scale_rows)
+                scw = np.ones((n_dev, W), dtype=sc.dtype)
+                for d, rows in enumerate(kper):
+                    scw[d, :len(rows)] = sc[d, rows]
+                ooffs_h = ooffs_h * scw[:, :, None, None]
+        else:
+            offs_all = np.asarray(hood.nbr_offs)
+            ooffs_h = np.zeros((n_dev, W, S, 3), dtype=offs_all.dtype)
+            for d, rows in enumerate(kper):
+                ooffs_h[d, :len(rows)] = offs_all[d, rows]
+        sh = self._sharding()
+        tables = [hood.dev(("orp", exch, "rows"), orow_h, sh),
+                  hood.dev(("orp", exch, "nbr"), onr_h, sh),
+                  hood.dev(("orp", exch, "mask"), omask_h, sh),
+                  hood.dev(("orp", exch, "offs"), ooffs_h, sh)]
+        fields_in = tuple(fields_in)
+        fields_out = tuple(fields_out)
+        key = ("outer_repass", kernel, fields_in, fields_out,
+               neighborhood_id, exch, L, R)
+        fn = self._program_cache.get(key)
+        if fn is not None:
+            return fn, tables
+        axis, mesh = self.axis, self.mesh
+        nin, nout = len(fields_in), len(fields_out)
+
+        def body(orow, onr, omask, ooffs, *args):
+            orow, onr = orow[0], onr[0]
+            omask, ooffs = omask[0], ooffs[0]
+            orc = jnp.minimum(orow, L - 1)
+            fins = {n: a[0] for n, a in zip(fields_in, args[:nin])}
+            bulk = [a[0] for a in args[nin:nin + nout]]
+            cell = {n: fins[n][:L][orc] for n in fields_in}
+            nbr = {n: fins[n][onr] for n in fields_in}
+            res = kernel(cell, nbr, ooffs, omask)
+            outs = []
+            for n, b in zip(fields_out, bulk):
+                fixed = b[:L].at[orow].set(res[n].astype(b.dtype),
+                                           mode="drop")
+                outs.append(b.at[:L].set(fixed)[None])
+            return tuple(outs)
+
+        mapped = _shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis),) * (4 + nin + nout),
+            out_specs=(P(axis),) * nout, check_vma=False)
+        fn = jax.jit(lambda *a: mapped(*a))
+        self._program_cache[key] = fn
+        return fn, tables
+
     def _make_stencil(self, kernel, fields_in, fields_out, neighborhood_id, include_to,
                       n_extra=0):
         """(program, bound tables) for a gather stencil. The jitted
@@ -2818,19 +3031,77 @@ class Grid:
             tables.append(hood.dev("hard_mask", hood.hard_mask, sh))
         overlap = (self.n_dev > 1 and hood.n_inner is not None
                    and n_x > 0 and self._use_overlap())
+        # per-field ghost split (DCCRG_GHOST_SPLIT, default on): a
+        # kernel declaring ghost_deps re-runs only the outer rows
+        # feeding the fields that actually exchanged, and scatters
+        # only the outputs whose declared ghost reads intersect the
+        # exchanged set. Without a declaration (or with the knob off)
+        # the pre-split program compiles bit-identically below.
+        deps = getattr(kernel, "ghost_deps", None)
+        o_mode = None          # "full" | "split" | "none" once engaged
+        repass = fields_out    # outputs the outer re-pass scatters
+        rows_full = rows_split = 0
         if overlap:
+            rows_full = int((np.asarray(self.plan.n_local)
+                             - np.asarray(hood.n_inner)).sum())
+        if overlap and deps is not None and ghost_split_enabled():
+            xn = tuple(fields_out[j] for j in exch_idx)
+            repass = tuple(F for F in fields_out
+                           if set(deps.get(F, fields_in)) & set(xn))
+            relevant = tuple(sorted(set().union(set(), *(
+                set(deps.get(F, fields_in)) & set(xn)
+                for F in repass))))
+            st = (self._split_outer_tables(
+                neighborhood_id, hood, use_roll, r_shifts, roll,
+                relevant) if repass else None)
+            if st is None:
+                # nothing needs a re-pass: overlap with the re-pass
+                # elided entirely (the exchanged ghosts feed no output
+                # this kernel computes, or no local row reads them)
+                o_mode, repass = "none", ()
+            elif repass == fields_out and st[2] >= rows_full:
+                # the split saves nothing over the full re-pass: fall
+                # through to the pre-split program (same key, same
+                # tables — the shared compile IS the negative pin)
+                o_mode, repass = None, fields_out
+            elif 2 * st[2] > int(np.asarray(self.plan.n_local).sum()):
+                overlap = False  # the re-pass outweighs the hidden
+                repass = fields_out  # collective even split
+            else:
+                o_mode, rows_split = "split", st[2]
+                # use_roll in the upload keys: the OOM fallback chain
+                # (guarded_step) can compile roll AND table programs
+                # over one hood, and their onr formats differ
+                tables.append(hood.dev(
+                    ("gsplit_rows", use_roll) + tuple(relevant),
+                    st[0], sh))
+                tables.append(hood.dev(
+                    ("gsplit_nbr", use_roll) + tuple(relevant),
+                    st[1], sh))
+        if overlap and o_mode is None:
             ot = self._outer_tables(neighborhood_id, hood, use_roll,
                                     r_shifts, roll)
             if ot is None:
                 overlap = False
             else:
+                o_mode = "full"
+                rows_split = rows_full
                 tables.append(hood.dev("outer_rows", ot[0], sh))
                 tables.append(hood.dev("outer_nbr_rows", ot[1], sh))
+        o_tabs = o_mode in ("full", "split")
+        self.last_overlap = {
+            "mode": o_mode or "off",
+            "rows_full": rows_full * n_out if overlap else 0,
+            "rows_split": (rows_split * len(repass) if o_tabs
+                           else 0) if overlap else 0,
+            "repass_fields": repass if overlap else fields_out,
+        }
 
         synth = _synth_key(cf)
         key = ("steploop", kernel, fields_in, fields_out, exch_idx, n_extra,
                L, R, uniform_offs, scaled, split, r_shifts, synth, deltas,
-               overlap)
+               overlap) + ((("gsplit", o_mode, repass),)
+                           if o_mode in ("split", "none") else ())
         fn = self._program_cache.get(key)
         if fn is not None:
             return fn, tables, static_in
@@ -2861,7 +3132,7 @@ class Grid:
                 hr, hnr, hof, hm, *args = args
                 hr, hnr, hof, hm = hr[0], hnr[0], hof[0], hm[0]
                 hrc = jnp.minimum(hr, L - 1)
-            if overlap:
+            if o_tabs:
                 orow_t, onr_t, *args = args
                 orow, onr = orow_t[0], onr_t[0]
                 orc = jnp.minimum(orow, L - 1)
@@ -2960,32 +3231,39 @@ class Grid:
                         result = kernel(cell_fields, nbr_fields, noffs,
                                         nmask, *extra)
                     # land the halos, then redo just the outer rows
+                    # (with ghost-split, only the rows feeding the
+                    # exchanged fields, scattering only the outputs
+                    # whose declared ghost reads those fields)
                     for xi, j in enumerate(exch_idx):
                         fl = state[j]
                         for t in range(n_t):
                             fl = _halo_scatter(fl, recv_rs[xi * n_t + t],
                                                payloads[xi * n_t + t], R)
                         state[j] = fl.at[R - 1].set(0)
-                    full = dict(statics)
-                    full.update(zip(fields_out, state))
-                    cell_fields = {n: full[n][:L] for n in fields_in}
-                    om = mask_rows(orc) if slotwise else nmask[orc]
-                    o_cell = {n: cell_fields[n][orc] for n in fields_in}
-                    o_nbr = {}
-                    for n in fields_in:
-                        g = full[n][onr]
-                        if use_roll:
-                            # mirror _make_nbr_gather's mask-zeroing
-                            mexp = om.reshape(om.shape
-                                              + (1,) * (g.ndim - 2))
-                            g = jnp.where(mexp, g,
-                                          jnp.zeros((), g.dtype))
-                        o_nbr[n] = g
-                    o_offs = offs_rows(orc, om) if slotwise else noffs[orc]
-                    o_res = kernel(o_cell, o_nbr, o_offs, om, *extra)
-                    for n in fields_out:
-                        result[n] = result[n].at[orow].set(
-                            o_res[n].astype(result[n].dtype), mode="drop")
+                    if o_tabs:
+                        full = dict(statics)
+                        full.update(zip(fields_out, state))
+                        cell_fields = {n: full[n][:L] for n in fields_in}
+                        om = mask_rows(orc) if slotwise else nmask[orc]
+                        o_cell = {n: cell_fields[n][orc]
+                                  for n in fields_in}
+                        o_nbr = {}
+                        for n in fields_in:
+                            g = full[n][onr]
+                            if use_roll:
+                                # mirror _make_nbr_gather's mask-zeroing
+                                mexp = om.reshape(om.shape
+                                                  + (1,) * (g.ndim - 2))
+                                g = jnp.where(mexp, g,
+                                              jnp.zeros((), g.dtype))
+                            o_nbr[n] = g
+                        o_offs = (offs_rows(orc, om) if slotwise
+                                  else noffs[orc])
+                        o_res = kernel(o_cell, o_nbr, o_offs, om, *extra)
+                        for n in repass:
+                            result[n] = result[n].at[orow].set(
+                                o_res[n].astype(result[n].dtype),
+                                mode="drop")
                 else:
                     if n_dev > 1:
                         for xi, j in enumerate(exch_idx):
@@ -3024,7 +3302,7 @@ class Grid:
             + ((P(axis), P(axis)) if use_roll else ())
             + ((P(axis),) if scaled else ())
             + ((P(axis),) * 4 if split else ())
-            + ((P(axis), P(axis)) if overlap else ())
+            + ((P(axis), P(axis)) if o_tabs else ())
             + (P(axis),) * (n_static + n_out) + (P(),) * n_extra,
             out_specs=(P(axis),) * n_out,
             check_vma=False,
@@ -3059,6 +3337,15 @@ class Grid:
                 kernel, fields_in, fields_out, exchange_fields,
                 neighborhood_id, n_extra=len(extra_args),
             )
+            ov = getattr(self, "last_overlap", None)
+            if ov is not None and ov["mode"] != "off":
+                # the ghost-split measuring stick: outer-re-pass row
+                # slots actually recomputed vs the full re-pass's
+                telemetry.inc("dccrg_outer_repass_rows_total",
+                              ov["rows_split"] * int(n_steps),
+                              mode=ov["mode"])
+                telemetry.inc("dccrg_outer_repass_rows_full_total",
+                              ov["rows_full"] * int(n_steps))
             out = fn(
                 jnp.int32(n_steps),
                 *tables,

@@ -468,6 +468,57 @@ class SLOPolicy:
                                  else (1, -slacks[e[1].name], -e[0])))
         return order[:min(len(jobs) // 2, len(jobs) - 1)]
 
+    def lane_shed_victims(self, groups):
+        """Cross-bucket (mixed-kernel) shedding for one device lane.
+
+        ``groups`` is ``[(index, key, jobs)]`` — one entry per bucket
+        sharing the lane (distinct kernels land in distinct buckets,
+        so a lane serving a mixed fleet dispatches every group each
+        tick and a deadline job pays the SUM of the cohabiting
+        buckets' quantum latencies per quantum of its own). When a
+        deadline job's slack measured against that lane latency is
+        negative while its own bucket alone would still meet the
+        deadline — the cohabitants, not the bucket, are the problem —
+        the best-effort jobs of the OTHER groups are the victims
+        (lowest priority first). Returns ``(trigger_job, victims)``
+        with victims ``[(index, slot, job)]``, or None when there is
+        no cross-bucket pressure (fewer than two groups, unmeasured
+        latencies, no SLO job, or no best-effort cohabitant): a
+        single-kernel or SLO-free fleet never sheds across buckets —
+        the negative pin."""
+        if len(groups) < 2:
+            return None
+        lats = {i: self._ewma.get(key) for i, key, _jobs in groups}
+        if any(lat is None for lat in lats.values()):
+            return None
+        lane_lat = sum(lats.values())
+        best = None
+        for i, key, jobs in groups:
+            for _slot, j in jobs:
+                if j.slo_ms is None or j.slo_t0 is None:
+                    continue
+                remaining = max(0, j.n_steps - j.steps_done)
+                quanta = -(-remaining // self.quantum)  # ceil
+                budget = j.slo_ms / 1e3 - (self.clock() - j.slo_t0)
+                lane_slack = budget - quanta * lane_lat
+                own_slack = budget - quanta * lats[i]
+                if lane_slack < 0.0 <= own_slack and (
+                        best is None or lane_slack < best[0]):
+                    best = (lane_slack, i, j)
+        if best is None:
+            return None
+        _slack, keep, trigger = best
+        victims = []
+        for i, _key, jobs in groups:
+            if i == keep:
+                continue
+            victims += [(i, slot, j) for slot, j in jobs
+                        if j.slo_ms is None]
+        if not victims:
+            return None
+        victims.sort(key=lambda e: (e[2].priority, -e[1]))
+        return trigger, victims
+
 
 class FleetPreemptedError(RuntimeError):
     """The fleet stopped at a quantum boundary on a preemption signal;
@@ -561,6 +612,11 @@ class FleetScheduler:
         #: input: the trip-rate denominator)
         self.steps_total = 0
         self._queue: list = []  # heap of (-priority, seq, job)
+        # lane-shed parking lot: cross-bucket SLO victims wait here
+        # (keyframed) until their trigger job finishes, instead of
+        # being re-admitted by the very next tick's backfill
+        self._parked: list = []  # [{job, trigger, max_tick}]
+        self._lane_shed_tick: dict = {}  # lane -> last shed tick
         self._seq = itertools.count()
         self._by_name: dict = {}
         self.buckets: dict = {}  # bucket key -> [GridBatch]
@@ -1703,10 +1759,86 @@ class FleetScheduler:
             "the tightest admitted SLO", len(victims), small.capacity,
             batch.capacity)
 
+    def _shed_for_lane(self) -> None:
+        """Cross-bucket SLO shedding (mixed-kernel fleets): when a
+        deadline job's projected completion against its LANE's total
+        per-tick latency — every cohabiting bucket on the device
+        dispatches each tick — violates the deadline while its own
+        bucket alone would not, the best-effort jobs of the OTHER
+        buckets on that lane are keyframed and PARKED (not requeued:
+        the next admission pass would put them straight back) until
+        the trigger job finishes. Tick-boundary act, once per lane
+        per ``shed_cooldown``; a fleet without SLO jobs or with a
+        single bucket per lane never enters the policy."""
+        by_lane: dict = {}
+        for insts in self.buckets.values():
+            for b in insts:
+                if b.jobs:
+                    by_lane.setdefault(getattr(b, "lane", 0),
+                                       []).append(b)
+        for lane, batches in sorted(by_lane.items()):
+            if len(batches) < 2:
+                continue
+            if self.ticks - self._lane_shed_tick.get(lane, -10**9) \
+                    < self.slo.shed_cooldown:
+                continue
+            hit = self.slo.lane_shed_victims(
+                [(i, b.key, b.jobs) for i, b in enumerate(batches)])
+            if hit is None:
+                continue
+            trigger, victims = hit
+            self._lane_shed_tick[lane] = self.ticks
+            parked = 0
+            for i, slot, job in victims:
+                batch = batches[i]
+                if batch.slots[slot] is not job:
+                    continue
+                try:
+                    self._save_job(batch, slot, job,
+                                   force_keyframe=True)
+                except OwnershipLostError as e:
+                    self._drop_lost(batch, slot, job, e)
+                    continue
+                batch.clear(slot)
+                job.requeues += 1
+                job.status = "parked"
+                telemetry.inc("dccrg_fleet_lane_sheds_total",
+                              job=job.name)
+                self._parked.append({
+                    "job": job, "trigger": trigger.name,
+                    "max_tick": self.ticks
+                    + 8 * max(1, self.slo.shed_cooldown)})
+                parked += 1
+            if parked:
+                logger.warning(
+                    "lane %d SLO shed: parked %d best-effort "
+                    "cohabitant(s) from other buckets until deadline "
+                    "job %s completes", lane, parked, trigger.name)
+
+    def _release_parked(self, force: bool = False) -> None:
+        """Re-enqueue lane-shed victims whose trigger finished (or
+        whose backstop tick passed; ``force`` releases everything —
+        the drain and preemption paths)."""
+        if not self._parked:
+            return
+        still = []
+        for entry in self._parked:
+            trig = self._by_name.get(entry["trigger"])
+            if (force or trig is None
+                    or trig.status in ("done", "failed")
+                    or self.ticks >= entry["max_tick"]):
+                self.add(entry["job"])
+            else:
+                still.append(entry)
+        self._parked = still
+
     # -- preemption ---------------------------------------------------
 
     def _preempt(self) -> None:
         requeued = []
+        # lane-shed victims already hold park-time keyframes: back to
+        # the queue so a resume serves them like any requeued job
+        self._release_parked(force=True)
         with telemetry.span("fleet.preempt"):
             for insts in self.buckets.values():
                 for batch in insts:
@@ -1771,10 +1903,16 @@ class FleetScheduler:
                         f"injected host death at tick {self.ticks}")
                 if self.rank_aware:
                     self._rank_tick()
+                self._release_parked()
                 self._admit_pending()
                 active = [b for insts in self.buckets.values()
                           for b in insts if b.jobs]
                 if not active:
+                    if self._parked and not self._queue:
+                        # everything else drained: whatever the parked
+                        # jobs were yielding to is gone — serve them
+                        self._release_parked(force=True)
+                        continue
                     if self._queue:
                         raise RuntimeError(
                             "fleet wedged: queued jobs but no bucket "
@@ -1812,6 +1950,10 @@ class FleetScheduler:
                     for batch in list(insts):
                         if batch.jobs:
                             self._shed_for_slo(batch)
+                # cross-bucket (mixed-kernel) lane shedding — same
+                # tick-boundary discipline; no-op without SLO jobs or
+                # with one bucket per lane
+                self._shed_for_lane()
                 # autopilot control pass — also a tick-boundary act
                 # (it retunes the knobs the NEXT tick dispatches
                 # with); None (the default) skips everything

@@ -44,7 +44,11 @@ step-loop blockage recorded in the ``dccrg_recommit_stall_seconds``
 ``dccrg_ckpt_stall_seconds`` histograms — the serving-path stall a
 sync epoch would have charged in full, so the sync-vs-background win
 is one PromQL ratio (``bench/recommit_bench.py --overlap`` measures
-the same quantity offline).
+the same quantity offline). The per-field ghost split counts its
+outer re-pass row slots in ``dccrg_outer_repass_rows_total{mode}``
+(vs ``dccrg_outer_repass_rows_full_total``, the full-re-pass
+baseline), and the mixed-kernel lane SLO shed marks each parked
+cohabitant in ``dccrg_fleet_lane_sheds_total{job}``.
 
 **Trace export** — :func:`flush_trace` appends the ring as JSONL (one
 event per line) to ``DCCRG_TRACE_FILE`` (auto-flushed at process

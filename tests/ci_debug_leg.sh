@@ -56,6 +56,12 @@
 # trips (tampering detected), and the off-by-default negative pin
 # holds — no controller, no knob movement, bitwise-solo results.
 #
+# Also runs a model-zoo smoke leg under DCCRG_DEBUG=1: an MHD 8^3
+# run (conservation pinned) plus the MHD-schema GridFuzzer leg, so
+# every mutation's post-commit verify_all runs over the multi-field
+# schema, and one ghost-split parity case (split vs full outer
+# re-pass bitwise, strictly fewer recomputed row slots).
+#
 # Usage: tests/ci_debug_leg.sh [extra pytest args]
 set -e
 cd "$(dirname "$0")/.."
@@ -133,6 +139,11 @@ left = [n for n in os.listdir(workdir)
 assert not left, left
 print("kill-mid-overlap smoke OK (resumed step %d)" % info.step)
 PYEOF
+env JAX_PLATFORMS=cpu python -m pytest -q \
+    "tests/test_models.py::test_mhd_conservation" \
+    "tests/test_models.py::test_mhd_schema_fuzz_leg" \
+    "tests/test_models.py::test_ghost_split_bitwise_and_strictly_fewer_rows" \
+    --dccrg-debug -p no:cacheprovider "$@"
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_recommit.py::test_native_numpy_plans_bitwise_identical" \
     "tests/test_checkpoint_integrity.py::test_chain_salvage_falls_back_to_verifying_prefix" \
