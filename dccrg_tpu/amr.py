@@ -373,3 +373,55 @@ def resolve_adaptation(
         refined_parents=np.sort(refined_parents),
         unrefined_parents=np.sort(new_parents),
     )
+
+
+def frontier_induced_refines(
+    mapping: Mapping,
+    cells: np.ndarray,
+    owner: np.ndarray,
+    offsets: np.ndarray,
+    refines: set,
+    local_devs,
+    topology=None,
+) -> np.ndarray:
+    """The FIRST induction wave a rank's local refines push across its
+    ownership boundary: every refinable coarser neighbor of a directly
+    requested refine that is NOT owned by ``local_devs``.
+
+    This is the partial-view half of the distributed commit
+    (dccrg_tpu/distamr.py): each rank declares this wave in its sealed
+    proposal, computed from nothing but its OWN request set and the
+    replicated structure. Because the wave depends only on (requests,
+    structure), every peer can recompute it from the proposal against
+    its own replicated structure — a mismatch convicts the proposer of
+    resolving against a DIFFERENT structure epoch (a zombie whose plan
+    is stale, a torn-but-CRC-passing payload) before any merge
+    happens. It is deliberately ONE wave, not the fixpoint: the merged
+    :func:`resolve_adaptation` runs the real fixpoint over the union
+    of requests, and its digest is what the ranks compare at the
+    resolve barrier; the frontier is the per-proposal integrity check
+    that makes a bad proposal fail CLOSED at collect time."""
+    n = len(cells)
+    if topology is None:
+        topology = GridTopology((False, False, False))
+    lvl = mapping.get_refinement_level(cells)
+
+    flag = np.zeros(n, dtype=bool)
+    if refines:
+        ids = np.fromiter((int(c) for c in refines), dtype=np.uint64,
+                          count=len(refines))
+        pos = np.minimum(np.searchsorted(cells, ids), n - 1)
+        pos = pos[cells[pos] == ids].astype(np.int64)
+        flag[pos[lvl[pos] < mapping.max_refinement_level]] = True
+    if not flag.any():
+        return np.empty(0, dtype=np.uint64)
+
+    edges = _FrontierEdges(mapping, topology, cells, offsets)
+    edges.expand(flag)
+    m = flag[edges.src] & (lvl[edges.nbr] < lvl[edges.src])
+    cand = np.zeros(n, dtype=bool)
+    cand[edges.nbr[m]] = True
+    cand &= ~flag & (lvl < mapping.max_refinement_level)
+    local = np.isin(owner, np.asarray(sorted(int(d) for d in local_devs),
+                                      dtype=np.asarray(owner).dtype))
+    return np.sort(cells[cand & ~local].astype(np.uint64))

@@ -34,8 +34,13 @@ checkpoint write (temp stream + CRC sidecar + fsync + rename) on a
 writer thread against a :func:`freeze_grid` snapshot, overlapped with
 the next quantum's dispatch; :meth:`AsyncSaver.drain` is the barrier
 every store reader (rollback, resume, GC, emergency save) takes before
-trusting the directory. Multi-process saves stay synchronous: the
-two-phase commit's barriers belong to the rank's main thread.
+trusting the directory. Multi-process saves overlap too, through
+:func:`freeze_grid_mp`: the two-phase commit's barriers are pure
+coordination-service gRPC (thread-safe off the main thread), and the
+snapshot replaces the save path's two device touch points — shard
+reads become host-copy reads, and the commit-time CRC exchange rides
+the coordination KV instead of a device all-gather — so the writer
+thread never dispatches jax work.
 """
 
 from __future__ import annotations
@@ -183,6 +188,75 @@ def freeze_grid(grid, fields=None):
     snap._ckpt_dirty = set(dirty) if isinstance(dirty, set) else dirty
     # the snapshot must never alias live background machinery: a save
     # of the frozen copy may not drain/install the real grid's builds
+    snap._bg_build = None
+    return snap
+
+
+def freeze_grid_mp(grid, fields=None, variable=None):
+    """A :func:`freeze_grid` analogue for MULTI-PROCESS grids, so the
+    two-phase-commit save can run on an :class:`AsyncSaver` writer
+    thread. The mp save path touches devices in exactly two places,
+    and the snapshot removes both on the caller's thread:
+
+    - payload reads go through ``grid._shard_read`` (per-device
+      addressable-shard pulls): the snapshot pulls every LOCAL device's
+      shard to host numpy here and overrides ``_shard_read`` with a
+      host-copy reader;
+    - variable-field counts go through ``checkpoint._replicated_pull``
+      (a chunked psum device gather — an XLA collective): the snapshot
+      precomputes the pull for every count field of ``variable`` into
+      ``_frozen_pulls``, which ``_replicated_pull`` serves first.
+
+    The remaining cross-rank traffic — the prepare/commit/done
+    barriers and the commit-time CRC exchange — is coordination-service
+    gRPC: the snapshot sets ``_ckpt_crc_via_kv`` so the CRC table
+    crosses through KV records posted before the commit barrier
+    (:func:`~dccrg_tpu.checkpoint._post_run_crcs_kv`) instead of
+    ``comm.host_all_gather``. Collective discipline is unchanged:
+    EVERY rank must freeze and submit the same save (the barriers
+    still rendezvous, just on writer threads), and the save-attempt
+    epoch advances on the SOURCE grid through ``_mp_epoch_src`` so a
+    later save never reuses a barrier tag. Field arrays are immutable
+    jax values, so the frozen bytes are exactly what a synchronous
+    save at the freeze point would write."""
+    snap = copy.copy(grid)
+    names = sorted(grid.data) if fields is None else sorted(fields)
+    host: dict = {}
+    for n in names:
+        arr = grid.data[n]
+        by_dev = {}
+        for s in arr.addressable_shards:
+            d = int(s.index[0].start or 0)
+            if grid._proc_local_dev[d]:
+                by_dev[d] = np.asarray(s.data)[0]
+        host[n] = by_dev
+
+    def _frozen_shard_read(field, dev, rows, _host=host):
+        by_dev = _host[field]
+        sample = next(iter(by_dev.values()))
+        out = np.empty((len(dev),) + sample.shape[1:],
+                       dtype=sample.dtype)
+        for d in np.unique(dev):
+            m = dev == d
+            out[m] = by_dev[int(d)][rows[m]]
+        return out
+
+    snap._shard_read = _frozen_shard_read
+    snap._frozen_pulls = {}
+    if variable:
+        from . import checkpoint as checkpoint_mod
+        cells = np.asarray(grid.get_cells())
+        for cf in sorted(set(variable.values())):
+            snap._frozen_pulls[cf] = checkpoint_mod._replicated_pull(
+                grid, cf, cells)
+    snap._ckpt_crc_via_kv = True
+    snap._mp_epoch_src = grid
+    # same layout pin as freeze_grid: the save reads row_of_pos
+    # through _host_rows, and an arena recycle must not rot it
+    snap.plan = copy.copy(grid.plan)
+    snap.plan.row_of_pos = np.array(grid.plan.row_of_pos, copy=True)
+    dirty = getattr(grid, "_ckpt_dirty", None)
+    snap._ckpt_dirty = set(dirty) if isinstance(dirty, set) else dirty
     snap._bg_build = None
     return snap
 

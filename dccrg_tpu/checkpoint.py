@@ -383,7 +383,16 @@ def _replicated_pull(grid, field, cells):
     single-controller grids read directly; multi-process grids use the
     chunked psum device gather, whose (replicated) index args make the
     collective consistent across processes — the role of the
-    reference's allgathered cell lists (dccrg.hpp:1109-1736)."""
+    reference's allgathered cell lists (dccrg.hpp:1109-1736).
+
+    A :func:`~dccrg_tpu.background.freeze_grid_mp` snapshot carries the
+    pull PRE-COMPUTED (``_frozen_pulls``, taken on the caller thread at
+    freeze time): the chunked gather is an XLA collective, and the
+    async writer thread must never dispatch device work."""
+    frozen = getattr(grid, "_frozen_pulls", None)
+    if frozen is not None and field in frozen \
+            and len(frozen[field]) == len(cells):
+        return frozen[field]
     if not grid._multiproc:
         return grid.get(field, cells)
     out = []
@@ -507,7 +516,73 @@ def _device_runs(n_dev, owner, offsets, sizes):
     return runs
 
 
-def _gather_run_crcs(grid, runs, local_crcs, rank, tmp, real):
+def _crc_kv_key(base, rank):
+    return f"dccrg_crc:{base}:{rank}"
+
+
+def _post_run_crcs_kv(grid, runs, local_crcs, rank, base):
+    """Writer-thread half of the commit-time CRC exchange: post this
+    rank's per-run CRC32s to the coordination KV under the
+    attempt-tagged key BEFORE the commit barrier, so once the barrier
+    releases every posted record is visible to the committer. Pure
+    gRPC — no XLA collective — which is what lets an
+    :class:`~dccrg_tpu.background.AsyncSaver` writer thread run the
+    whole two-phase save without dispatching device work
+    (:func:`~dccrg_tpu.background.freeze_grid_mp`'s contract). The
+    record is CRC-framed (:func:`~dccrg_tpu.coord.seal_record`): a
+    rank that dies mid-post reads as a torn record, which the
+    committer treats exactly like a dead rank."""
+    import json
+
+    from . import coord
+
+    by_dev: dict = {}
+    for gri, (d, _seg, _lo, _hi) in enumerate(runs):
+        by_dev.setdefault(d, []).append(gri)
+    payload = {str(d): [int(local_crcs[g]) & 0xFFFFFFFF for g in gris]
+               for d, gris in by_dev.items() if grid._proc_local_dev[d]}
+    rec = coord.seal_record(
+        json.dumps({"rank": int(rank), "devs": payload}, sort_keys=True))
+    client = coord._coordination_client()
+    key = _crc_kv_key(base, rank)
+    try:
+        client.key_value_set(key, rec, allow_overwrite=True)
+    except TypeError:  # older jaxlib without the kwarg
+        client.key_value_set(key, rec)
+
+
+def _read_run_crcs_kv(grid, by_dev, base):
+    """Committer half of the KV CRC exchange: merge every rank's posted
+    record into the ``{dev: (rank, [crc, ...])}`` table. A rank that
+    never posted (died before the commit barrier) or posted a torn
+    record simply leaves its devices absent — the committer's
+    missing-slice check turns that into a
+    :class:`~dccrg_tpu.coord.CheckpointCommitError` naming it."""
+    import json
+
+    import jax
+
+    from . import coord
+
+    client = coord._coordination_client()
+    out: dict = {}
+    for r in range(jax.process_count()):
+        key = _crc_kv_key(base, r)
+        try:
+            rec = client.blocking_key_value_get(key, 10_000)
+        except Exception:  # dead before posting: devices stay absent
+            continue
+        try:
+            msg = json.loads(coord.unseal_record(rec, key=key))
+        except coord.TornRecordError:
+            continue  # torn post == dead rank to the committer
+        for ds, crcs in msg["devs"].items():
+            out[int(ds)] = (int(msg["rank"]), [int(c) for c in crcs])
+    return out
+
+
+def _gather_run_crcs(grid, runs, local_crcs, rank, tmp, real,
+                     via_kv=False, base=""):
     """Collect every rank's per-run CRC32s into one replicated table
     ``{dev: (rank, [crc, ...])}``.
 
@@ -522,10 +597,15 @@ def _gather_run_crcs(grid, runs, local_crcs, rank, tmp, real):
     half of all CRC32 values and make healthy ranks look dead. Faked
     test splits merge the in-process stage table instead (their passes
     run sequentially — there is nothing to gather *from* yet when the
-    first pass runs)."""
+    first pass runs). ``via_kv`` (a freeze_grid_mp snapshot's async
+    save) swaps the device all-gather for the coordination-KV records
+    every rank posted before the commit barrier — no collective, so
+    the exchange is legal on a writer thread."""
     by_dev: dict = {}
     for gri, (d, _seg, _lo, _hi) in enumerate(runs):
         by_dev.setdefault(d, []).append(gri)
+    if real and via_kv:
+        return _read_run_crcs_kv(grid, by_dev, base)
     if not real:
         stage = _MP_CRC_STAGE.setdefault(tmp, {})
         for d, gris in by_dev.items():
@@ -599,8 +679,13 @@ def _save_process_slice(grid, filename, meta, cells, offsets, sizes, counts,
     # save collectively even when a previous attempt failed at
     # different points on different ranks, so tagging by attempt
     # re-aligns the whole barrier sequence on a collective retry
-    # (coord.barrier's per-tag counters cover everything else)
-    attempt = getattr(grid, "_mp_save_epoch", 0) + 1
+    # (coord.barrier's per-tag counters cover everything else).
+    # A freeze_grid_mp snapshot counts through its SOURCE grid
+    # (_mp_epoch_src): bumping only the shallow copy would hand the
+    # next save the same attempt number and collide its barrier tags
+    attempt_src = getattr(grid, "_mp_epoch_src", None) or grid
+    attempt = getattr(attempt_src, "_mp_save_epoch", 0) + 1
+    attempt_src._mp_save_epoch = attempt
     grid._mp_save_epoch = attempt
     base = f"{os.path.basename(filename)}#{attempt}"
     end = int(offsets[-1] + sizes[-1]) if len(cells) else len(meta)
@@ -656,8 +741,15 @@ def _save_process_slice(grid, filename, meta, cells, offsets, sizes, counts,
     faults.fire("checkpoint.mp", phase="written", rank=rank, path=filename)
 
     # -- phase 2: commit barrier, CRC exchange, verify + publish ------
+    via_kv = real and bool(getattr(grid, "_ckpt_crc_via_kv", False))
+    if via_kv:
+        # post BEFORE the barrier: once it releases, every surviving
+        # rank's record is already readable (KV writes are ordered
+        # before the poster's barrier arrival)
+        _post_run_crcs_kv(grid, runs, local_crcs, rank, base)
     coord.barrier(f"save_commit:{base}")
-    crc_table = _gather_run_crcs(grid, runs, local_crcs, rank, tmp, real)
+    crc_table = _gather_run_crcs(grid, runs, local_crcs, rank, tmp, real,
+                                 via_kv=via_kv, base=base)
     status_key = f"dccrg_commit:{base}"
     client = coord._coordination_client() if real else None
     if commits:

@@ -36,6 +36,16 @@ rank synchronization through:
   multi-process checkpoint commit (checkpoint._save_process_slice):
   raised by the committing rank when a slice is missing or fails its
   CRC, with the previous checkpoint still intact under the final name.
+- :func:`seal_record` / :func:`unseal_record` / :func:`kv_barrier` —
+  the primitives the distributed-AMR commit (dccrg_tpu/distamr.py)
+  rides: CRC-framed KV records (a torn write convicts as
+  :class:`TornRecordError`, never acts), and a presence-key barrier
+  with an EXPLICIT participant set that doubles as a small all-gather,
+  watches an epoch fence (:class:`StaleFenceError` — a SIGSTOP zombie
+  that wakes after the fleet moved on must lose) and a peer abort
+  marker (:class:`RemoteAbortError` — distributed rollback propagates
+  faster than a timeout), and upgrades expiry to
+  :class:`PeerDeadError` under a membership lease view.
 - :class:`Membership` — elastic fleet membership: every rank writes a
   heartbeat lease into the coordination KV store
   (``DCCRG_HEARTBEAT_S`` cadence), and peers classify each other
@@ -122,6 +132,55 @@ class CheckpointCommitError(RuntimeError):
     def __init__(self, msg, ranks=()):
         super().__init__(msg)
         self.ranks = sorted({int(r) for r in ranks})
+
+
+class TornRecordError(RuntimeError):
+    """A sealed coordination record (:func:`seal_record`) failed its
+    CRC32 frame — the half-written KV record of a writer that died (or
+    was SIGKILLed) mid-write. The reader must treat the record as
+    absent-and-poisoned: abort the protocol round, never act on the
+    payload. ``key`` names the record when known."""
+
+    def __init__(self, key: str = "", detail: str = ""):
+        super().__init__(
+            f"coordination record {key!r} is torn (CRC mismatch"
+            f"{': ' + detail if detail else ''})")
+        self.key = key
+
+
+class StaleFenceError(RuntimeError):
+    """An epoch-fenced coordination point observed the fence move past
+    the epoch this participant entered under: this process is a ZOMBIE
+    — it was stopped (SIGSTOP, GC pause, swapped host) while the
+    surviving ranks completed (or re-formed) the protocol round and
+    advanced the fence. The only safe move is a full local rollback to
+    the pre-round state; rejoining happens at the NEW fence through the
+    fleet layer, never by finishing the stale round."""
+
+    def __init__(self, tag: str, expected, observed):
+        super().__init__(
+            f"fenced point {tag!r}: fence moved {expected!r} -> "
+            f"{observed!r} while this rank was inside the round — this "
+            "rank is a zombie; rolling back to the pre-round state")
+        self.tag = tag
+        self.expected = expected
+        self.observed = observed
+
+
+class RemoteAbortError(RuntimeError):
+    """A PEER rank aborted the distributed transaction this rank is
+    inside and posted an abort marker — the distributed-rollback fast
+    path: every waiting participant raises this immediately instead of
+    burning its barrier timeout. ``rank`` names the aborter (-1 when
+    the marker was unreadable), ``reason`` its message."""
+
+    def __init__(self, tag: str, rank: int = -1, reason: str = ""):
+        super().__init__(
+            f"distributed commit {tag!r}: peer rank {rank} aborted"
+            f"{' (' + reason + ')' if reason else ''} — rolling back")
+        self.tag = tag
+        self.rank = int(rank)
+        self.reason = reason
 
 
 class PeerDeadError(BarrierTimeoutError):
@@ -512,6 +571,159 @@ def default_kv():
     if _LOCAL_KV is None:
         _LOCAL_KV = InMemoryKV()
     return _LOCAL_KV
+
+
+# ---------------------------------------------------------------------
+# sealed records + fenced KV barrier (the distributed-AMR commit rides
+# these; see dccrg_tpu/distamr.py)
+# ---------------------------------------------------------------------
+
+def seal_record(payload: str) -> str:
+    """Frame ``payload`` with its CRC32 (``crc:length:payload``) for a
+    KV write that may be observed half-done: the coordination service
+    itself writes atomically, but a writer can die BETWEEN composing a
+    record and meaning it, and fault injection deliberately stores torn
+    tails — the frame lets every reader convict a damaged record
+    instead of acting on it."""
+    import zlib
+
+    data = str(payload)
+    raw = data.encode("utf-8")
+    return f"{zlib.crc32(raw) & 0xFFFFFFFF:08x}:{len(raw)}:{data}"
+
+
+def unseal_record(record: str, key: str = "") -> str:
+    """Verify and strip a :func:`seal_record` frame; raises
+    :class:`TornRecordError` naming ``key`` when the CRC or length
+    does not match the payload."""
+    import zlib
+
+    try:
+        crc_hex, length, data = str(record).split(":", 2)
+        want_crc = int(crc_hex, 16)
+        want_len = int(length)
+    except (ValueError, AttributeError):
+        raise TornRecordError(key, "unparseable frame") from None
+    raw = data.encode("utf-8")
+    if len(raw) != want_len:
+        raise TornRecordError(key, f"length {len(raw)} != {want_len}")
+    if (zlib.crc32(raw) & 0xFFFFFFFF) != want_crc:
+        raise TornRecordError(key, "payload CRC mismatch")
+    return data
+
+
+def kv_barrier(kv, tag: str, rank: int, ranks, timeout=None, *,
+               value: str = "1", poll_s: float = 0.02, fence=None,
+               abort_key=None, membership=None) -> dict:
+    """Presence-key barrier over the coordination KV: each participant
+    writes ``<tag>/<rank> = value`` and polls until every rank in
+    ``ranks`` has arrived, then returns ``{rank: value}`` — the barrier
+    doubles as an all-gather of one small record per rank (the
+    distributed-AMR commit meets at it with structure digests as the
+    values, so agreement checking costs no extra round).
+
+    Unlike the coordination-service barrier this one takes an EXPLICIT
+    participant set, so a collective that lost a rank can re-form over
+    the survivors, and in-process fake ranks (tests) can meet at it.
+    While polling it watches for the two conditions that must abort a
+    distributed round faster than a timeout:
+
+    - ``fence=(key, expected)``: raises :class:`StaleFenceError` the
+      moment the fence key moves off ``expected`` — a stopped rank that
+      wakes after the fleet committed without it must lose, not finish.
+    - ``abort_key``: raises :class:`RemoteAbortError` the moment a peer
+      posts an abort marker there (the distributed-rollback fast path).
+
+    On expiry, a ``membership`` whose lease view declares a missing
+    peer DEAD upgrades the timeout to :class:`PeerDeadError` naming the
+    rank; otherwise :class:`BarrierTimeoutError` blames the tag. An
+    injected :meth:`~dccrg_tpu.faults.FaultPlan.barrier_hang` for the
+    tag replaces this rank's arrival with a sleep, exercising the
+    peers' timeout machinery deterministically."""
+    timeout = barrier_timeout() if timeout is None else float(timeout)
+    expected = sorted({int(r) for r in ranks})
+    faults.fire("coord.barrier", tag=tag)
+    hang = faults.take_barrier_hang(tag)
+    deadline = time.monotonic() + timeout
+    if hang is not None:
+        # simulate a lost/slow rank: never (or late) post the arrival
+        time.sleep(min(float(hang), max(0.0, deadline - time.monotonic())))
+    kv.set(f"{tag}/{int(rank)}", str(value))
+
+    def _arrivals() -> dict:
+        got = kv.dir_get(f"{tag}/")
+        if got is None:  # service hiccup: degrade to per-key reads
+            got = {}
+            for r in expected:
+                v = kv.get(f"{tag}/{r}")
+                if v is not None:
+                    got[f"{tag}/{r}"] = v
+        arrived = {}
+        for k, v in got.items():
+            tail = k.rsplit("/", 1)[-1]
+            try:
+                arrived[int(tail)] = v
+            except ValueError:
+                continue
+        return arrived
+
+    last_live_check = 0.0
+    while True:
+        # completion is checked FIRST: presence keys are monotonic
+        # within a round, so once any rank observed all arrivals, every
+        # rank will — a fence bump the winner performs right after
+        # passing must never strand a slower participant that the
+        # barrier already counted (it returns success here before the
+        # fence check could convict it)
+        arrived = _arrivals()
+        if all(r in arrived for r in expected):
+            return {r: arrived[r] for r in expected}
+        if fence is not None:
+            fkey, fexp = fence
+            cur = kv.get(fkey)
+            if cur is not None and str(cur) != str(fexp):
+                # the real service's get BLOCKS briefly on an absent
+                # key, so a bump landing during this very check can be
+                # observed BEFORE the arrival that justified it was
+                # re-read — re-sample the arrivals once: a barrier the
+                # winner already counted this rank through must return
+                # success, not convict a live participant as a zombie
+                arrived = _arrivals()
+                if all(r in arrived for r in expected):
+                    return {r: arrived[r] for r in expected}
+                raise StaleFenceError(tag, fexp, cur)
+        if abort_key is not None:
+            marker = kv.get(abort_key)
+            if marker is not None:
+                raise _remote_abort(tag, abort_key, marker)
+        now = time.monotonic()
+        if membership is not None and now - last_live_check > 0.25:
+            last_live_check = now
+            try:
+                dead = set(membership.detect_dead_ranks())
+            except Exception:  # noqa: BLE001 - view refresh is best-effort
+                dead = set()
+            missing_dead = [r for r in expected
+                            if r not in arrived and r in dead]
+            if missing_dead:
+                raise PeerDeadError(tag, timeout, missing_dead,
+                                    lease_s=membership.lease_s)
+        if now >= deadline:
+            raise BarrierTimeoutError(tag, timeout)
+        time.sleep(poll_s)
+
+
+def _remote_abort(tag: str, key: str, marker) -> RemoteAbortError:
+    """Decode an abort marker into the typed error (tolerating a torn
+    marker: an unreadable abort is still an abort)."""
+    import json
+
+    try:
+        info = json.loads(unseal_record(marker, key))
+        return RemoteAbortError(tag, rank=int(info.get("rank", -1)),
+                                reason=str(info.get("reason", "")))
+    except Exception:  # noqa: BLE001 - torn marker: abort anonymously
+        return RemoteAbortError(tag, rank=-1, reason="torn abort marker")
 
 
 class Membership:

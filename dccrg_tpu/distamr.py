@@ -1,0 +1,449 @@
+"""Crash-safe distributed AMR: an epoch-fenced, abortable cross-rank
+structure commit.
+
+The reference dccrg resolves induced 2:1 refinement ACROSS process
+boundaries with iterated MPI collectives (dccrg.hpp:9730-10693); a rank
+that dies mid-commit takes the job with it. Here structure is
+replicated and AMR *requests* are rank-local
+(:meth:`~dccrg_tpu.grid.Grid.refine_completely` gates on ``is_local``),
+so a multi-process adapt epoch must first exchange every rank's request
+view and then install the SAME new structure everywhere — atomically,
+against real failure: ``kill -9`` at any phase, a SIGSTOP zombie with a
+stale epoch, a wedged or lying KV, a torn proposal record.
+
+:func:`distributed_stop_refining` runs one adapt epoch as a fleet-wide
+transaction over the coordination KV (:mod:`dccrg_tpu.coord`
+primitives), four fenced phases, each a named fault point
+(``amr.propose`` / ``amr.resolve`` / ``amr.install`` with
+``phase="prepare"|"commit"``; see :data:`~dccrg_tpu.faults
+.DIST_AMR_FAULT_SITES`):
+
+``propose``
+    Each rank seals (CRC-framed, :func:`~dccrg_tpu.coord.seal_record`)
+    its local request sets, its structure digest, and the one-wave
+    induced-refinement frontier it expects to push across its
+    ownership boundary (:func:`~dccrg_tpu.amr
+    .frontier_induced_refines`) into a proposal record, and the
+    records meet at a fenced :func:`~dccrg_tpu.coord.kv_barrier` — the
+    barrier doubles as the deadline-bounded proposal exchange.
+
+``resolve``
+    Each rank verifies every proposal (CRC frame, fence/attempt echo,
+    structure-digest agreement, and the frontier cross-check: the
+    declared wave is recomputed from the declared requests against the
+    reader's OWN replicated structure — a mismatch convicts the
+    proposer of resolving against a different structure epoch), merges
+    the request sets, and runs the same deterministic
+    :func:`~dccrg_tpu.amr.resolve_adaptation` fixpoint. The result
+    digests meet at the resolve barrier and must be identical.
+
+``prepare``
+    Each rank mirrors the local commit's bookkeeping (request sets
+    cleared, disappearing cells' data preserved) and builds the new
+    plan WITHOUT touching the live one — on a
+    :class:`~dccrg_tpu.background.PlanBuildWorker` against its own
+    arena generation when ``DCCRG_BG_RECOMMIT=1``, inline otherwise.
+    Plan digests meet at the prepare barrier and must be identical.
+
+``commit``
+    The decision point: a fenced barrier, then one winner CAS-records
+    the decision, every rank bumps the fence (idempotent — the same
+    value from every survivor, no single point of failure) and
+    installs its prepared plan via ``Grid._install_plan``.
+
+Crash consistency: ANY failure before the commit barrier — raise,
+timeout, dead peer, torn record, stale fence — aborts through
+:func:`~dccrg_tpu.txn.cross_rank_transaction`: this rank rolls back
+bitwise (old plan, old data, request sets restored — the epoch is
+retryable) and posts an abort marker the peers' barriers fast-abort
+on, so the whole fleet rolls back together. A rank that dies AFTER
+passing the commit barrier is a post-decision death (classic 2PC):
+the survivors install the agreed plan and the PR-14 lease/reclaim
+machinery absorbs the corpse's cells. A SIGSTOP zombie that wakes
+after the survivors re-formed and committed finds the fence advanced
+(:class:`~dccrg_tpu.coord.StaleFenceError`): it rolls back and keeps
+serving the OLD plan — rejoining happens through the fleet layer at
+the new epoch, never by finishing the stale round.
+
+A retry after an abort is a COLLECTIVE retry: every participant calls
+:func:`distributed_stop_refining` again, and the per-process attempt
+counter re-aligns the barrier tags by construction — the same
+``#<attempt>`` discipline the two-phase checkpoint save documents in
+coord.py. Single-controller grids never construct an
+:class:`AmrCommitGroup`, and ``stop_refining`` without one routes to
+the unchanged local path — bitwise identical to the pre-refactor
+commit (pinned by tests/test_distamr.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import zlib
+
+import numpy as np
+
+from . import amr, background, coord, faults, telemetry, txn
+
+logger = logging.getLogger("dccrg_tpu.distamr")
+
+#: test hook: called as ``_PHASE_PROBE(phase, rank)`` right before each
+#: protocol phase runs — the mp harness's cue point (progress markers,
+#: the self-SIGSTOP of the zombie scenario). None in production.
+_PHASE_PROBE = None
+
+
+class AmrProposalError(RuntimeError):
+    """A peer's proposal record failed verification BEYOND its CRC
+    frame: wrong fence/attempt echo, a structure digest that does not
+    match this rank's replicated structure, or a declared induction
+    frontier that does not recompute from the declared requests — the
+    proposer resolved against a different structure epoch. The round
+    must abort collectively; acting on the proposal would commit
+    diverged structure. ``rank`` names the proposer."""
+
+    def __init__(self, rank: int, detail: str):
+        super().__init__(
+            f"AMR proposal from rank {rank} rejected: {detail}")
+        self.rank = int(rank)
+
+
+def _crc(arr, h: int = 0) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes(), h) & 0xFFFFFFFF
+
+
+def structure_digest(grid) -> int:
+    """CRC32 of the live plan's (cells, owner) — the replicated
+    structure fingerprint every proposal must echo."""
+    return _crc(grid.plan.owner, _crc(grid.plan.cells))
+
+
+def plan_digest(plan) -> int:
+    """CRC32 fingerprint of a constructed plan's structural identity
+    (cells, owner, layout extents) — what the prepare barrier
+    compares, and what the mp harness asserts survivors kept bitwise
+    after an aborted commit."""
+    h = _crc(plan.owner, _crc(plan.cells))
+    for scalar in (getattr(plan, "R", 0), getattr(plan, "L", 0)):
+        h = zlib.crc32(str(int(scalar)).encode(), h) & 0xFFFFFFFF
+    return h
+
+
+class AmrCommitGroup:
+    """One rank's handle on the fleet-wide AMR commit protocol.
+
+    Holds the coordination KV, this rank's identity, the expected
+    participant set (narrowed by a :class:`~dccrg_tpu.coord.Membership`
+    lease view when one is given — a dead rank's requests are dropped
+    and its cells absorbed by reclaim, which is how a retry after a
+    death makes progress), and the epoch fence every round is gated
+    on. Install with :meth:`~dccrg_tpu.grid.Grid
+    .enable_distributed_amr`; ``stop_refining`` then routes through
+    :func:`distributed_stop_refining`."""
+
+    def __init__(self, grid, *, kv=None, rank=None, n_ranks=None,
+                 membership=None, prefix: str = "dccrg/amr",
+                 timeout=None, poll_s: float = 0.02):
+        self.grid = grid
+        self.kv = kv if kv is not None else coord.default_kv()
+        if rank is None:
+            rank = coord.process_rank(grid)
+        self.rank = int(rank)
+        if n_ranks is None:
+            import jax
+
+            n_ranks = jax.process_count()
+        self.n_ranks = max(1, int(n_ranks))
+        self.membership = membership
+        self.prefix = str(prefix)
+        self.timeout = timeout  # None: coord.barrier_timeout() per round
+        self.poll_s = max(0.001, float(poll_s))
+        self.attempt = 0
+
+    def fence_key(self) -> str:
+        return f"{self.prefix}/fence"
+
+    def read_fence(self) -> int:
+        val = self.kv.get(self.fence_key())
+        try:
+            return int(val)
+        except (TypeError, ValueError):
+            return 0
+
+    def local_devs(self):
+        """This rank's device ids (what ``is_local`` gates on) — the
+        ownership view its proposal declares so peers can recompute
+        its frontier."""
+        return [int(d) for d in
+                np.nonzero(np.asarray(self.grid._proc_local_dev))[0]]
+
+    def expected_ranks(self):
+        """The participant set of the NEXT round: every configured
+        rank, minus the ones the membership lease view has declared
+        dead (their pending requests are lost with them — the
+        documented semantics of a mid-epoch death)."""
+        if self.membership is not None:
+            try:
+                self.membership.poll()
+            except Exception:  # noqa: BLE001 - view refresh best-effort
+                pass
+            live = {r for r in self.membership.live_ranks()
+                    if 0 <= int(r) < self.n_ranks}
+            live.add(self.rank)
+            return sorted(live)
+        return list(range(self.n_ranks))
+
+
+class _Attempt:
+    """Naming + abort plumbing of one (fence, attempt) round."""
+
+    def __init__(self, group: AmrCommitGroup, fence: int, attempt: int,
+                 expected):
+        self.group = group
+        self.fence = int(fence)
+        self.attempt = int(attempt)
+        self.expected = list(expected)
+        self.timeout = (coord.barrier_timeout() if group.timeout is None
+                        else float(group.timeout))
+
+    def tag(self, phase: str) -> str:
+        return (f"{self.group.prefix}/b/{self.fence}"
+                f"#{self.attempt}/{phase}")
+
+    def key(self, name: str) -> str:
+        return (f"{self.group.prefix}/{name}/{self.fence}"
+                f"#{self.attempt}")
+
+    def abort_key(self) -> str:
+        return self.key("abort")
+
+    def post_abort(self, err: BaseException) -> None:
+        """The distributed-rollback announcement
+        (:func:`~dccrg_tpu.txn.cross_rank_transaction`'s ``on_abort``):
+        land a sealed abort marker so every peer blocked in this
+        round's barriers aborts NOW instead of at its deadline."""
+        cause = getattr(err, "__cause__", None) or err
+        self.group.kv.set(self.abort_key(), coord.seal_record(json.dumps(
+            {"rank": self.group.rank,
+             "reason": f"{type(cause).__name__}: {cause}"[:200]})))
+
+    def barrier(self, phase: str, value: str = "1") -> dict:
+        """This round's fenced barrier at ``phase``; returns the
+        per-rank values (the built-in all-gather)."""
+        return coord.kv_barrier(
+            self.group.kv, self.tag(phase), self.group.rank,
+            self.expected, timeout=self.timeout, value=value,
+            poll_s=self.group.poll_s,
+            fence=(self.group.fence_key(), str(self.fence)),
+            abort_key=self.abort_key(), membership=self.group.membership)
+
+
+def _probe(phase: str, rank: int) -> None:
+    if _PHASE_PROBE is not None:
+        _PHASE_PROBE(phase, rank)
+
+
+def _maybe_hang(site: str, phase, rank) -> None:
+    hang = faults.take_amr_hang(site, phase=phase, rank=rank)
+    if hang:
+        time.sleep(min(float(hang), 3600.0))
+
+
+def distributed_stop_refining(grid, group: AmrCommitGroup = None):
+    """Commit all ranks' refinement requests as one fleet-wide,
+    crash-consistent transaction (see module docstring); returns the
+    created cells exactly as the local ``stop_refining`` would.
+
+    Any failure before the commit decision raises
+    :class:`~dccrg_tpu.txn.CrossRankAbortedError` (or propagates an
+    injected rank death raw) with this rank bitwise rolled back and
+    the abort announced to the peers; the epoch is collectively
+    retryable — every surviving rank calls this again."""
+    if group is None:
+        group = getattr(grid, "_amr_group", None)
+    if group is None:
+        raise ValueError("grid has no AmrCommitGroup: call "
+                         "enable_distributed_amr() first")
+    fence0 = group.read_fence()
+    group.attempt += 1
+    att = _Attempt(group, fence0, group.attempt, group.expected_ranks())
+    t0 = time.perf_counter()
+    try:
+        with telemetry.span("grid.adapt.dist"), \
+                txn.cross_rank_transaction(
+                    grid, op="distributed_stop_refining",
+                    rank=group.rank, on_abort=att.post_abort):
+            new_cells = _run_round(grid, group, att)
+    except txn.CrossRankAbortedError:
+        telemetry.inc("dccrg_dist_amr_aborts_total")
+        raise
+    telemetry.observe("dccrg_dist_amr_commit_seconds",
+                      time.perf_counter() - t0)
+    telemetry.inc("dccrg_dist_amr_commits_total")
+    return new_cells
+
+
+def _run_round(grid, group: AmrCommitGroup, att: _Attempt):
+    from .grid import DEFAULT_NEIGHBORHOOD_ID
+
+    offsets = grid.neighborhoods[DEFAULT_NEIGHBORHOOD_ID]
+
+    # ---- propose ----------------------------------------------------
+    _probe("propose", group.rank)
+    faults.fire("amr.propose", rank=group.rank)
+    _maybe_hang("amr.propose", None, group.rank)
+    cur = group.read_fence()
+    if cur != att.fence:
+        # stopped between reading the fence and proposing: a zombie
+        # already — lose before writing anything
+        raise coord.StaleFenceError(att.tag("propose"), att.fence, cur)
+    sdig = structure_digest(grid)
+    devs = group.local_devs()
+    frontier = amr.frontier_induced_refines(
+        grid.mapping, grid.plan.cells, grid.plan.owner, offsets,
+        grid._refines, devs, topology=grid.topology)
+    record = coord.seal_record(json.dumps({
+        "rank": group.rank, "fence": att.fence, "attempt": att.attempt,
+        "sdig": sdig, "devs": devs,
+        "refines": sorted(int(c) for c in grid._refines),
+        "unrefines": sorted(int(c) for c in grid._unrefines),
+        "dont_refines": sorted(int(c) for c in grid._dont_refines),
+        "dont_unrefines": sorted(int(c) for c in grid._dont_unrefines),
+        "frontier": [int(c) for c in frontier],
+    }, sort_keys=True))
+    if faults.take_torn_record("amr.propose", rank=group.rank):
+        # a writer that died mid-write: store a frame whose CRC cannot
+        # verify — readers must convict, never parse
+        record = record[: max(1, len(record) - 4)]
+    # the fenced barrier IS the deadline-bounded proposal exchange
+    raw = att.barrier("propose", value=record)
+
+    # ---- resolve ----------------------------------------------------
+    _probe("resolve", group.rank)
+    faults.fire("amr.resolve", rank=group.rank)
+    _maybe_hang("amr.resolve", None, group.rank)
+    props = {}
+    for r, rec in raw.items():
+        payload = coord.unseal_record(rec, key=att.tag("propose")
+                                      + f"/{r}")
+        props[r] = json.loads(payload)
+    merged = {"refines": set(), "unrefines": set(),
+              "dont_refines": set(), "dont_unrefines": set()}
+    for r, p in sorted(props.items()):
+        if (int(p.get("fence", -1)) != att.fence
+                or int(p.get("attempt", -1)) != att.attempt
+                or int(p.get("rank", -1)) != r):
+            raise AmrProposalError(
+                r, f"round echo mismatch (fence {p.get('fence')!r}, "
+                   f"attempt {p.get('attempt')!r})")
+        if int(p.get("sdig", -1)) != sdig:
+            raise AmrProposalError(
+                r, f"structure digest {p.get('sdig')} != local {sdig} "
+                   "— proposer resolved against a different structure "
+                   "epoch")
+        declared = np.sort(np.asarray(p.get("frontier", []),
+                                      dtype=np.uint64))
+        recomputed = amr.frontier_induced_refines(
+            grid.mapping, grid.plan.cells, grid.plan.owner, offsets,
+            set(int(c) for c in p.get("refines", [])),
+            p.get("devs", []), topology=grid.topology)
+        if not np.array_equal(declared, recomputed):
+            raise AmrProposalError(
+                r, "declared induction frontier does not recompute "
+                   "from the declared requests")
+        for name in merged:
+            merged[name].update(int(c) for c in p.get(name, []))
+    res = amr.resolve_adaptation(
+        grid.mapping, grid.plan.cells, grid.plan.owner, offsets,
+        merged["refines"], merged["unrefines"],
+        merged["dont_refines"], merged["dont_unrefines"],
+        pins=grid._pins, weights=grid._weights,
+        topology=grid.topology, hood_len=grid._hood_len)
+    rdig = _crc(res.owner, _crc(res.cells))
+    votes = att.barrier("resolve", value=str(rdig))
+    bad = {r: v for r, v in votes.items() if v != str(rdig)}
+    if bad:
+        raise AmrProposalError(
+            min(bad), f"resolve digest disagreement: {bad} != {rdig} "
+                      "— the deterministic fixpoint diverged")
+
+    # ---- prepare ----------------------------------------------------
+    _probe("prepare", group.rank)
+    faults.fire("amr.install", phase="prepare", rank=group.rank)
+    _maybe_hang("amr.install", "prepare", group.rank)
+    # mirror the local commit's bookkeeping (grid.stop_refining): the
+    # request sets are consumed, disappearing cells' data preserved
+    # for get_old_data(), all inside the transaction snapshot
+    grid._refines.clear()
+    grid._unrefines.clear()
+    grid._dont_refines.clear()
+    grid._dont_unrefines.clear()
+    old_ids = np.concatenate([res.refined_parents, res.removed_cells])
+    grid._removed_data = {}
+    if len(old_ids):
+        dev, rows = grid._host_rows(old_ids)
+        capn = grid._sticky_cap("removed", len(old_ids))
+        for name in grid.fields:
+            grid._removed_data[name] = (
+                old_ids, grid._device_gather(name, dev, rows, cap=capn))
+    else:
+        grid._removed_data = {name: (old_ids, None)
+                              for name in grid.fields}
+    grid._removed_cells = res.removed_cells
+    grid._new_cells = res.new_cells
+    grid._unrefined_parents = res.unrefined_parents
+
+    old_plan = grid.plan
+    same_cells = (len(res.cells) == len(old_plan.cells)
+                  and np.array_equal(res.cells, old_plan.cells))
+    if same_cells:
+        changed_hint = (old_plan.cells, np.empty(0, dtype=np.uint64))
+    else:
+        changed_hint = (old_plan.cells, res.changed_cells)
+    if background.bg_recommit_enabled():
+        # the per-rank build runs on this rank's PlanBuildWorker
+        # against its own arena generation (live + rollback plans stay
+        # protected); the commit still waits for it HERE — the install
+        # is collective and cannot ride a per-host step boundary
+        worker = background.PlanBuildWorker(
+            grid, res.cells, res.owner, changed_hint).start()
+        worker.wait()
+        if worker.error is not None:
+            logger.warning(
+                "distributed AMR plan build worker failed (%s: %s); "
+                "rebuilding inline", type(worker.error).__name__,
+                worker.error)
+            plan = grid._construct_plan(res.cells, res.owner,
+                                        changed_hint)
+        else:
+            plan = worker.plan
+    else:
+        plan = grid._construct_plan(res.cells, res.owner, changed_hint)
+    pdig = plan_digest(plan)
+    votes = att.barrier("prepare", value=str(pdig))
+    bad = {r: v for r, v in votes.items() if v != str(pdig)}
+    if bad:
+        raise AmrProposalError(
+            min(bad), f"prepared plan digest disagreement: {bad} != "
+                      f"{pdig}")
+
+    # ---- commit -----------------------------------------------------
+    _probe("commit", group.rank)
+    faults.fire("amr.install", phase="commit", rank=group.rank)
+    _maybe_hang("amr.install", "commit", group.rank)
+    # the decision point: a rank that dies BEFORE this barrier aborts
+    # the whole round (the survivors time out / convict the lease and
+    # keep the old plan bitwise); a rank that dies AFTER passing it is
+    # a post-decision death — the survivors install and reclaim
+    att.barrier("commit")
+    # one winner CAS-records the decision; the fence bump is an
+    # idempotent same-value write from EVERY survivor, so publishing
+    # the new epoch has no single point of failure
+    group.kv.create(att.key("decision"), coord.seal_record(json.dumps(
+        {"fence": att.fence, "attempt": att.attempt,
+         "rank": group.rank, "pdig": pdig})))
+    group.kv.set(group.fence_key(), str(att.fence + 1))
+    grid._pending_changed_cells = None
+    grid._install_plan(plan, same_cells=same_cells)
+    return res.new_cells.copy()

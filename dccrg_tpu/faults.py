@@ -39,6 +39,21 @@ active plan through the module hooks:
 - :func:`take_barrier_hang` — non-raising query coord.barrier uses to
   turn a scheduled :meth:`~FaultPlan.barrier_hang` into a simulated
   lost-rank hang inside its watchdog thread.
+- ``amr.propose`` / ``amr.resolve`` / ``amr.install`` (phases
+  ``prepare`` / ``commit``) — the distributed-AMR commit's named fault
+  points (dccrg_tpu/distamr.py), one per protocol phase. Three
+  variants: :meth:`~FaultPlan.amr_error` raises at the phase (the
+  cross-rank transaction must roll this rank back bitwise and post the
+  abort marker its peers fast-abort on), :meth:`~FaultPlan.amr_hang`
+  stalls the rank inside the phase (queried via :func:`take_amr_hang`
+  — the SIGSTOP-zombie / wedged-KV class; peers' deadline-bounded
+  collects must abort typed, never block), and
+  :meth:`~FaultPlan.amr_torn_record` makes the rank store its sealed
+  proposal with a corrupted tail (queried via
+  :func:`take_torn_record`; readers must convict it as
+  :class:`~dccrg_tpu.coord.TornRecordError`).
+  :meth:`~FaultPlan.rank_death` at the same sites kills the rank
+  mid-phase (the mp harness maps it to a real ``kill -9``).
 - :func:`take_preempt` / :func:`take_step_hang` — non-raising queries
   the run-supervision layer (:mod:`dccrg_tpu.supervise`) uses to turn
   a scheduled :meth:`~FaultPlan.preempt_signal` into a delivered
@@ -176,6 +191,19 @@ MUTATION_FAULT_SITES = {
         ("hybrid.recommit", "tables"),
     ),
 }
+
+# Canonical (site, phase) fault points of the DISTRIBUTED AMR commit
+# (dccrg_tpu/distamr.py), one per protocol phase — consumed by the
+# distributed fuzz leg (fuzz.distributed_amr_case) and
+# tests/test_distamr.py. Deliberately NOT in MUTATION_FAULT_SITES:
+# these fire only when an AmrCommitGroup drives the commit, so the
+# single-grid fuzzer would wait forever for them.
+DIST_AMR_FAULT_SITES = (
+    ("amr.propose", None),
+    ("amr.resolve", None),
+    ("amr.install", "prepare"),
+    ("amr.install", "commit"),
+)
 
 _active: "FaultPlan | None" = None
 
@@ -389,6 +417,46 @@ class FaultPlan:
         """
         return self._add(site, "mutation", times, phase=phase)
 
+    def amr_error(self, site="amr.propose", phase=None, rank=None,
+                  times=1):
+        """Raise (:class:`InjectedMutationError`) at a distributed-AMR
+        commit phase — sites ``amr.propose`` / ``amr.resolve`` /
+        ``amr.install`` (phases ``prepare``, ``commit``), the named
+        fault points of dccrg_tpu/distamr.py. The cross-rank
+        transaction must roll this rank back bitwise, restore its
+        request sets, and post the abort marker every peer fast-aborts
+        on; the fleet keeps serving the OLD plan. ``rank`` narrows to
+        one rank's pass (faked in-process groups carry real rank
+        ids)."""
+        return self._add(site, "mutation", times, phase=phase, rank=rank)
+
+    def amr_hang(self, site="amr.resolve", hang_s=None, phase=None,
+                 rank=None, times=1):
+        """This rank STALLS inside a distributed-AMR commit phase — the
+        SIGSTOP-zombie / wedged-KV fault class. Queried — not raised —
+        through :func:`take_amr_hang` (site suffixed ``.hang``, same
+        discipline as :meth:`barrier_hang`): the stall replaces the
+        phase work, so the PEERS' deadline-bounded proposal collects
+        and fenced barriers are what get exercised — they must abort
+        typed within their bound, and a commit the survivors re-form
+        afterwards advances the fence so the woken zombie loses
+        (:class:`~dccrg_tpu.coord.StaleFenceError`). ``hang_s=None``
+        stalls past any deadline (``math.inf``)."""
+        return self._add(site + ".hang", "hang", times, phase=phase,
+                         rank=rank, hang_s=hang_s)
+
+    def amr_torn_record(self, site="amr.propose", rank=None, times=1):
+        """This rank stores its sealed proposal/commit record with a
+        corrupted tail — the half-written KV record of a rank that died
+        mid-write. Queried — not raised — through
+        :func:`take_torn_record` by the record WRITER (site suffixed
+        ``.torn``), so the damage lands in the store and every READER's
+        CRC frame check (:func:`~dccrg_tpu.coord.unseal_record`) is
+        what gets exercised: conviction as
+        :class:`~dccrg_tpu.coord.TornRecordError` and a collective
+        abort, never action on the torn payload."""
+        return self._add(site + ".torn", "torn", times, rank=rank)
+
     # -- installation -------------------------------------------------
 
     def __enter__(self):
@@ -463,6 +531,40 @@ def take_barrier_hang(tag: str):
     plan.log.append(("coord.barrier_hang", "hang", {"tag": tag}))
     hang = rule.params.get("hang_s")
     return math.inf if hang is None else float(hang)
+
+
+def take_amr_hang(site: str, phase=None, rank=None):
+    """Consume a scheduled :meth:`~FaultPlan.amr_hang` for this rank's
+    distributed-AMR phase; returns the stall duration in seconds
+    (math.inf for a frozen-forever rank) or None. Queried — not raised
+    — by distamr so the stall happens INSIDE the phase: the peers'
+    deadline machinery is what gets exercised."""
+    plan = _active
+    if plan is None:
+        return None
+    ctx = {"phase": phase, "rank": rank}
+    rule = plan._take(site + ".hang", ctx)
+    if rule is None:
+        return None
+    plan.log.append((site + ".hang", "hang", dict(ctx)))
+    hang = rule.params.get("hang_s")
+    return math.inf if hang is None else float(hang)
+
+
+def take_torn_record(site: str, rank=None) -> bool:
+    """Consume a scheduled :meth:`~FaultPlan.amr_torn_record` for this
+    rank's record write; True when one fired. Queried — not raised —
+    by the record writer so the torn bytes LAND in the KV and the
+    readers' CRC conviction is what gets exercised."""
+    plan = _active
+    if plan is None:
+        return False
+    ctx = {"rank": rank}
+    rule = plan._take(site + ".torn", ctx)
+    if rule is None:
+        return False
+    plan.log.append((site + ".torn", "torn", dict(ctx)))
+    return True
 
 
 def take_host_death(rank: int, tick: int) -> bool:

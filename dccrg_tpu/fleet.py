@@ -697,8 +697,19 @@ class GridBatch:
         # quantum boundaries are the fleet's step boundaries: a
         # structure plan a background recommit finished for the scratch
         # grid installs here, never mid-quantum (DCCRG_BG_RECOMMIT —
-        # the same swap discipline as Grid.run_steps)
+        # the same swap discipline as Grid.run_steps). Distributed-AMR
+        # grids (enable_distributed_amr) must never reach this site
+        # with a deferred build: their install is an epoch-fenced
+        # COLLECTIVE (distamr commit phase), and a per-host quantum
+        # boundary cannot host a collective swap — one host installing
+        # while a peer keeps stepping the old plan is exactly the
+        # divergence the fenced protocol exists to prevent.
         if self.grid.bg_pending():
+            if getattr(self.grid, "_amr_group", None) is not None:
+                raise RuntimeError(
+                    "distributed-AMR grid reached a per-host swap site "
+                    "with a deferred plan build; the fenced collective "
+                    "install (distamr) must commit it instead")
             self.grid.bg_install()
         budget = np.asarray(budget, dtype=np.int32)
         q = int(budget.max()) if len(budget) else 0

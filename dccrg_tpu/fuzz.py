@@ -627,6 +627,200 @@ def fleet_isolation_case(seed: int, jobs: int = 8, n: int = 8,
             "trips": report[victim.name]["trips"], "report": report}
 
 
+# -- distributed-AMR commit scenario (the distamr layer's oracle) -----
+
+def _dist_amr_digest(grid):
+    """Bitwise fingerprint of one faked rank's world: structure
+    (plan digest), owned payload bytes (process-local state digest),
+    the pending request sets the rollback must restore, and the epoch
+    fence. ``txn.grid_state_bytes`` is the single-controller
+    fingerprint; a faked-split rank cannot run a whole two-phase save
+    alone, so the distributed scenario composes the same coverage from
+    rank-local pieces."""
+    from . import distamr
+    from .checkpoint import state_digest
+
+    return (distamr.plan_digest(grid.plan), state_digest(grid),
+            tuple(sorted(grid._refines)), tuple(sorted(grid._unrefines)),
+            tuple(sorted(grid._dont_refines)),
+            tuple(sorted(grid._dont_unrefines)),
+            grid._amr_group.read_fence())
+
+
+def dist_amr_case(seed: int, rounds: int = 4, abort_rate: float = 0.6,
+                  length=(6, 6, 4), max_lvl: int = 1) -> dict:
+    """One seeded distributed-AMR crash-consistency scenario: two
+    faked ranks (process-split device masks, one shared
+    :class:`~dccrg_tpu.coord.InMemoryKV`, one protocol thread per
+    rank) drive ``rounds`` adapt epochs of random rank-local
+    refine/unrefine requests through
+    :func:`~dccrg_tpu.distamr.distributed_stop_refining`. With
+    probability ``abort_rate`` a round first runs with an injected
+    fault at a random :data:`~dccrg_tpu.faults.DIST_AMR_FAULT_SITES`
+    point on a random victim rank: EVERY rank must abort
+    (:class:`~dccrg_tpu.txn.CrossRankAbortedError`), every rank's
+    fingerprint (structure, owned bytes, request sets, fence) must be
+    bitwise its pre-round value, and the collective fault-free retry
+    must commit. After every committed epoch each rank's grid must
+    match the single-controller oracle (the merged requests through
+    the unchanged local ``stop_refining``) and re-verify
+    :func:`~dccrg_tpu.verify.verify_refinement_balance` and
+    :func:`~dccrg_tpu.verify.verify_neighbor_symmetry` from scratch.
+    Raises :class:`FuzzFailure`; returns summary counts."""
+    import threading
+
+    from . import coord, distamr
+    from .faults import FaultPlan as _FaultPlan
+    from .txn import CrossRankAbortedError
+    from .verify import verify_neighbor_symmetry, verify_refinement_balance
+
+    rng = np.random.default_rng(seed)
+    devs = _default_devices()
+    if len(devs) < 2:
+        raise FuzzFailure(
+            "dist_amr_case needs >=2 devices (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=N)",
+            seed=seed)
+
+    def mk():
+        from jax.sharding import Mesh
+
+        g = (
+            Grid(cell_data={"rho": np.float32})
+            .set_initial_length(length)
+            .set_maximum_refinement_level(int(max_lvl))
+            .set_periodic(True, True, True)
+            .set_neighborhood_length(1)
+            .initialize(Mesh(np.array(devs[:2]), ("dev",)),
+                        partition="block")
+        )
+        cells = g.get_cells()
+        g.set("rho", cells,
+              (np.asarray(cells) % np.uint64(29)).astype(np.float32))
+        return g
+
+    ref = mk()
+    kv = coord.InMemoryKV()
+    jlock = threading.Lock()  # two threads must never dispatch jax at once
+    grids = {}
+    for rank in (0, 1):
+        g = mk()
+        g._proc_local_dev = np.array(
+            [(d < 1) == (rank == 0) for d in range(g.n_dev)], dtype=bool)
+        g._ckpt_rank = rank
+        ig, dg = g._install_plan, g._device_gather
+
+        def _install(plan, same_cells=None, _f=ig):
+            with jlock:
+                return _f(plan, same_cells=same_cells)
+
+        def _gather(name, dev, rows, cap=None, _f=dg):
+            with jlock:
+                return _f(name, dev, rows, cap=cap)
+
+        g._install_plan, g._device_gather = _install, _gather
+        g.enable_distributed_amr(kv=kv, rank=rank, n_ranks=2, timeout=60)
+        grids[rank] = g
+
+    def run_all(plan=None):
+        """One collective round on both rank threads; returns
+        ``{rank: outcome}`` (the new cells, or the raised error)."""
+        out = {}
+
+        def one(rank):
+            try:
+                out[rank] = grids[rank].stop_refining()
+            except BaseException as e:  # noqa: BLE001 - asserted below
+                out[rank] = e
+
+        ctx = plan if plan is not None else _NullCtx()
+        with ctx:
+            ts = [threading.Thread(target=one, args=(r,)) for r in (0, 1)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(120)
+        return out
+
+    aborts = commits = 0
+    for rnd in range(1, rounds + 1):
+        # random rank-local requests, mirrored into the oracle grid
+        any_req = False
+        for rank in (0, 1):
+            g = grids[rank]
+            local = g.local_cells().ids
+            for cid in rng.choice(local, size=min(2, len(local)),
+                                  replace=False):
+                if (max_lvl and rng.random() < 0.7
+                        and g.refine_completely(int(cid))):
+                    ref.refine_completely(int(cid))
+                    any_req = True
+                elif g.unrefine_completely(int(cid)):
+                    ref.unrefine_completely(int(cid))
+                    any_req = True
+        if not any_req:
+            continue
+
+        if rng.random() < abort_rate:
+            from .faults import DIST_AMR_FAULT_SITES
+
+            site, phase = DIST_AMR_FAULT_SITES[
+                int(rng.integers(len(DIST_AMR_FAULT_SITES)))]
+            victim = int(rng.integers(2))
+            before = {r: _dist_amr_digest(grids[r]) for r in (0, 1)}
+            plan = _FaultPlan(seed=int(rng.integers(1 << 31)))
+            plan.amr_error(site=site, phase=phase, rank=victim)
+            out = run_all(plan)
+            for r in (0, 1):
+                if not isinstance(out[r], CrossRankAbortedError):
+                    raise FuzzFailure(
+                        f"round {rnd}: rank {r} did not abort on "
+                        f"injected {site}/{phase}@rank{victim} "
+                        f"(got {out[r]!r})", seed=seed)
+                if _dist_amr_digest(grids[r]) != before[r]:
+                    raise FuzzFailure(
+                        f"round {rnd}: rank {r} is not bitwise its "
+                        f"pre-round state after the {site} abort",
+                        seed=seed)
+            aborts += 1
+
+        out = run_all()
+        for r in (0, 1):
+            if isinstance(out[r], BaseException):
+                raise FuzzFailure(
+                    f"round {rnd}: fault-free commit failed on rank "
+                    f"{r}: {out[r]!r}", seed=seed)
+        ref.stop_refining()
+        commits += 1
+        for r in (0, 1):
+            g = grids[r]
+            if not (np.array_equal(g.plan.cells, ref.plan.cells)
+                    and np.array_equal(g.plan.owner, ref.plan.owner)):
+                raise FuzzFailure(
+                    f"round {rnd}: rank {r} structure diverged from "
+                    "the single-controller oracle", seed=seed)
+            try:
+                with jlock:
+                    verify_refinement_balance(g)
+                    verify_neighbor_symmetry(g)
+            except VerificationError as e:
+                raise FuzzFailure(
+                    f"round {rnd}: rank {r} invariants broken after "
+                    f"commit: {e}", seed=seed,
+                    cells=getattr(e, "cells", ())) from e
+            g.clear_refined_unrefined_data()
+        ref.clear_refined_unrefined_data()
+    return {"rounds": rounds, "aborts": aborts, "commits": commits}
+
+
+class _NullCtx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
 # -- CLI --------------------------------------------------------------
 
 def _main(argv=None) -> int:
@@ -657,7 +851,29 @@ def _main(argv=None) -> int:
                          "(one poisoned batch slot; every job must "
                          "match its solo digest) instead of the "
                          "mutation fuzz")
+    ap.add_argument("--dist-amr", type=int, default=None, metavar="K",
+                    help="run K seeded distributed-AMR commit "
+                         "scenarios (two faked ranks, random aborted "
+                         "commits, bitwise rollback + re-verified "
+                         "2:1/neighbor invariants) instead of the "
+                         "mutation fuzz")
     args = ap.parse_args(argv)
+
+    if args.dist_amr is not None:
+        import time as time_mod
+
+        t0 = time_mod.time()
+        for s in range(args.dist_amr):
+            try:
+                out = dist_amr_case(s)
+            except FuzzFailure as e:
+                print(f"FAIL {e}")
+                return 1
+            print(f"dist-amr seed {s}: {out['commits']} commit(s), "
+                  f"{out['aborts']} injected abort(s) rolled back")
+        print(f"OK {args.dist_amr} dist-amr seed(s), "
+              f"{time_mod.time() - t0:.1f}s")
+        return 0
 
     if args.fleet is not None:
         import time as time_mod

@@ -3831,6 +3831,29 @@ class Grid:
         c = self.get_existing_cell(coordinate)
         return bool(c != ERROR_CELL) and self.dont_unrefine(c)
 
+    def enable_distributed_amr(self, *, kv=None, rank=None,
+                               n_ranks=None, membership=None,
+                               prefix="dccrg/amr", timeout=None):
+        """Route this grid's adapt epochs through the fleet-wide,
+        crash-consistent commit protocol (dccrg_tpu/distamr.py):
+        ``stop_refining`` becomes an epoch-fenced collective install
+        coordinated over the KV, every rank's local requests merged by
+        a deadline-bounded proposal exchange. Returns the installed
+        :class:`~dccrg_tpu.distamr.AmrCommitGroup`. A ``membership``
+        lease view lets a retry after a rank death re-form the
+        collective over the survivors."""
+        from . import distamr
+
+        self._amr_group = distamr.AmrCommitGroup(
+            self, kv=kv, rank=rank, n_ranks=n_ranks,
+            membership=membership, prefix=prefix, timeout=timeout)
+        return self._amr_group
+
+    def disable_distributed_amr(self) -> None:
+        """Drop the commit group: ``stop_refining`` reverts to the
+        single-controller path."""
+        self._amr_group = None
+
     def stop_refining(self) -> np.ndarray:
         """Commit all refinement requests; returns the created cells
         (dccrg.hpp:3483-3507). Data of refined parents and removed
@@ -3843,7 +3866,22 @@ class Grid:
         :class:`~dccrg_tpu.txn.MutationAbortedError`; retrying the
         commit is then safe. With ``DCCRG_DEBUG=1`` the committed
         state is verified and rolled back on a broken invariant
-        (:class:`~dccrg_tpu.txn.GridInvariantError`)."""
+        (:class:`~dccrg_tpu.txn.GridInvariantError`).
+
+        With an :meth:`enable_distributed_amr` group installed the
+        commit instead runs the fleet-wide fenced protocol — same
+        return value, same atomicity per rank, plus the distributed
+        rollback/fencing guarantees documented in
+        dccrg_tpu/distamr.py. Without one, this is byte-for-byte the
+        single-controller commit."""
+        group = getattr(self, "_amr_group", None)
+        if group is not None:
+            from . import distamr
+
+            return distamr.distributed_stop_refining(self, group)
+        return self._stop_refining_local()
+
+    def _stop_refining_local(self) -> np.ndarray:
         from .amr import resolve_adaptation
 
         with telemetry.span("grid.adapt"), \
@@ -4135,19 +4173,35 @@ class Grid:
     # vectorized projection helpers (the idiomatic TPU versions of the
     # per-cell loops in tests/advection/adapter.hpp:229-301)
 
+    def _owned_subset(self, ids):
+        """The subset of ``ids`` on this process's devices — the
+        projection helpers write rank-locally on multi-process meshes
+        (the reference projects each process's own cells; under
+        distributed AMR the commit's ``_new_cells``/parents span the
+        whole fleet, and each peer projects its own share)."""
+        if len(ids) == 0 or not self._multiproc:
+            return ids
+        dev, _rows = self._host_rows(ids)
+        return ids[self._proc_local_dev[dev]]
+
     def assign_children_from_parents(self, fields=None) -> None:
-        """Copy each new child's value from its refined parent."""
-        if len(self._new_cells) == 0:
+        """Copy each new child's value from its refined parent
+        (process-local on multi-process meshes)."""
+        new = self._owned_subset(self._new_cells)
+        if len(new) == 0:
             return
-        parents = self.mapping.get_parent(self._new_cells)
+        parents = self.mapping.get_parent(new)
         for name in fields if fields is not None else self.fields:
-            self.set(name, self._new_cells, self.get_old_data(name, parents))
+            self.set(name, new, self.get_old_data(name, parents))
 
     def average_parents_from_children(self, fields=None) -> None:
-        """Set each unrefined parent to the mean of its removed children."""
+        """Set each unrefined parent to the mean of its removed
+        children (process-local on multi-process meshes)."""
         if len(self._removed_cells) == 0:
             return
-        parents = self._unrefined_parents
+        parents = self._owned_subset(self._unrefined_parents)
+        if len(parents) == 0:
+            return
         kids = self.mapping.get_all_children(parents)  # [n, 8]
         for name in fields if fields is not None else self.fields:
             vals = self.get_old_data(name, kids.reshape(-1))

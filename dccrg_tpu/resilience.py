@@ -1385,17 +1385,21 @@ class ResilientRunner:
         route through :meth:`dccrg_tpu.supervise.CheckpointStore.save`
         (numbered files, dirty-field delta saves).
 
-        With ``DCCRG_ASYNC_SAVE=1`` (single-controller only: the
-        multi-process two-phase commit's barriers belong to the rank's
-        main thread) the write runs on a background thread against a
-        :func:`dccrg_tpu.background.freeze_grid` snapshot, overlapped
+        With ``DCCRG_ASYNC_SAVE=1`` the write runs on a background
+        thread against a :func:`dccrg_tpu.background.freeze_grid`
+        snapshot (multi-process meshes through
+        :func:`dccrg_tpu.background.freeze_grid_mp`, whose two-phase
+        barriers rendezvous on the ranks' writer threads), overlapped
         with the following steps' dispatch — bitwise identical bytes,
         published atomically; :meth:`_drain_saves` is the barrier every
         store reader (rollback, run end) takes first."""
-        if background.async_save_enabled() and not self.grid._multiproc:
+        if background.async_save_enabled():
             saver = self._active_saver(create=True)
             saver.drain()  # one in flight; an earlier failure raises here
-            frozen = background.freeze_grid(self.grid)
+            frozen = (background.freeze_grid_mp(self.grid,
+                                                variable=self.variable)
+                      if self.grid._multiproc
+                      else background.freeze_grid(self.grid))
             path = self.checkpoint_path
             saver.submit(
                 lambda: save_checkpoint(frozen, path, header=self.header,

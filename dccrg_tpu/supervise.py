@@ -718,8 +718,11 @@ class CheckpointStore:
         whose tracking reflects whatever slot passed through last, but
         it knows exactly which fields its step program writes.
 
-        With ``DCCRG_ASYNC_SAVE=1`` (single-controller grids) the
-        write runs on a background thread against a frozen snapshot,
+        With ``DCCRG_ASYNC_SAVE=1`` the write runs on a background
+        thread against a frozen snapshot (:func:`~dccrg_tpu.background
+        .freeze_grid`; multi-process grids through
+        :func:`~dccrg_tpu.background.freeze_grid_mp`, whose two-phase
+        barriers rendezvous on the ranks' writer threads),
         overlapped with the next quantum's dispatch; the chain policy,
         the parent link and the dirty re-baseline are all resolved
         synchronously here, so the published bytes are bitwise
@@ -732,7 +735,7 @@ class CheckpointStore:
         self.drain()
         fields = self._delta_fields(grid, variable, force_keyframe,
                                     dirty_override=dirty_fields)
-        if not (background.async_save_enabled() and not grid._multiproc):
+        if not background.async_save_enabled():
             if fields is not None:
                 path = self.path_for(step, delta=True)
                 try:
@@ -770,7 +773,14 @@ class CheckpointStore:
                                "keyframe (%s)", step, e)
                 fields = None
         path = self.path_for(step, delta=fields is not None)
-        frozen = background.freeze_grid(grid, fields=fields)
+        # multi-process grids freeze through freeze_grid_mp: the
+        # two-phase commit's barriers are writer-thread safe (gRPC),
+        # and the snapshot removes the save path's device touch points
+        # (host-copy shard reads, KV CRC exchange, frozen count pulls)
+        frozen = (background.freeze_grid_mp(grid, fields=fields,
+                                            variable=variable)
+                  if grid._multiproc
+                  else background.freeze_grid(grid, fields=fields))
 
         def _write(path=path, fields=fields, extra=extra):
             resilience.save_checkpoint(frozen, path, header=header,

@@ -49,6 +49,7 @@ import os
 import tempfile
 from contextlib import contextmanager
 
+from . import faults as faults_mod
 from . import verify as verify_mod
 
 
@@ -84,6 +85,55 @@ class GridInvariantError(MutationError):
         super().__init__(
             f"{op} violated a grid invariant, commit rolled back "
             f"({cause})", cells=cells)
+
+
+class CrossRankAbortedError(MutationAbortedError):
+    """A DISTRIBUTED structural mutation aborted on this rank. The
+    local half is the inherited contract: the grid — request sets
+    included — is bitwise its pre-mutation state and the mutation can
+    be retried. The distributed half already happened by the time this
+    propagates: the abort was ANNOUNCED to every peer inside the same
+    collective commit (the ``on_abort`` hook posts the abort marker
+    their fenced barriers fast-abort on), so the whole fleet rolls
+    back together instead of the survivors waiting out a timeout.
+    ``rank`` names the aborting rank."""
+
+    def __init__(self, op: str, cause: BaseException, rank: int = -1,
+                 cells=()):
+        self.rank = int(rank)
+        super().__init__(op, cause, cells=cells)
+
+
+@contextmanager
+def cross_rank_transaction(grid, op: str = "distributed_mutation", *,
+                           rank: int = -1, on_abort=None, validate=None):
+    """:func:`grid_transaction` plus distributed rollback: any failure
+    rolls this rank back bitwise (inherited) and then invokes
+    ``on_abort(error)`` — the distributed-AMR commit posts its abort
+    marker there, so peers blocked in the round's
+    :func:`~dccrg_tpu.coord.kv_barrier` / proposal collects abort
+    immediately instead of burning their deadline. Re-raises as
+    :class:`CrossRankAbortedError`.
+
+    Two failure classes deliberately bypass the announcement: an
+    :class:`~dccrg_tpu.faults.InjectedRankDeath` (a kill -9 cannot
+    post markers — peers must convict it by lease/timeout, which is
+    the invariant under test) and ``BaseException`` (interpreter
+    teardown)."""
+    try:
+        with grid_transaction(grid, op=op, validate=validate):
+            yield
+    except MutationError as e:
+        if on_abort is not None:
+            try:
+                on_abort(e)
+            except Exception:  # noqa: BLE001 - announcing is best-effort
+                pass
+        if isinstance(e, CrossRankAbortedError):
+            raise
+        cause = e.__cause__ if e.__cause__ is not None else e
+        raise CrossRankAbortedError(
+            op, cause, rank=rank, cells=e.cells) from cause
 
 
 _MISSING = object()
@@ -214,6 +264,14 @@ def grid_transaction(grid, op: str = "mutation", validate=None):
         except Exception as e:
             _discard_bg(grid)
             restore_state(grid, snap)
+            if isinstance(e, faults_mod.InjectedRankDeath):
+                # a simulated kill -9: the process is about to die (the
+                # mp harness hard-exits the OS process on it), so keep
+                # the type — peers key their recovery on the DEATH, not
+                # on an abort this corpse could never announce. The
+                # rollback above still runs: a consistent grid costs
+                # nothing and the in-process fakes assert against it.
+                raise
             raise MutationAbortedError(
                 op, e, cells=tuple(getattr(e, "cells", ()) or ())) from e
         except BaseException:

@@ -20,6 +20,17 @@
 # tests/test_multiprocess.py (which run in tier-1) with actual OS
 # processes.
 #
+# The distributed-AMR scenarios (amr_commit / amr_rank_kill /
+# amr_zombie: epoch-fenced collective structure commits over the live
+# coordination KV, a REAL rank death at each commit phase, a REAL
+# SIGSTOPped zombie proposer losing to the fence) and the async
+# writer-thread mp-save scenarios (async_save / async_save_kill) ride
+# the default 2-process sweep. The single-process dist-AMR fuzz leg
+# below additionally sweeps injected aborts at EVERY protocol phase —
+# including "prepare", which no real-process kill can cover (a
+# survivor inside the prepare device gather blocks in the gloo
+# collective when its peer dies).
+#
 # Skips cleanly (exit 0, with a notice) where jax.distributed on CPU
 # is unavailable — the harness probes the environment first and exits
 # 77 in that case. Seeds are deterministic (fuzz.py style): pass
@@ -55,4 +66,8 @@ for sc in host_death zombie_fence host_rejoin; do
         exit $rc
     fi
 done
+# single-process dist-AMR fuzz: N faked ranks' full protocol rounds
+# (commit parity + injected aborts at every phase, prepare included)
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m dccrg_tpu.fuzz --dist-amr 2
 exit 0

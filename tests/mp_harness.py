@@ -77,6 +77,40 @@ drives the scenarios the faked splits cannot truthfully exercise:
   second wave of jobs enters every rank's queue once rank 1 is
   observed live again, and the deterministic partition hands the
   rejoined rank work it serves to completion.
+- ``amr_commit``    — distributed AMR (dccrg_tpu/distamr.py): the
+  ranks run two adapt epochs end to end — rank-local refines, the
+  sealed proposal exchange, resolve/prepare digest agreement, the
+  epoch-fenced collective install — over the LIVE coordination KV.
+  Plan digests must agree on every rank, the fence must advance
+  exactly once per epoch, and epoch 2 runs the background
+  (PlanBuildWorker) prepare build. Prints per-epoch commit wall
+  times (``AMR_COMMIT_SECONDS`` — the PERF.md numbers).
+- ``amr_rank_kill`` — a FaultPlan ``rank_death`` really exits rank
+  1's OS process at EACH commit phase in AMR_KILL_PHASES; the
+  survivor must abort TYPED within its barrier bound and keep
+  serving the OLD plan bitwise: structure digest, payload bytes and
+  the restored (collectively retryable) request sets. See
+  AMR_KILL_PHASES on why "prepare" is exercised by the faked tier-1
+  suite and the fuzzer instead.
+- ``amr_zombie``    — the stale proposer fence: rank 1 stalls inside
+  the propose phase (an injected hang, plus a REAL SIGSTOP from the
+  parent) past rank 0's barrier deadline; rank 0 aborts typed,
+  stays on the old plan bitwise, and advances the fence — standing
+  in for a re-formed fleet's commit. The woken zombie must LOSE:
+  StaleFenceError, bitwise rollback, never a stale install.
+- ``async_save``    — the async (writer-thread) two-phase mp save:
+  each rank freezes through ``background.freeze_grid_mp`` and hands
+  the save to an AsyncSaver writer — the REAL prepare/commit
+  barriers rendezvous on the writer threads, the commit CRC table
+  crosses through sealed KV records — while the main threads keep
+  dispatching real collectives and mutate the LIVE grid. The
+  published file must be byte-identical to a synchronous save of
+  the same (pre-mutation) state.
+- ``async_save_kill`` — a rank death on rank 1's WRITER thread
+  mid-slice: the drain surfaces it on the main thread and the OS
+  process really exits; rank 0's writer aborts typed at its barrier
+  bound, the previous checkpoint stays bitwise intact, and nothing
+  is ever published.
 
 Runs are DETERMINISTIC: ``--seed`` drives the field values and fault
 placement the same way fuzz.py's seeds do — two runs with the same
@@ -115,7 +149,8 @@ RESUMABLE_RC = 75  # supervise.RESUMABLE_EXIT (EX_TEMPFAIL)
 SCENARIOS = ("save_restore", "psum", "barrier_timeout", "rank_kill",
              "consensus", "sdc_rank", "preempt", "delta_rank_kill",
              "trace_merge", "host_death", "zombie_fence",
-             "host_rejoin")
+             "host_rejoin", "amr_commit", "amr_rank_kill",
+             "amr_zombie", "async_save", "async_save_kill")
 # elastic-fleet scenario knobs: tight heartbeat/lease bounds so the
 # whole detect->reclaim->drain recovery fits inside the ~10 s window
 # jax's coordination service grants survivors after a peer dies
@@ -135,6 +170,19 @@ DELTA_LEGS = ("delta_restore", "delta_kill")
 # at rank 1 (the _ckpt_commits override checkpoint.py honors), so the
 # death still lands on the committing rank mid-commit.
 DELTA_KILL_PHASES = ("meta", "slice", "written", "commit", "publish")
+# distributed-AMR commit phases a rank death is injected at (the
+# faults.py dist-AMR sites; see faults.DIST_AMR_FAULT_SITES).
+# "prepare" is deliberately NOT in this list: the survivor's prepare
+# work IS a cross-process device gather (a shard_map psum), so with
+# its peer already dead it blocks inside the gloo collective — the
+# bound hit would be the runtime's, not the commit protocol's.
+# Prepare-phase aborts are pinned by the faked tier-1 suite
+# (tests/test_distamr.py) and the fuzzer's --dist-amr leg, where
+# every rank's collectives run in one process.
+AMR_KILL_PHASES = ("propose", "resolve", "commit")
+AMR_KILL_SITES = {"propose": ("amr.propose", None),
+                  "resolve": ("amr.resolve", None),
+                  "commit": ("amr.install", "commit")}
 
 
 # =====================================================================
@@ -1039,6 +1087,289 @@ def scenario_host_rejoin(args):
         print(f"[rank 1] REJOIN_SERVED {sorted(local2)}", flush=True)
 
 
+def _mk_amr_grid(seed: int):
+    """Like ``_mk_grid`` but REFINABLE (max level 1) — the distributed
+    AMR scenarios need cells whose refinement the commit protocol can
+    actually install."""
+    import jax.numpy as jnp
+
+    from dccrg_tpu.grid import Grid
+
+    g = (Grid(cell_data={"v": jnp.float32})
+         .set_initial_length((8, 8, 4))
+         .set_periodic(True, True, False)
+         .set_maximum_refinement_level(1)
+         .set_neighborhood_length(1)
+         .set_load_balancing_method("block")
+         .initialize())
+    cells = g.plan.cells
+    g.set("v", cells, _expected(cells, seed))
+    return g
+
+
+def _amr_picks(g, rank: int, seed: int, count: int = 4):
+    """``count`` still-refinable locally-owned cells of ``g``,
+    seed-deterministic per rank (fuzz.py style)."""
+    import numpy as np
+
+    cells, owner = g.plan.cells, g.plan.owner
+    lvl = g.mapping.get_refinement_level(cells)
+    mask = g._proc_local_dev[owner] & (lvl < g.mapping.max_refinement_level)
+    mine = cells[mask]
+    rng = np.random.default_rng(seed * 1000 + rank)
+    return sorted(int(c) for c in
+                  rng.choice(mine, size=min(count, len(mine)),
+                             replace=False))
+
+
+def _amr_local_crc(g) -> int:
+    """CRC of this rank's locally-owned payload — the bitwise
+    'still serving the old plan' witness of the abort scenarios."""
+    import zlib
+
+    import numpy as np
+
+    mine = g.plan.cells[g._proc_local_dev[g.plan.owner]]
+    return zlib.crc32(np.asarray(g.get("v", mine)).tobytes())
+
+
+def scenario_amr_commit(args):
+    """Two distributed adapt epochs over the live coordination KV (see
+    module docstring); epoch 2 exercises the background-build prepare
+    path (DCCRG_BG_RECOMMIT=1)."""
+    import numpy as np
+
+    from dccrg_tpu import coord, distamr
+
+    g = _mk_amr_grid(args.seed)
+    assert g._multiproc, "harness grid must span processes"
+    group = g.enable_distributed_amr(timeout=60)
+    for epoch in (1, 2):
+        picks = _amr_picks(g, args.rank, args.seed + epoch)
+        for c in picks:
+            g.refine_completely(c)
+        if epoch == 2:
+            os.environ["DCCRG_BG_RECOMMIT"] = "1"
+        try:
+            t0 = time.monotonic()
+            new = g.stop_refining()
+            dt = time.monotonic() - t0
+        finally:
+            os.environ.pop("DCCRG_BG_RECOMMIT", None)
+        # every rank's requests landed: >= 8 children per LOCAL pick
+        # alone (the fleet-wide set also carries the peers' children)
+        assert len(new) >= 8 * len(picks), (len(new), picks)
+        g.assign_children_from_parents(fields=["v"])
+        g.clear_refined_unrefined_data()
+        assert group.read_fence() == epoch, group.read_fence()
+        dig = f"{distamr.plan_digest(g.plan):08x}"
+        digs = _kv_allgather(f"amr_plan_{epoch}", dig, args.rank,
+                             args.procs)
+        assert len(set(digs)) == 1, f"plan diverged: {digs}"
+        print(f"[rank {args.rank}] DIGEST amr_epoch{epoch} {dig}",
+              flush=True)
+        print(f"[rank {args.rank}] AMR_COMMIT_SECONDS epoch{epoch} "
+              f"{dt:.3f}", flush=True)
+    # unrefined original cells kept their payload bitwise through two
+    # install/migrate rounds
+    cells = g.plan.cells
+    keep = cells[(g.mapping.get_refinement_level(cells) == 0)
+                 & g._proc_local_dev[g.plan.owner]]
+    np.testing.assert_array_equal(np.asarray(g.get("v", keep)),
+                                  _expected(keep, args.seed))
+    coord.barrier("amr_commit_done", timeout=60)
+
+
+def scenario_amr_kill(args):
+    """One REAL rank death at the ``--phase`` commit phase (see
+    AMR_KILL_SITES); the survivor must abort typed within its barrier
+    bound and keep serving the OLD plan bitwise. NO retry here: a
+    surviving retry's install is a device-gather collective the dead
+    peer can no longer join on a real gloo mesh — retry-over-survivors
+    is pinned by tests/test_distamr.py with a scriptable membership
+    view."""
+    from dccrg_tpu import coord, distamr, faults, txn
+
+    # tight bound: jax's coordination service hard-kills survivors
+    # ~10s after a peer dies, so abort + asserts must finish first
+    os.environ["DCCRG_BARRIER_TIMEOUT"] = "3"
+    site, phase = AMR_KILL_SITES[args.phase]
+    g = _mk_amr_grid(args.seed)
+    group = g.enable_distributed_amr(timeout=3)
+    picks = _amr_picks(g, args.rank, args.seed)
+    for c in picks:
+        g.refine_completely(c)
+    pre_plan = distamr.plan_digest(g.plan)
+    pre_bytes = _amr_local_crc(g)
+    if args.rank == 1:
+        plan = faults.FaultPlan(seed=args.seed)
+        plan.rank_death(site=site, phase=phase, rank=None)
+        with plan:
+            g.stop_refining()  # InjectedRankDeath -> os._exit(DEATH_RC)
+        raise AssertionError("rank 1 should have died mid-commit")
+    try:
+        g.stop_refining()
+        raise AssertionError("commit decided with a dead rank")
+    except txn.CrossRankAbortedError as e:
+        assert isinstance(e.__cause__, coord.BarrierTimeoutError), \
+            repr(e.__cause__)
+    assert distamr.plan_digest(g.plan) == pre_plan, "plan changed"
+    assert _amr_local_crc(g) == pre_bytes, "payload changed"
+    assert sorted(g._refines) == picks, "requests not restored"
+    assert group.read_fence() == 0, "fence moved without a commit"
+    print(f"[rank {args.rank}] DIGEST amr_kill_{args.phase} "
+          f"{pre_plan:08x}", flush=True)
+
+
+def scenario_amr_zombie(args):
+    """The stale proposer fence with a REAL stalled process (see
+    module docstring). Rank 1 hangs inside propose past rank 0's
+    barrier deadline (the parent layers a real SIGSTOP/SIGCONT round
+    trip on the stall); rank 0 aborts typed, then advances the fence
+    the way a re-formed fleet's commit would. The zombie must lose."""
+    from dccrg_tpu import coord, distamr, faults, txn
+
+    os.environ["DCCRG_BARRIER_TIMEOUT"] = "3"
+    g = _mk_amr_grid(args.seed)
+    group = g.enable_distributed_amr(
+        timeout=(30 if args.rank == 1 else 3))
+
+    def probe(phase, rank):  # the parent's SIGSTOP cue point
+        with open(os.path.join(args.tmp, f"amr_phase.rank{rank}"),
+                  "w") as f:
+            f.write(phase)
+
+    distamr._PHASE_PROBE = probe
+    picks = _amr_picks(g, args.rank, args.seed)
+    for c in picks:
+        g.refine_completely(c)
+    pre_plan = distamr.plan_digest(g.plan)
+    pre_bytes = _amr_local_crc(g)
+
+    if args.rank == 1:  # the zombie: stalls, wakes into a moved fence
+        plan = faults.FaultPlan(seed=args.seed)
+        plan.amr_hang(site="amr.propose", hang_s=6.0, rank=None)
+        with plan:
+            try:
+                g.stop_refining()
+                raise AssertionError("zombie finished the stale round")
+            except txn.CrossRankAbortedError as e:
+                assert isinstance(e.__cause__, coord.StaleFenceError), \
+                    repr(e.__cause__)
+        assert plan.fired("amr.propose.hang") == 1
+        assert distamr.plan_digest(g.plan) == pre_plan
+        assert _amr_local_crc(g) == pre_bytes
+        assert sorted(g._refines) == picks
+        print(f"[rank 1] FENCED amr fence={group.read_fence()}",
+              flush=True)
+        return
+    # rank 0: the stall exhausts this rank's barrier bound
+    try:
+        g.stop_refining()
+        raise AssertionError("commit decided without the stalled rank")
+    except txn.CrossRankAbortedError as e:
+        assert isinstance(e.__cause__, coord.BarrierTimeoutError), \
+            repr(e.__cause__)
+    assert distamr.plan_digest(g.plan) == pre_plan
+    assert _amr_local_crc(g) == pre_bytes
+    # stand in for the re-formed survivors' next commit: move the fence
+    group.kv.set(group.fence_key(), "1")
+    with open(os.path.join(args.tmp, "amr_zombie.fenced.rank0"),
+              "w") as f:
+        f.write("1")
+    print(f"[rank 0] DIGEST amr_zombie {pre_plan:08x}", flush=True)
+    # rank 0 is the jax.distributed LEADER: exiting now would take the
+    # coordination service down mid-assertion on the zombie — wait for
+    # its success marker (child_main writes it after the scenario)
+    marker1 = os.path.join(args.tmp, "amr_zombie.rank1.ok")
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if os.path.exists(marker1):
+            return
+        time.sleep(0.1)
+    raise AssertionError("zombie never finished its fence verdict")
+
+
+def scenario_async_save(args):
+    """The async (writer-thread) two-phase mp save on REAL ranks (see
+    module docstring): bitwise vs a synchronous save, with real
+    collectives dispatched and the LIVE grid mutated mid-write."""
+    import zlib
+
+    import numpy as np
+
+    from dccrg_tpu import background, coord, resilience
+
+    g = _mk_grid(args.seed)
+    cells = g.plan.cells
+    fn_sync = os.path.join(args.tmp, "sync.dc")
+    resilience.save_checkpoint(g, fn_sync)
+    assert resilience.verify_checkpoint(fn_sync) == []
+    with open(fn_sync, "rb") as f:
+        sync_crc = f"{zlib.crc32(f.read()):08x}"
+
+    fn = os.path.join(args.tmp, "async.dc")
+    frozen = background.freeze_grid_mp(g)
+    assert frozen._ckpt_crc_via_kv, "mp freeze must take the gRPC CRC path"
+    saver = background.AsyncSaver()
+    saver.submit(lambda: resilience.save_checkpoint(frozen, fn))
+    # the overlap the feature exists for: real cross-process
+    # collectives from the MAIN thread while the writer saves
+    for _ in range(3):
+        g.update_copies_of_remote_neighbors()
+    # and a LIVE mutation that must never reach the frozen bytes
+    mine = cells[g._proc_local_dev[g.plan.owner]]
+    g.set("v", mine, np.full(len(mine), -5.0, np.float32))
+    saver.drain()
+    assert resilience.verify_checkpoint(fn) == []
+    with open(fn, "rb") as f:
+        crc = f"{zlib.crc32(f.read()):08x}"
+    assert crc == sync_crc, f"async bytes differ: {crc} != {sync_crc}"
+    hashes = _kv_allgather("async_save_crc", crc, args.rank, args.procs)
+    assert len(set(hashes)) == 1, hashes
+    print(f"[rank {args.rank}] DIGEST async_save {crc}", flush=True)
+    coord.barrier("async_save_done", timeout=60)
+
+
+def scenario_async_save_kill(args):
+    """A REAL rank death on rank 1's writer thread mid-slice (see
+    module docstring): the drain surfaces it, the process exits hard;
+    rank 0's writer aborts typed and the old checkpoint survives."""
+    import numpy as np
+
+    from dccrg_tpu import background, coord, faults, resilience
+
+    os.environ["DCCRG_BARRIER_TIMEOUT"] = "3"
+    g = _mk_grid(args.seed)
+    cells = g.plan.cells
+    fn = os.path.join(args.tmp, "kill.dc")
+    resilience.save_checkpoint(g, fn)  # the good checkpoint
+    with open(fn, "rb") as f:
+        good = f.read()
+
+    # new state that must never reach the final name
+    mine = cells[g._proc_local_dev[g.plan.owner]]
+    g.set("v", mine, np.full(len(mine), 123.0, np.float32))
+    frozen = background.freeze_grid_mp(g)
+    saver = background.AsyncSaver()
+    if args.rank == 1:
+        plan = faults.FaultPlan(seed=args.seed)
+        plan.rank_death(phase="slice", rank=None)
+        with plan:
+            saver.submit(lambda: resilience.save_checkpoint(frozen, fn))
+            saver.drain()  # re-raises InjectedRankDeath off the writer
+        raise AssertionError("rank 1 should have died mid-slice")
+    saver.submit(lambda: resilience.save_checkpoint(frozen, fn))
+    try:
+        saver.drain()
+        raise AssertionError("async save completed despite a dead rank")
+    except coord.BarrierTimeoutError as e:
+        assert "save_commit" in e.tag or "save_prepare" in e.tag, e.tag
+    with open(fn, "rb") as f:
+        assert f.read() == good, "dead rank tore the old checkpoint"
+    assert resilience.verify_checkpoint(fn) == []
+
+
 CHILD_SCENARIOS = {
     "probe": scenario_probe,
     "save_restore": scenario_save_restore,
@@ -1056,6 +1387,11 @@ CHILD_SCENARIOS = {
     "host_death": scenario_host_death,
     "zombie_fence": scenario_zombie_fence,
     "host_rejoin": scenario_host_rejoin,
+    "amr_commit": scenario_amr_commit,
+    "amr_kill": scenario_amr_kill,
+    "amr_zombie": scenario_amr_zombie,
+    "async_save": scenario_async_save,
+    "async_save_kill": scenario_async_save_kill,
 }
 
 
@@ -1164,7 +1500,8 @@ def _run_scenario(scenario: str, args, expect_rcs=None, extra=()) -> str:
     else:
         for out in outs:  # relay digests for determinism comparisons
             for line in out.splitlines():
-                if " DIGEST " in line:
+                if (" DIGEST " in line
+                        or " AMR_COMMIT_SECONDS " in line):
                     print(f"  {line}")
     return "ok" if ok else "fail"
 
@@ -1380,6 +1717,54 @@ def _run_delta(args) -> str:
     return "ok"
 
 
+def _run_amr_kill(args) -> str:
+    """The distributed-AMR kill loop: one REAL rank death per commit
+    phase in AMR_KILL_PHASES (the death always lands on rank 1 —
+    rank 0 is the jax.distributed leader, see DELTA_KILL_PHASES)."""
+    for phase in AMR_KILL_PHASES:
+        expect = [DEATH_RC if r == 1 else 0 for r in range(args.procs)]
+        v = _run_scenario("amr_kill", args, expect_rcs=expect,
+                          extra=("--phase", phase))
+        print(f"    amr_kill[{phase:<7}] {v}")
+        if v != "ok":
+            return v
+    return "ok"
+
+
+def _run_amr_zombie(args) -> str:
+    """amr_zombie with a REAL signal round trip layered on the
+    in-child stall: SIGSTOP rank 1 once it reports the propose phase,
+    SIGCONT it once rank 0 has advanced the fence. The injected hang
+    alone already guarantees the zombie wakes into a moved fence —
+    the signals make it an actually-stopped OS process meanwhile (the
+    stop window stays well inside the coordination service's
+    missed-heartbeat tolerance)."""
+    import signal as signal_mod
+
+    procs = _spawn("amr_zombie", args)
+    tmp = os.path.join(args.tmp, "amr_zombie")
+    deadline = time.monotonic() + args.timeout
+    stopped = False
+    if _wait_progress(os.path.join(tmp, "amr_phase.rank1"),
+                      lambda t: t == "propose", deadline, procs):
+        procs[1].send_signal(signal_mod.SIGSTOP)
+        stopped = True
+        _wait_progress(os.path.join(tmp, "amr_zombie.fenced.rank0"),
+                       lambda t: t == "1", deadline, procs)
+        procs[1].send_signal(signal_mod.SIGCONT)
+    outs, rcs = _collect(procs, deadline)
+    if any(rc == SKIP_RC for rc in rcs):
+        return "skip"
+    ok = stopped and _survivors_ok("amr_zombie", args, rcs)
+    if ok:
+        ok = any("FENCED" in out for out in outs)
+    if not ok:
+        _dump_fail("amr_zombie", outs, rcs, f"(stopped: {stopped})")
+        return "fail"
+    _relay_digests(outs)
+    return "ok"
+
+
 def _run_preempt(args) -> str:
     """The SIGTERM round trip (see module docstring): ref run, real
     mid-run kill of rank 1, resume — and the resumed digest must be
@@ -1442,6 +1827,15 @@ def parent_main(args) -> int:
         if sc in ("zombie_fence", "host_rejoin"):
             def run(_sc, args_, expect_rcs=None, sc=sc):  # noqa: ARG001
                 return _run_stop_cont(sc, args_)
+        if sc == "amr_rank_kill":  # parent-orchestrated phase loop
+            def run(_sc, args_, expect_rcs=None):  # noqa: ARG001
+                return _run_amr_kill(args_)
+        if sc == "amr_zombie":  # parent-orchestrated real SIGSTOP
+            def run(_sc, args_, expect_rcs=None):  # noqa: ARG001
+                return _run_amr_zombie(args_)
+        if sc == "async_save_kill":
+            expect = [DEATH_RC if r == 1 else 0
+                      for r in range(args.procs)]
         verdict = run(sc, args, expect_rcs=expect)
         print(f"  {sc:<16} {verdict}")
         if verdict == "fail":
@@ -1465,13 +1859,13 @@ def main(argv=None) -> int:
     ap.add_argument("--procs", type=int, default=2)
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--scenario", default=None,
-                    choices=(None, "probe") + SCENARIOS + PREEMPT_PHASES
-                            + DELTA_LEGS)
+                    choices=(None, "probe", "amr_kill") + SCENARIOS
+                            + PREEMPT_PHASES + DELTA_LEGS)
     ap.add_argument("--store", default="",
                     help="shared checkpoint-store dir of the preempt "
                          "phases (parent-provided)")
     ap.add_argument("--phase", default="",
-                    help="two-phase-commit phase the delta_kill leg "
+                    help="commit phase the delta_kill / amr_kill leg "
                          "injects the rank death at (parent-provided)")
     ap.add_argument("--seed", type=int, default=0,
                     help="deterministic data/fault seed (fuzz.py style)")

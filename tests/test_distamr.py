@@ -1,0 +1,429 @@
+"""Tier-1 tests of the crash-safe distributed AMR commit
+(dccrg_tpu/distamr.py): two faked in-process ranks (process-split
+device masks over the 8 virtual CPU devices, one shared
+:class:`~dccrg_tpu.coord.InMemoryKV`, one protocol thread per rank)
+drive the real four-phase epoch-fenced protocol end to end.
+
+What is pinned here:
+
+- a fault-free two-rank commit installs the SAME structure the
+  single-controller path produces from the merged request sets, and
+  each rank's locally-owned payload matches it bitwise;
+- an injected failure at EVERY named fault point
+  (:data:`~dccrg_tpu.faults.DIST_AMR_FAULT_SITES`) aborts the round
+  COLLECTIVELY — the victim by the injected error, the peer by the
+  posted abort marker — with both ranks bitwise rolled back (plan,
+  payload, request sets, fence) and the fault-free retry committing;
+- a torn proposal record is convicted by its CRC frame, never parsed;
+- a zombie proposer whose epoch fence advanced underneath it loses
+  with a typed :class:`~dccrg_tpu.coord.StaleFenceError` and keeps
+  serving the OLD plan bitwise;
+- a peer death mid-round aborts the survivor typed
+  (:class:`~dccrg_tpu.coord.PeerDeadError` through the membership
+  lease view) and the retry RE-FORMS over the survivors and commits —
+  the dead rank's requests are dropped, its grid stays bitwise
+  pre-commit;
+- ``stop_refining`` without a commit group is byte-for-byte the
+  pre-refactor single-controller commit.
+
+The REAL-process versions (actual ``kill -9`` mid-phase, a stalled
+proposer fenced across OS processes) live in tests/mp_harness.py; the
+random-schedule version is ``python -m dccrg_tpu.fuzz --dist-amr``.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dccrg_tpu import amr, coord, distamr, faults, fuzz, txn
+from dccrg_tpu.grid import DEFAULT_NEIGHBORHOOD_ID, Grid
+
+# jax dispatch is not thread-safe; the per-rank protocol threads
+# serialize every device-touching call on one lock (the PlanBuildWorker
+# / fuzz.dist_amr_case discipline)
+JLOCK = threading.Lock()
+
+
+def _mk(length=(8, 8, 4), max_lvl=1):
+    g = (Grid(cell_data={"v": jnp.float32})
+         .set_initial_length(length)
+         .set_periodic(True, True, False)
+         .set_maximum_refinement_level(max_lvl)
+         .set_neighborhood_length(1)
+         .initialize(partition="block"))
+    cells = g.plan.cells
+    g.set("v", cells, (cells % np.uint64(23)).astype(np.float32))
+    return g
+
+
+def _fake_split(g, rank):
+    half = g.n_dev // 2
+    devs = range(half) if rank == 0 else range(half, g.n_dev)
+    g._proc_local_dev = np.array(
+        [d in set(devs) for d in range(g.n_dev)], dtype=bool)
+    g._ckpt_rank = rank
+    return sorted(devs)
+
+
+def _serialize_jax(g):
+    ig, dg = g._install_plan, g._device_gather
+
+    def install(plan, same_cells=None):
+        with JLOCK:
+            return ig(plan, same_cells=same_cells)
+
+    def gather(name, dev, rows, cap=None):
+        with JLOCK:
+            return dg(name, dev, rows, cap=cap)
+
+    g._install_plan = install
+    g._device_gather = gather
+
+
+def _pair(kv=None, timeout=60, membership=None):
+    """Two faked ranks sharing one KV, distamr enabled; returns
+    (kv, {rank: grid})."""
+    kv = kv if kv is not None else coord.InMemoryKV()
+    grids = {}
+    with JLOCK:
+        for rank in (0, 1):
+            g = _mk()
+            _fake_split(g, rank)
+            _serialize_jax(g)
+            g.enable_distributed_amr(kv=kv, rank=rank, n_ranks=2,
+                                     timeout=timeout,
+                                     membership=membership)
+            grids[rank] = g
+    return kv, grids
+
+
+def _run_ranks(grids, fn, join_s=120):
+    """fn(rank, grid) on one thread per rank; returns {rank: error}."""
+    errs = {}
+
+    def body(rank):
+        try:
+            fn(rank, grids[rank])
+            errs[rank] = None
+        except BaseException as e:  # noqa: BLE001 - asserted by caller
+            errs[rank] = e
+
+    ts = {r: threading.Thread(target=body, args=(r,)) for r in grids}
+    for t in ts.values():
+        t.start()
+    for t in ts.values():
+        t.join(join_s)
+    assert all(not t.is_alive() for t in ts.values()), "rank wedged"
+    return errs
+
+
+def _digest(g):
+    with JLOCK:
+        return fuzz._dist_amr_digest(g)
+
+
+def _local_reqs(g, rank, count=4, stride=3):
+    """``count`` locally-owned level-0 cells of ``rank``, spread out."""
+    half = g.n_dev // 2
+    devs = range(half) if rank == 0 else range(half, g.n_dev)
+    mine = g.plan.cells[np.isin(g.plan.owner, list(devs))]
+    return [int(c) for c in mine[: count * stride : stride]]
+
+
+def _merged_reference(reqs):
+    """The single-controller commit of the MERGED request sets — what
+    every rank's installed structure must equal bitwise."""
+    ref = _mk()
+    for r in sorted(reqs):
+        for c in reqs[r]:
+            ref.refine_completely(c)
+    ref.stop_refining()
+    ref.assign_children_from_parents(fields=["v"])
+    ref.clear_refined_unrefined_data()
+    return ref
+
+
+def _assert_matches_reference(grids, ref):
+    ref_cells = ref.plan.cells
+    ref_owner = ref.plan.owner
+    ref_v = ref.get("v", ref_cells)
+    half = grids[0].n_dev // 2
+    for rank, g in grids.items():
+        np.testing.assert_array_equal(g.plan.cells, ref_cells,
+                                      err_msg=f"rank {rank} cells")
+        np.testing.assert_array_equal(g.plan.owner, ref_owner,
+                                      err_msg=f"rank {rank} owner")
+        # the faked split only materializes THIS rank's writes (the
+        # foreign shards' writes happen in the other real process);
+        # compare the locally-owned payload bitwise
+        mine = np.isin(ref_owner, list(
+            range(half) if rank == 0 else range(half, g.n_dev)))
+        g._proc_local_dev = np.ones(g.n_dev, dtype=bool)
+        np.testing.assert_array_equal(g.get("v", ref_cells[mine]),
+                                      ref_v[mine])
+
+
+def test_two_rank_commit_matches_single_controller():
+    kv, grids = _pair()
+    reqs = {r: _local_reqs(grids[0], r) for r in (0, 1)}
+    ref = _merged_reference(reqs)
+
+    def body(rank, g):
+        for c in reqs[rank]:
+            g.refine_completely(c)
+        new = g.stop_refining()
+        assert len(new) == 8 * len(set(reqs[0]) | set(reqs[1]))
+
+    errs = _run_ranks(grids, body)
+    assert not any(errs.values()), errs
+    with JLOCK:
+        for g in grids.values():
+            g.assign_children_from_parents(fields=["v"])
+            g.clear_refined_unrefined_data()
+    assert grids[0]._amr_group.read_fence() == 1
+    _assert_matches_reference(grids, ref)
+
+
+@pytest.mark.parametrize("site,phase", faults.DIST_AMR_FAULT_SITES)
+@pytest.mark.parametrize("victim", [0, 1])
+def test_injected_abort_rolls_back_both_ranks_bitwise(site, phase,
+                                                      victim):
+    """An error at any named fault point aborts the round on EVERY
+    rank — the victim typed by the injected fault, the peer fast-
+    aborted by the posted marker — both bitwise pre-commit; the
+    fault-free retry then commits the same merged structure."""
+    kv, grids = _pair()
+    reqs = {r: _local_reqs(grids[0], r) for r in (0, 1)}
+    with JLOCK:
+        for r, g in grids.items():
+            for c in reqs[r]:
+                g.refine_completely(c)
+    before = {r: _digest(g) for r, g in grids.items()}
+
+    plan = faults.FaultPlan().amr_error(site=site, phase=phase,
+                                        rank=victim)
+    with plan:
+        errs = _run_ranks(grids, lambda _r, g: g.stop_refining())
+    assert plan.fired(site) == 1, plan.log
+    for r, e in errs.items():
+        assert isinstance(e, txn.CrossRankAbortedError), (r, e)
+    cause_v = errs[victim].__cause__
+    cause_p = errs[1 - victim].__cause__
+    assert isinstance(cause_v, faults.InjectedMutationError), cause_v
+    assert isinstance(cause_p, coord.RemoteAbortError), cause_p
+    assert cause_p.rank == victim
+    for r, g in grids.items():
+        assert _digest(g) == before[r], f"rank {r} not bitwise"
+
+    # the epoch is collectively retryable: same requests, no fault
+    ref = _merged_reference(reqs)
+    errs = _run_ranks(grids, lambda _r, g: g.stop_refining())
+    assert not any(errs.values()), errs
+    with JLOCK:
+        for g in grids.values():
+            g.assign_children_from_parents(fields=["v"])
+            g.clear_refined_unrefined_data()
+    assert grids[0]._amr_group.read_fence() == 1
+    _assert_matches_reference(grids, ref)
+
+
+def test_torn_proposal_record_convicted_never_parsed():
+    """A proposal whose sealed frame fails its CRC (the writer died
+    mid-write) aborts the round for everyone; nobody acts on it."""
+    kv, grids = _pair()
+    reqs = {r: _local_reqs(grids[0], r) for r in (0, 1)}
+    with JLOCK:
+        for r, g in grids.items():
+            for c in reqs[r]:
+                g.refine_completely(c)
+    before = {r: _digest(g) for r, g in grids.items()}
+
+    plan = faults.FaultPlan().amr_torn_record(site="amr.propose",
+                                              rank=0)
+    with plan:
+        errs = _run_ranks(grids, lambda _r, g: g.stop_refining())
+    assert plan.fired("amr.propose.torn") == 1, plan.log
+    for r, e in errs.items():
+        assert isinstance(e, txn.CrossRankAbortedError), (r, e)
+    # at least one rank convicted the frame itself; the other may have
+    # been fast-aborted by the marker first — both are typed aborts
+    causes = {type(e.__cause__) for e in errs.values()}
+    assert coord.TornRecordError in causes, causes
+    for r, g in grids.items():
+        assert _digest(g) == before[r], f"rank {r} not bitwise"
+
+    errs = _run_ranks(grids, lambda _r, g: g.stop_refining())
+    assert not any(errs.values()), errs
+    assert grids[0]._amr_group.read_fence() == 1
+
+
+def test_zombie_proposer_loses_to_advanced_fence(monkeypatch):
+    """A rank that stalls after reading the fence and wakes after the
+    survivors committed a new epoch must LOSE: typed
+    StaleFenceError, bitwise rollback, old plan still served."""
+    kv, grids = _pair()
+    g = grids[1]
+    with JLOCK:
+        for c in _local_reqs(g, 1):
+            g.refine_completely(c)
+    before = _digest(g)
+    old_cells = g.plan.cells.copy()
+
+    def probe(phase, rank):
+        # the survivors re-formed and committed while this rank was
+        # SIGSTOPped: the fence key moved on
+        if phase == "propose":
+            kv.set(g._amr_group.fence_key(), "1")
+
+    monkeypatch.setattr(distamr, "_PHASE_PROBE", probe)
+    with pytest.raises(txn.CrossRankAbortedError) as ei:
+        g.stop_refining()
+    assert isinstance(ei.value.__cause__, coord.StaleFenceError)
+    assert _digest(g)[:-1] == before[:-1]  # all but the moved fence
+    np.testing.assert_array_equal(g.plan.cells, old_cells)
+
+
+class _StubMembership:
+    """A lease view the test script directly: live until told dead."""
+
+    lease_s = 1.0
+
+    def __init__(self):
+        self.live = {0, 1}
+
+    def poll(self):
+        pass
+
+    def live_ranks(self):
+        return set(self.live)
+
+    def detect_dead_ranks(self):
+        return {0, 1} - self.live
+
+
+def test_peer_death_aborts_then_retry_reforms_over_survivors():
+    """Rank 1 dies mid-propose (a kill -9: no abort marker). The
+    survivor's barrier convicts it through the membership lease view
+    (typed PeerDeadError), rolls back bitwise, and the RETRY re-forms
+    the collective over the survivors alone: rank 1's requests are
+    lost with it, rank 0 commits its own and the fence advances."""
+    stub = _StubMembership()
+    kv, grids = _pair(timeout=30, membership=stub)
+    reqs = {r: _local_reqs(grids[0], r) for r in (0, 1)}
+    with JLOCK:
+        for r, g in grids.items():
+            for c in reqs[r]:
+                g.refine_completely(c)
+    before = {r: _digest(g) for r, g in grids.items()}
+
+    def probe(phase, rank):
+        # by the time rank 0 proposes, the lease on rank 1 has lapsed
+        # (the attempt's expected set was already formed with it in)
+        if rank == 0 and phase == "propose":
+            stub.live.discard(1)
+
+    outcome = {}
+
+    def body(rank, g):
+        if rank == 1:
+            g.stop_refining()  # raises InjectedRankDeath
+            return
+        try:
+            g.stop_refining()
+            outcome["first"] = "committed"
+        except txn.CrossRankAbortedError as e:
+            outcome["first"] = e
+        outcome["mid"] = _digest(g)
+        # the collective retry over the survivors ({0} alone)
+        outcome["new"] = g.stop_refining()
+
+    plan = (faults.FaultPlan()
+            .rank_death(site="amr.propose", rank=1))
+    old_probe = distamr._PHASE_PROBE
+    distamr._PHASE_PROBE = probe
+    try:
+        with plan:
+            errs = _run_ranks(grids, body)
+    finally:
+        distamr._PHASE_PROBE = old_probe
+    assert isinstance(errs[1], faults.InjectedRankDeath), errs[1]
+    assert errs[0] is None, errs[0]
+    assert isinstance(outcome["first"], txn.CrossRankAbortedError)
+    assert isinstance(outcome["first"].__cause__, coord.PeerDeadError)
+    assert outcome["mid"] == before[0], "survivor not bitwise on abort"
+    # the dead rank rolled back bitwise before its (injected) death
+    assert _digest(grids[1])[:-1] == before[1][:-1]
+
+    # the survivor-only commit == single-controller with ONLY rank 0's
+    # requests (the dead rank's were never proposed)
+    ref = _merged_reference({0: reqs[0]})
+    g0 = grids[0]
+    assert g0._amr_group.read_fence() == 1
+    np.testing.assert_array_equal(g0.plan.cells, ref.plan.cells)
+    np.testing.assert_array_equal(g0.plan.owner, ref.plan.owner)
+    assert len(outcome["new"]) == 8 * len(reqs[0])
+
+
+def test_frontier_induced_refines_properties():
+    """The proposal-integrity frontier: the one-wave coarser-neighbor
+    set a rank's refines push across its ownership boundary."""
+    g = _mk(max_lvl=2)
+    offsets = g.neighborhoods[DEFAULT_NEIGHBORHOOD_ID]
+    cells, owner = g.plan.cells, g.plan.owner
+
+    # no requests -> no frontier; whole-grid ownership -> no frontier
+    empty = amr.frontier_induced_refines(
+        g.mapping, cells, owner, offsets, set(), [0],
+        topology=g.topology)
+    assert empty.dtype == np.uint64 and len(empty) == 0
+    corner = int(cells[0])  # periodic corner: neighbors wrap far away
+    assert len(amr.frontier_induced_refines(
+        g.mapping, cells, owner, offsets, {corner},
+        range(g.n_dev), topology=g.topology)) == 0
+
+    # refine the corner cell, then request one of its children: every
+    # coarser neighbor NOT owned by the child's rank is frontier
+    g.refine_completely(corner)
+    new = g.stop_refining()
+    g.clear_refined_unrefined_data()
+    cells, owner = g.plan.cells, g.plan.owner
+    child = int(np.min(new))
+    lvl = g.mapping.get_refinement_level(cells)
+    f0 = amr.frontier_induced_refines(
+        g.mapping, cells, owner, offsets, {child}, [0],
+        topology=g.topology)
+    assert len(f0), "corner child induces nothing across the boundary"
+    assert np.array_equal(f0, np.sort(f0)) and f0.dtype == np.uint64
+    pos = np.searchsorted(cells, f0)
+    np.testing.assert_array_equal(cells[pos], f0)
+    child_lvl = int(g.mapping.get_refinement_level(
+        np.asarray([child], dtype=np.uint64))[0])
+    assert (lvl[pos] < child_lvl).all(), "frontier must be coarser"
+    assert not np.isin(owner[pos], [0]).any(), "frontier must be foreign"
+    # shrinking the ownership view can only GROW the frontier
+    f01 = amr.frontier_induced_refines(
+        g.mapping, cells, owner, offsets, {child}, [0, 1],
+        topology=g.topology)
+    assert set(int(c) for c in f01) <= set(int(c) for c in f0)
+
+
+def test_single_controller_path_is_unchanged():
+    """Without a commit group, stop_refining IS the local commit."""
+    a, b = _mk(), _mk()
+    picks = [int(c) for c in a.plan.cells[:9:3]]
+    for g in (a, b):
+        for c in picks:
+            g.refine_completely(c)
+    ra = a.stop_refining()  # no group installed: routes local
+    rb = b._stop_refining_local()
+    np.testing.assert_array_equal(ra, rb)
+    np.testing.assert_array_equal(a.plan.cells, b.plan.cells)
+    np.testing.assert_array_equal(a.plan.owner, b.plan.owner)
+    for g in (a, b):
+        g.assign_children_from_parents(fields=["v"])
+        g.clear_refined_unrefined_data()
+    np.testing.assert_array_equal(a.get("v", a.plan.cells),
+                                  b.get("v", b.plan.cells))

@@ -604,3 +604,141 @@ def test_initialize_accepts_foreign_process_mesh_structurally():
     _fake_split(g2, range(g2.n_dev // 2))
     assert np.array_equal(g1.plan.cells, g2.plan.cells)
     assert np.array_equal(g1.plan.owner, g2.plan.owner)
+
+
+# ---------------------------------------------------------------------
+# async (writer-thread) two-phase saves: background.freeze_grid_mp
+# ---------------------------------------------------------------------
+
+def test_async_mp_save_is_bitwise_and_snapshot_consistent(tmp_path):
+    """The mp save run from freeze_grid_mp snapshots on AsyncSaver
+    writer threads produces the byte-identical file of the synchronous
+    two-pass save — even when the LIVE grid is mutated between the
+    freeze and the write (the snapshot pulled every local shard to
+    host at freeze time)."""
+    from dccrg_tpu import background
+
+    fn_sync = tmp_path / "sync.dc"
+    _two_pass_save(_value_grid(), fn_sync, header=b"HDR!")
+
+    g = _value_grid()
+    fn = tmp_path / "async.dc"
+    frozen = {}
+    half = g.n_dev // 2
+    for rank in (0, 1):  # collective discipline: EVERY rank freezes
+        _fake_split(g, range(half) if rank == 0 else range(half, g.n_dev),
+                    rank=rank)
+        g._ckpt_writes_meta = rank == 0
+        g._ckpt_commits = rank == 1
+        frozen[rank] = background.freeze_grid_mp(g)
+    _unfake(g)
+    # live mutation AFTER the freeze: must not reach the files
+    g.set("v", g.plan.cells, np.full(len(g.plan.cells), -9.0, np.float32))
+
+    saver = background.AsyncSaver()
+    for rank in (0, 1):  # faked split: barriers no-op, passes sequence
+        fr = frozen[rank]
+        saver.submit(lambda fr=fr: fr.save_grid_data(str(fn),
+                                                     header=b"HDR!"))
+        saver.drain()
+    assert fn.read_bytes() == fn_sync.read_bytes()
+    # and the attempt epoch advanced on the SOURCE grid (_mp_epoch_src),
+    # so the NEXT save never reuses a barrier tag
+    assert getattr(g, "_mp_save_epoch", 0) >= 2
+
+
+@pytest.mark.faultinject
+def test_async_mp_save_rank_death_aborts_cleanly(tmp_path):
+    """A rank death inside an async writer thread surfaces typed at
+    drain() (the async analogue of the synchronous save raising in
+    place); nothing is published and a fresh save retries clean."""
+    from dccrg_tpu import background
+
+    fn = tmp_path / "ad.dc"
+    _two_pass_save(_value_grid(), fn, sidecar=True)
+    good = fn.read_bytes()
+
+    g = _value_grid(7.0)
+    half = g.n_dev // 2
+    frozen = {}
+    for rank in (0, 1):
+        _fake_split(g, range(half) if rank == 0 else range(half, g.n_dev),
+                    rank=rank)
+        g._ckpt_writes_meta = rank == 0
+        g._ckpt_commits = rank == 1
+        frozen[rank] = background.freeze_grid_mp(g)
+    _unfake(g)
+
+    saver = background.AsyncSaver()
+    failures = []
+    plan = faults.FaultPlan()
+    plan.rank_death(phase="slice", rank=1)
+    with plan:
+        for rank in (0, 1):
+            fr = frozen[rank]
+            saver.submit(lambda fr=fr: fr.save_grid_data(str(fn),
+                                                         sidecar=True),
+                         on_fail=lambda e: failures.append(e))
+            if rank == 0:
+                saver.drain()
+            else:
+                with pytest.raises(faults.InjectedRankDeath):
+                    saver.drain()
+    assert len(failures) == 1
+    assert fn.read_bytes() == good  # old checkpoint bitwise intact
+    assert resilience.verify_checkpoint(str(fn)) == []
+
+    # the epoch is retryable: a fresh synchronous save publishes
+    _two_pass_save(_value_grid(7.0), fn, sidecar=True)
+    single = tmp_path / "s.dc"
+    _value_grid(7.0).save_grid_data(str(single), sidecar=True)
+    assert fn.read_bytes() == single.read_bytes()
+
+
+def test_supervise_store_routes_multiproc_async_through_freeze_mp(
+        tmp_path, monkeypatch):
+    """With DCCRG_ASYNC_SAVE=1 a multi-process CheckpointStore.save
+    freezes through freeze_grid_mp (not the single-controller
+    freeze_grid) and the published bytes equal the synchronous save's
+    — the PR-13 follow-up: mp saves no longer block dispatch."""
+    from dccrg_tpu import background, supervise
+
+    monkeypatch.setenv("DCCRG_ASYNC_SAVE", "1")
+    frozen_kinds = []
+    real_freeze_mp = background.freeze_grid_mp
+
+    def spy(grid, fields=None, variable=None):
+        frozen_kinds.append("mp")
+        return real_freeze_mp(grid, fields=fields, variable=variable)
+
+    monkeypatch.setattr(background, "freeze_grid_mp", spy)
+    store_dir = tmp_path / "store"
+    store = supervise.CheckpointStore(str(store_dir), stem="as")
+    g = _value_grid()
+    half = g.n_dev // 2
+    for rank in (0, 1):
+        _fake_split(g, range(half) if rank == 0 else range(half, g.n_dev),
+                    rank=rank)
+        g._ckpt_writes_meta = rank == 0
+        g._ckpt_commits = rank == 1
+        store.save(g, step=4)
+        store.drain()
+    _unfake(g)
+    assert frozen_kinds == ["mp", "mp"]
+    entries = supervise.list_checkpoints(str(store_dir), stem="as")
+    assert entries, "async mp store save never published"
+
+    sync_dir = tmp_path / "sync_store"
+    monkeypatch.setenv("DCCRG_ASYNC_SAVE", "0")
+    store2 = supervise.CheckpointStore(str(sync_dir), stem="as")
+    g2 = _value_grid()
+    for rank in (0, 1):
+        _fake_split(g2, range(half) if rank == 0 else range(half, g2.n_dev),
+                    rank=rank)
+        g2._ckpt_writes_meta = rank == 0
+        g2._ckpt_commits = rank == 1
+        store2.save(g2, step=4)
+    _unfake(g2)
+    a = entries[0][1]
+    b = supervise.list_checkpoints(str(sync_dir), stem="as")[0][1]
+    assert open(a, "rb").read() == open(b, "rb").read()
