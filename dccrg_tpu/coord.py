@@ -631,8 +631,16 @@ def kv_barrier(kv, tag: str, rank: int, ranks, timeout=None, *,
     - ``fence=(key, expected)``: raises :class:`StaleFenceError` the
       moment the fence key moves off ``expected`` — a stopped rank that
       wakes after the fleet committed without it must lose, not finish.
+      The first element may also be a zero-arg callable returning the
+      current fence (the distributed-AMR group's monotonic epoch read)
+      instead of a KV key.
     - ``abort_key``: raises :class:`RemoteAbortError` the moment a peer
       posts an abort marker there (the distributed-rollback fast path).
+      The marker also VETOES completion: arrival keys are monotonic
+      within a round, so a peer that arrived and later aborted (a
+      deeper-phase failure, a commit-wait timeout) leaves its arrivals
+      behind as ghosts — a slow rank waking into a "complete" barrier
+      of an aborted round must abort with the fleet, not finish alone.
 
     On expiry, a ``membership`` whose lease view declares a missing
     peer DEAD upgrades the timeout to :class:`PeerDeadError` naming the
@@ -667,20 +675,43 @@ def kv_barrier(kv, tag: str, rank: int, ranks, timeout=None, *,
                 continue
         return arrived
 
+    def _abort_marker():
+        """Read the abort marker cheaply: a prefix listing returns
+        only keys that EXIST, where the real service's get blocks
+        ~100 ms on an absent one — this probe runs every poll and on
+        every successful exit. The listing targets the marker's PARENT
+        directory (the real service's dir-get only returns keys UNDER
+        the prefix, never the prefix itself), then picks the exact
+        key — which also keeps attempt 1 from shadowing attempt 10."""
+        got = kv.dir_get(abort_key.rsplit("/", 1)[0] + "/")
+        if got is not None:
+            return got.get(abort_key)
+        return kv.get(abort_key)
+
+    def _finish(arrived: dict) -> dict:
+        """Success-path exit: every expected rank arrived. An abort
+        marker still vetoes completion (see docstring) — the arrival
+        keys may be ghosts of a round the peers already rolled back."""
+        if abort_key is not None:
+            marker = _abort_marker()
+            if marker is not None:
+                raise _remote_abort(tag, abort_key, marker)
+        return {r: arrived[r] for r in expected}
+
     last_live_check = 0.0
     while True:
-        # completion is checked FIRST: presence keys are monotonic
-        # within a round, so once any rank observed all arrivals, every
-        # rank will — a fence bump the winner performs right after
-        # passing must never strand a slower participant that the
-        # barrier already counted (it returns success here before the
-        # fence check could convict it)
+        # completion is checked before the FENCE: presence keys are
+        # monotonic within a round, so once any rank observed all
+        # arrivals, every rank will — a fence bump the winner performs
+        # right after passing must never strand a slower participant
+        # that the barrier already counted. The ABORT marker is the
+        # one thing that outranks completion (checked in _finish).
         arrived = _arrivals()
         if all(r in arrived for r in expected):
-            return {r: arrived[r] for r in expected}
+            return _finish(arrived)
         if fence is not None:
             fkey, fexp = fence
-            cur = kv.get(fkey)
+            cur = fkey() if callable(fkey) else kv.get(fkey)
             if cur is not None and str(cur) != str(fexp):
                 # the real service's get BLOCKS briefly on an absent
                 # key, so a bump landing during this very check can be
@@ -690,10 +721,10 @@ def kv_barrier(kv, tag: str, rank: int, ranks, timeout=None, *,
                 # success, not convict a live participant as a zombie
                 arrived = _arrivals()
                 if all(r in arrived for r in expected):
-                    return {r: arrived[r] for r in expected}
+                    return _finish(arrived)
                 raise StaleFenceError(tag, fexp, cur)
         if abort_key is not None:
-            marker = kv.get(abort_key)
+            marker = _abort_marker()
             if marker is not None:
                 raise _remote_abort(tag, abort_key, marker)
         now = time.monotonic()

@@ -46,30 +46,53 @@ primitives), four fenced phases, each a named fault point
     Plan digests meet at the prepare barrier and must be identical.
 
 ``commit``
-    The decision point: a fenced barrier, then one winner CAS-records
-    the decision, every rank bumps the fence (idempotent — the same
-    value from every survivor, no single point of failure) and
-    installs its prepared plan via ``Grid._install_plan``.
+    The decision point: the commit barrier, then every rank races its
+    verdict onto the round's SINGLE first-writer-wins decision key
+    (``kv.create``). Ranks that pass the barrier race ``commit``;
+    every abort path races ``abort`` (landed BEFORE the fast-abort
+    marker). Whatever record lands first IS the round's outcome, and
+    every rank reads it back and obeys: a slow rank whose peers timed
+    out and rolled back finds ``abort`` and rolls back too (arrival
+    keys are monotonic ghosts — without the verdict it would commit
+    alone off a "complete" barrier), and a rank whose commit barrier
+    failed just as the round was decided ``commit`` rolls FORWARD and
+    installs with the fleet. The epoch fence then advances through a
+    create-only per-epoch key — monotonic by construction, so a rank
+    that stalls between deciding and publishing can never drag the
+    fence backwards — the plan installs, and the decision winner
+    garbage-collects every key of rounds the fence has moved past.
 
-Crash consistency: ANY failure before the commit barrier — raise,
+Crash consistency: ANY failure before the commit decision — raise,
 timeout, dead peer, torn record, stale fence — aborts through
-:func:`~dccrg_tpu.txn.cross_rank_transaction`: this rank rolls back
-bitwise (old plan, old data, request sets restored — the epoch is
-retryable) and posts an abort marker the peers' barriers fast-abort
-on, so the whole fleet rolls back together. A rank that dies AFTER
-passing the commit barrier is a post-decision death (classic 2PC):
-the survivors install the agreed plan and the PR-14 lease/reclaim
-machinery absorbs the corpse's cells. A SIGSTOP zombie that wakes
+:func:`~dccrg_tpu.txn.cross_rank_transaction`: this rank lands the
+``abort`` verdict, rolls back bitwise (old plan, old data, request
+sets restored — the epoch is retryable) and posts an abort marker the
+peers' barriers fast-abort on, so the whole fleet rolls back
+together. Once the verdict is ``commit`` the transaction is past its
+point of no return (classic 2PC): a rank that dies installing is a
+post-decision death — the survivors install the agreed plan and the
+PR-14 lease/reclaim machinery absorbs the corpse's cells — and a rank
+whose LOCAL install fails terminates itself (:func:`_fatal_install`)
+rather than roll back into permanent structural divergence; the
+lease machinery absorbs it the same way. A SIGSTOP zombie that wakes
 after the survivors re-formed and committed finds the fence advanced
 (:class:`~dccrg_tpu.coord.StaleFenceError`): it rolls back and keeps
 serving the OLD plan — rejoining happens through the fleet layer at
-the new epoch, never by finishing the stale round.
+the new epoch, never by finishing the stale round. A zombie so stale
+its round's keys were garbage-collected reads the missing decision as
+``abort`` — same outcome.
 
 A retry after an abort is a COLLECTIVE retry: every participant calls
 :func:`distributed_stop_refining` again, and the per-process attempt
 counter re-aligns the barrier tags by construction — the same
 ``#<attempt>`` discipline the two-phase checkpoint save documents in
-coord.py. Single-controller grids never construct an
+coord.py. A restarted process whose reset counter re-enters an
+attempt that already ran cannot act on its leftover arrival keys: an
+aborted attempt left an abort marker (which vetoes barrier completion
+— it fast-forwards the straggler one quick typed abort per stale
+attempt until it catches the live one) and its verdict on the
+decision key, and the commit GC deletes whole rounds once the fence
+moves past them. Single-controller grids never construct an
 :class:`AmrCommitGroup`, and ``stop_refining`` without one routes to
 the unchanged local path — bitwise identical to the pre-refactor
 commit (pinned by tests/test_distamr.py).
@@ -164,12 +187,42 @@ class AmrCommitGroup:
     def fence_key(self) -> str:
         return f"{self.prefix}/fence"
 
-    def read_fence(self) -> int:
-        val = self.kv.get(self.fence_key())
+    def epoch_key(self, n: int) -> str:
+        return f"{self.prefix}/fence/{int(n)}"
+
+    def _mirror_fence(self) -> int:
         try:
-            return int(val)
+            return int(self.kv.get(self.fence_key()))
         except (TypeError, ValueError):
             return 0
+
+    def read_fence(self) -> int:
+        """The current epoch fence: the max over the CREATE-only
+        per-epoch keys (authoritative — they only accumulate, so this
+        read can never observe a regression) and the legacy mirror
+        key (what a ``dir_get``-degraded service still serves, and
+        what the zombie-fencing tests write directly)."""
+        best = self._mirror_fence()
+        listing = self.kv.dir_get(f"{self.prefix}/fence/")
+        for k in (listing or {}):
+            try:
+                best = max(best, int(k.rsplit("/", 1)[-1]))
+            except ValueError:
+                continue
+        return best
+
+    def advance_fence(self, target: int) -> int:
+        """Publish epoch ``target`` monotonically: CREATE the epoch
+        key (first-writer-wins and append-only — a rank that stalled
+        between deciding and publishing can never drag the fence
+        backwards, which the blind ``set`` this replaces could), then
+        refresh the mirror best-effort and only ever upwards. Returns
+        the fence now observed."""
+        target = int(target)
+        self.kv.create(self.epoch_key(target), "1")
+        if self._mirror_fence() < target:
+            self.kv.set(self.fence_key(), str(target))
+        return self.read_fence()
 
     def local_devs(self):
         """This rank's device ids (what ``is_local`` gates on) — the
@@ -218,25 +271,100 @@ class _Attempt:
     def abort_key(self) -> str:
         return self.key("abort")
 
+    def decision_key(self) -> str:
+        return self.key("decision")
+
+    def decide(self, want: str, detail: str = "") -> dict:
+        """Race this rank's verdict for the round onto the SINGLE
+        first-writer-wins decision key and return the verdict that
+        actually STANDS (which may be a peer's opposite one — the
+        caller must obey it). This is what makes the commit decision
+        atomic: a slow rank and a timing-out peer can both reach the
+        decision point, but only one record lands, and both act on
+        the same one. The read retries a transiently wedged KV; a
+        verdict that stays unreadable (or is torn) reads as ABORT —
+        keeping the old plan is the one answer a rank may act on
+        alone."""
+        key = self.decision_key()
+        self.group.kv.create(key, coord.seal_record(json.dumps(
+            {"decision": str(want), "fence": self.fence,
+             "attempt": self.attempt, "rank": self.group.rank,
+             "detail": str(detail)[:200]}, sort_keys=True)))
+        raw = None
+        for _ in range(50):
+            raw = self.group.kv.get(key)
+            if raw is not None:
+                break
+            time.sleep(0.02)
+        try:
+            info = json.loads(coord.unseal_record(raw, key))
+            if str(info.get("decision")) in ("commit", "abort"):
+                return info
+            fallback = f"malformed decision record {info!r}"[:200]
+        except Exception as e:  # noqa: BLE001 - torn/unreadable verdict
+            fallback = f"unreadable decision record ({type(e).__name__})"
+        return {"decision": "abort", "fence": self.fence,
+                "attempt": self.attempt, "rank": -1, "detail": fallback}
+
     def post_abort(self, err: BaseException) -> None:
         """The distributed-rollback announcement
         (:func:`~dccrg_tpu.txn.cross_rank_transaction`'s ``on_abort``):
-        land a sealed abort marker so every peer blocked in this
-        round's barriers aborts NOW instead of at its deadline."""
+        FIRST race the round's ABORT verdict onto the decision key —
+        so a slow peer that later wakes into a complete-looking
+        barrier reads it and rolls back instead of committing alone —
+        then land the sealed abort marker every peer blocked in this
+        round's barriers fast-aborts on instead of burning its
+        deadline."""
         cause = getattr(err, "__cause__", None) or err
+        reason = f"{type(cause).__name__}: {cause}"[:200]
+        self.group.kv.create(self.decision_key(), coord.seal_record(
+            json.dumps({"decision": "abort", "fence": self.fence,
+                        "attempt": self.attempt,
+                        "rank": self.group.rank, "detail": reason},
+                       sort_keys=True)))
         self.group.kv.set(self.abort_key(), coord.seal_record(json.dumps(
-            {"rank": self.group.rank,
-             "reason": f"{type(cause).__name__}: {cause}"[:200]})))
+            {"rank": self.group.rank, "reason": reason})))
 
     def barrier(self, phase: str, value: str = "1") -> dict:
         """This round's fenced barrier at ``phase``; returns the
-        per-rank values (the built-in all-gather)."""
+        per-rank values (the built-in all-gather). The fence watch
+        reads through :meth:`AmrCommitGroup.read_fence` (the monotonic
+        epoch-key max), not the raw mirror key, so a regressed mirror
+        can neither spuriously convict a live round nor let a stale
+        zombie pass."""
         return coord.kv_barrier(
             self.group.kv, self.tag(phase), self.group.rank,
             self.expected, timeout=self.timeout, value=value,
             poll_s=self.group.poll_s,
-            fence=(self.group.fence_key(), str(self.fence)),
+            fence=(self.group.read_fence, str(self.fence)),
             abort_key=self.abort_key(), membership=self.group.membership)
+
+    def gc_older_rounds(self) -> None:
+        """Best-effort garbage collection after THIS round committed:
+        delete every barrier arrival, abort marker, decision record
+        and epoch-fence key of rounds STRICTLY older than this fence.
+        The current round's keys stay — a slow peer may still be
+        reading its decision — and the newest epoch keys stay, so a
+        fence read can never regress. Keeps the coordination KV
+        bounded across adapt epochs and removes the stale arrivals
+        that made tag aliasing possible; a zombie whose whole round
+        was collected finds its decision key gone, reads ABORT, and
+        stays on its old plan (the fleet-layer rejoin path)."""
+        kv = self.group.kv
+        prefix = self.group.prefix
+        for sub in (f"{prefix}/b/", f"{prefix}/abort/",
+                    f"{prefix}/decision/", f"{prefix}/fence/"):
+            listing = kv.dir_get(sub)
+            for k in (listing or {}):
+                if not k.startswith(sub):
+                    continue
+                head = k[len(sub):].split("#", 1)[0].split("/", 1)[0]
+                try:
+                    f = int(head)
+                except ValueError:
+                    continue
+                if f < self.fence:
+                    kv.delete(k)
 
 
 def _probe(phase: str, rank: int) -> None:
@@ -250,6 +378,35 @@ def _maybe_hang(site: str, phase, rank) -> None:
         time.sleep(min(float(hang), 3600.0))
 
 
+#: test hook: replaces the process-terminating half of
+#: :func:`_fatal_install` so in-process fakes can observe the verdict
+#: without dying. Called with the original exception. None in
+#: production.
+_FATAL_INSTALL = None
+
+#: exit code of a rank whose post-decision install failed — the one
+#: failure 2PC cannot roll back (peers committed) and must convert
+#: into a death the lease/reclaim machinery absorbs.
+INSTALL_FATAL_RC = 86
+
+
+def _fatal_install(err: BaseException) -> None:
+    """A LOCAL failure after the round's verdict landed as COMMIT:
+    the peers are installing the new plan, so rolling this rank back
+    would leave a permanently structurally diverged survivor (every
+    future collective adapt would abort fleet-wide on its stale
+    digest, with no in-protocol resync). The only consistent outcome
+    is to stop being a survivor: terminate the process and let the
+    lease/reclaim machinery absorb it exactly like a post-decision
+    death — which is what it is."""
+    if _FATAL_INSTALL is not None:
+        _FATAL_INSTALL(err)
+        return
+    import os
+
+    os._exit(INSTALL_FATAL_RC)
+
+
 def distributed_stop_refining(grid, group: AmrCommitGroup = None):
     """Commit all ranks' refinement requests as one fleet-wide,
     crash-consistent transaction (see module docstring); returns the
@@ -259,7 +416,11 @@ def distributed_stop_refining(grid, group: AmrCommitGroup = None):
     :class:`~dccrg_tpu.txn.CrossRankAbortedError` (or propagates an
     injected rank death raw) with this rank bitwise rolled back and
     the abort announced to the peers; the epoch is collectively
-    retryable — every surviving rank calls this again."""
+    retryable — every surviving rank calls this again. Once the
+    round's verdict is COMMIT, failures roll FORWARD: the plan
+    installs even if this rank's commit barrier failed, and a local
+    install failure terminates the process (:func:`_fatal_install`)
+    instead of leaving a diverged survivor."""
     if group is None:
         group = getattr(grid, "_amr_group", None)
     if group is None:
@@ -269,22 +430,62 @@ def distributed_stop_refining(grid, group: AmrCommitGroup = None):
     group.attempt += 1
     att = _Attempt(group, fence0, group.attempt, group.expected_ranks())
     t0 = time.perf_counter()
+    staged: dict = {}
     try:
         with telemetry.span("grid.adapt.dist"), \
                 txn.cross_rank_transaction(
                     grid, op="distributed_stop_refining",
-                    rank=group.rank, on_abort=att.post_abort):
-            new_cells = _run_round(grid, group, att)
+                    rank=group.rank, on_abort=att.post_abort,
+                    validate=False):
+            _run_round(grid, group, att, staged)
     except txn.CrossRankAbortedError:
         telemetry.inc("dccrg_dist_amr_aborts_total")
         raise
+    # the fleet-wide verdict is COMMIT: from here on failures roll
+    # forward, never back — see _install_decided
+    _install_decided(grid, group, att, staged)
     telemetry.observe("dccrg_dist_amr_commit_seconds",
                       time.perf_counter() - t0)
     telemetry.inc("dccrg_dist_amr_commits_total")
-    return new_cells
+    return staged["res"].new_cells.copy()
 
 
-def _run_round(grid, group: AmrCommitGroup, att: _Attempt):
+def _install_decided(grid, group: AmrCommitGroup, att: _Attempt,
+                     staged: dict) -> None:
+    """The post-decision half of the commit: publish the new epoch
+    (monotonic create-only key — a stalled rank's late publish can
+    never regress it), install the prepared plan, verify in DEBUG
+    mode, then let the decision winner garbage-collect the rounds the
+    fence moved past. Runs OUTSIDE the abortable transaction: the
+    round is decided, so 2PC forbids restoring the old plan here — a
+    local failure terminates the process instead
+    (:func:`_fatal_install`)."""
+    try:
+        group.advance_fence(att.fence + 1)
+        grid._pending_changed_cells = None
+        grid._install_plan(staged["plan"],
+                           same_cells=staged["same_cells"])
+        if getattr(grid, "_debug", False):
+            from . import verify as verify_mod
+
+            verify_mod.verify_all(grid, check_pins=False)
+    except BaseException as err:  # noqa: BLE001 - divergence is fatal
+        logger.critical(
+            "rank %d: post-decision install failed (%s: %s) — "
+            "terminating: the fleet committed fence %d and a survivor "
+            "still serving the old plan would diverge it permanently",
+            group.rank, type(err).__name__, err, att.fence + 1)
+        telemetry.inc("dccrg_dist_amr_install_fatal_total")
+        _fatal_install(err)
+        raise
+    if int(staged.get("decision", {}).get("rank", -1)) == group.rank:
+        # exactly one rank won the decision create: it sweeps, the
+        # others skip — GC needs no coordination of its own
+        att.gc_older_rounds()
+
+
+def _run_round(grid, group: AmrCommitGroup, att: _Attempt,
+               staged: dict) -> None:
     from .grid import DEFAULT_NEIGHBORHOOD_ID
 
     offsets = grid.neighborhoods[DEFAULT_NEIGHBORHOOD_ID]
@@ -432,18 +633,44 @@ def _run_round(grid, group: AmrCommitGroup, att: _Attempt):
     _probe("commit", group.rank)
     faults.fire("amr.install", phase="commit", rank=group.rank)
     _maybe_hang("amr.install", "commit", group.rank)
-    # the decision point: a rank that dies BEFORE this barrier aborts
-    # the whole round (the survivors time out / convict the lease and
-    # keep the old plan bitwise); a rank that dies AFTER passing it is
-    # a post-decision death — the survivors install and reclaim
-    att.barrier("commit")
-    # one winner CAS-records the decision; the fence bump is an
-    # idempotent same-value write from EVERY survivor, so publishing
-    # the new epoch has no single point of failure
-    group.kv.create(att.key("decision"), coord.seal_record(json.dumps(
-        {"fence": att.fence, "attempt": att.attempt,
-         "rank": group.rank, "pdig": pdig})))
-    group.kv.set(group.fence_key(), str(att.fence + 1))
-    grid._pending_changed_cells = None
-    grid._install_plan(plan, same_cells=same_cells)
-    return res.new_cells.copy()
+    staged.update(plan=plan, res=res, same_cells=same_cells, pdig=pdig)
+    # the decision point: a rank that dies BEFORE the verdict lands
+    # aborts the whole round (the survivors time out / convict the
+    # lease, land the abort verdict, and keep the old plan bitwise); a
+    # rank that dies AFTER it is a post-decision death — the survivors
+    # install and reclaim. The verdict itself is one first-writer-wins
+    # record (att.decide), so the barrier outcome alone never commits.
+    try:
+        att.barrier("commit")
+    except faults.InjectedRankDeath:
+        # a simulated kill -9: a corpse posts no verdict — the peers
+        # must convict it by lease/timeout, which is the invariant
+        # under test
+        raise
+    except Exception as err:
+        # the barrier failed LOCALLY, but the round may already be
+        # decided: race an abort verdict onto the decision key. Losing
+        # to a peer's COMMIT means the fleet is installing — this rank
+        # must roll forward with it (a decided commit cannot be rolled
+        # back), not restore the old plan and diverge.
+        verdict = att.decide(
+            "abort", detail=f"{type(err).__name__} at commit barrier")
+        if verdict["decision"] == "commit":
+            logger.warning(
+                "rank %d: commit barrier failed (%s: %s) but the "
+                "round's verdict is COMMIT (landed by rank %s) — "
+                "rolling forward", group.rank, type(err).__name__,
+                err, verdict.get("rank"))
+            telemetry.inc("dccrg_dist_amr_commit_overruled_total")
+            staged["decision"] = verdict
+            return
+        raise
+    verdict = att.decide("commit", detail="commit barrier passed")
+    if verdict["decision"] != "commit":
+        # a peer's abort verdict won the race (it gave up on this
+        # rank's arrival just as the barrier completed): obey it and
+        # roll back with everyone else instead of committing alone
+        raise coord.RemoteAbortError(
+            att.tag("commit"), rank=int(verdict.get("rank", -1)),
+            reason=str(verdict.get("detail", ""))[:200])
+    staged["decision"] = verdict

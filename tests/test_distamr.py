@@ -32,6 +32,7 @@ random-schedule version is ``python -m dccrg_tpu.fuzz --dist-amr``.
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -365,6 +366,202 @@ def test_peer_death_aborts_then_retry_reforms_over_survivors():
     np.testing.assert_array_equal(g0.plan.cells, ref.plan.cells)
     np.testing.assert_array_equal(g0.plan.owner, ref.plan.owner)
     assert len(outcome["new"]) == 8 * len(reqs[0])
+
+
+def test_slow_rank_at_commit_barrier_cannot_commit_alone():
+    """The split-brain regression: rank 1 stalls just before the
+    commit barrier until rank 0 has timed out, rolled back and posted
+    the abort verdict + marker. Rank 1 then wakes into a barrier whose
+    arrival keys are ALL present (monotonic ghosts of the aborted
+    round) and must still LOSE — the abort verdict on the decision
+    key vetoes completion — leaving both ranks bitwise pre-round; the
+    collective retry then commits."""
+    kv, grids = _pair(timeout=60)
+    grids[0]._amr_group.timeout = 3  # only rank 0's commit wait
+    reqs = {r: _local_reqs(grids[0], r) for r in (0, 1)}
+    with JLOCK:
+        for r, g in grids.items():
+            for c in reqs[r]:
+                g.refine_completely(c)
+    before = {r: _digest(g) for r, g in grids.items()}
+    abort_key = f"{grids[0]._amr_group.prefix}/abort/0#1"
+
+    def probe(phase, rank):
+        # rank 1 reaches the commit phase and stalls until rank 0 has
+        # given up on it (timed out, rolled back, announced the abort)
+        if rank == 1 and phase == "commit":
+            deadline = time.monotonic() + 60
+            while kv.get(abort_key) is None:
+                assert time.monotonic() < deadline, "rank 0 never aborted"
+                time.sleep(0.01)
+
+    old_probe = distamr._PHASE_PROBE
+    distamr._PHASE_PROBE = probe
+    try:
+        errs = _run_ranks(grids, lambda _r, g: g.stop_refining())
+    finally:
+        distamr._PHASE_PROBE = old_probe
+    for r, e in errs.items():
+        assert isinstance(e, txn.CrossRankAbortedError), (r, e)
+    assert isinstance(errs[0].__cause__, coord.BarrierTimeoutError)
+    # the waker: complete-looking barrier, but the round is decided
+    assert isinstance(errs[1].__cause__, coord.RemoteAbortError)
+    assert errs[1].__cause__.rank == 0
+    for r, g in grids.items():
+        assert _digest(g) == before[r], f"rank {r} not bitwise"
+    assert grids[0]._amr_group.read_fence() == 0
+
+    grids[0]._amr_group.timeout = 60
+    ref = _merged_reference(reqs)
+    errs = _run_ranks(grids, lambda _r, g: g.stop_refining())
+    assert not any(errs.values()), errs
+    with JLOCK:
+        for g in grids.values():
+            g.assign_children_from_parents(fields=["v"])
+            g.clear_refined_unrefined_data()
+    assert grids[0]._amr_group.read_fence() == 1
+    _assert_matches_reference(grids, ref)
+
+
+def test_commit_barrier_failure_rolls_forward_when_decided(monkeypatch):
+    """2PC roll-forward: a rank whose commit barrier fails AFTER the
+    round's verdict landed as COMMIT must install with the fleet —
+    its abort bid loses the decision race and the recorded verdict
+    overrules the local failure."""
+    kv, grids = _pair()
+    reqs = {r: _local_reqs(grids[0], r) for r in (0, 1)}
+    with JLOCK:
+        for r, g in grids.items():
+            for c in reqs[r]:
+                g.refine_completely(c)
+    ref = _merged_reference(reqs)
+
+    real = distamr._Attempt.barrier
+
+    def wrapped(self, phase, value="1"):
+        out = real(self, phase, value=value)
+        if phase == "commit" and self.group.rank == 1:
+            # wait for the fleet's verdict to land, then fail the
+            # barrier locally — the narrow race the single decision
+            # record exists to close
+            deadline = time.monotonic() + 60
+            while self.group.kv.get(self.decision_key()) is None:
+                assert time.monotonic() < deadline, "no verdict landed"
+                time.sleep(0.01)
+            raise coord.BarrierTimeoutError(self.tag(phase), 0.0)
+        return out
+
+    monkeypatch.setattr(distamr._Attempt, "barrier", wrapped)
+    errs = _run_ranks(grids, lambda _r, g: g.stop_refining())
+    assert not any(errs.values()), errs  # BOTH ranks committed
+    with JLOCK:
+        for g in grids.values():
+            g.assign_children_from_parents(fields=["v"])
+            g.clear_refined_unrefined_data()
+    assert grids[0]._amr_group.read_fence() == 1
+    _assert_matches_reference(grids, ref)
+
+
+def test_fence_advance_is_monotonic_and_zombie_proof():
+    """The epoch fence can only move forward: a stalled rank's late
+    re-publish of an old epoch (the blind-set regression) and a blind
+    legacy write to the mirror key both leave the observed fence at
+    the fleet's maximum."""
+    kv, grids = _pair()
+    group = grids[0]._amr_group
+    assert group.read_fence() == 0
+    assert group.advance_fence(1) == 1
+    assert group.advance_fence(2) == 2
+    # a zombie waking between decide and publish re-publishes its
+    # stale target: the create-only epoch key cannot regress anything
+    assert group.advance_fence(1) == 2
+    assert group.read_fence() == 2
+    # nor can a blind write to the mirror key drag the fence back
+    kv.set(group.fence_key(), "1")
+    assert group.read_fence() == 2
+    # ...but raising the mirror (the zombie-fencing tests' knob, and
+    # a dir_get-degraded service's only view) still counts
+    kv.set(group.fence_key(), "5")
+    assert group.read_fence() == 5
+
+
+def test_committed_rounds_are_garbage_collected():
+    """Round keys — barrier arrivals, abort markers, decision records,
+    old epoch-fence keys — are deleted once the fence moves past their
+    round, so the coordination KV stays bounded across adapt epochs."""
+    kv, grids = _pair()
+    prefix = grids[0]._amr_group.prefix
+    # epoch 1: one aborted attempt, then the collective retry commits
+    reqs = {r: _local_reqs(grids[0], r) for r in (0, 1)}
+    with JLOCK:
+        for r, g in grids.items():
+            for c in reqs[r]:
+                g.refine_completely(c)
+    plan = faults.FaultPlan().amr_error(site="amr.resolve", rank=0)
+    with plan:
+        errs = _run_ranks(grids, lambda _r, g: g.stop_refining())
+    assert all(isinstance(e, txn.CrossRankAbortedError)
+               for e in errs.values()), errs
+    errs = _run_ranks(grids, lambda _r, g: g.stop_refining())
+    assert not any(errs.values()), errs
+    with JLOCK:
+        for g in grids.values():
+            g.assign_children_from_parents(fields=["v"])
+            g.clear_refined_unrefined_data()
+    # round 0 just committed: its own keys must still be readable (a
+    # slow peer may be mid-decision), so nothing is collected yet
+    assert kv.dir_get(f"{prefix}/b/0#"), "round-0 keys collected early"
+    assert kv.dir_get(f"{prefix}/abort/0#")
+    # epoch 2: the commit at fence 1 sweeps everything of round 0
+    with JLOCK:
+        for r, g in grids.items():
+            cells, owner = g.plan.cells, g.plan.owner
+            lvl = g.mapping.get_refinement_level(cells)
+            half = g.n_dev // 2
+            devs = list(range(half) if r == 0 else range(half, g.n_dev))
+            mine = cells[np.isin(owner, devs) & (lvl < 1)]
+            for c in mine[:2]:
+                g.refine_completely(int(c))
+    errs = _run_ranks(grids, lambda _r, g: g.stop_refining())
+    assert not any(errs.values()), errs
+    assert grids[0]._amr_group.read_fence() == 2
+    for sub in (f"{prefix}/b/0#", f"{prefix}/abort/0#",
+                f"{prefix}/decision/0#"):
+        assert not kv.dir_get(sub), (sub, kv.dir_get(sub))
+    # the newest epoch keys survive — a fence read can never regress
+    assert kv.dir_get(f"{prefix}/fence/")
+
+
+def test_post_decision_install_failure_is_fatal_not_divergent(
+        monkeypatch):
+    """Once the verdict is COMMIT, a local install failure must not
+    roll back into a diverged survivor: the rank terminates (stubbed
+    here) so lease/reclaim absorbs it like the post-decision death it
+    is."""
+    kv, grids = _pair()
+    reqs = {r: _local_reqs(grids[0], r) for r in (0, 1)}
+    with JLOCK:
+        for r, g in grids.items():
+            for c in reqs[r]:
+                g.refine_completely(c)
+
+    died = []
+    monkeypatch.setattr(distamr, "_FATAL_INSTALL", died.append)
+    g1_install = grids[1]._install_plan
+
+    def broken_install(plan, same_cells=None):
+        raise RuntimeError("injected install fault")
+
+    grids[1]._install_plan = broken_install
+    errs = _run_ranks(grids, lambda _r, g: g.stop_refining())
+    grids[1]._install_plan = g1_install
+    assert errs[0] is None, errs[0]  # the healthy rank committed
+    assert isinstance(errs[1], RuntimeError), errs[1]
+    assert len(died) == 1 and isinstance(died[0], RuntimeError)
+    # NOT rolled back: the broken rank did not resurrect the old plan
+    # as a CrossRankAbortedError would have
+    assert not isinstance(errs[1], txn.CrossRankAbortedError)
+    assert grids[0]._amr_group.read_fence() == 1
 
 
 def test_frontier_induced_refines_properties():
