@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Streaming-intake saturation bench: the ISSUE "2x overload" proof
+for the durable spool front door (``dccrg_tpu/intake.py``).
+
+Three legs on one real-clock in-process (intake, scheduler) pair
+over a shared spool + InMemoryKV:
+
+- ``warmup``   — a couple of jobs to absorb the jit compile (not
+  measured),
+- ``calibrate``— ``--calibrate`` jobs drained to completion; the
+  measured wall gives the steady drain rate ``intake_drain_per_sec``
+  (higher is better; the fleet-side cost of going through the spool
+  instead of the constructor),
+- ``overload`` — submissions streamed at ``--overload`` (default 2x)
+  the calibrated drain rate for ``--duration`` seconds while the
+  scheduler serves tick-at-a-time. Under sustained overload the
+  backpressure gate + journaled shed must keep the queue age bounded
+  (``intake_p99_queue_age_seconds``, lower is better, from the
+  telemetry queue-age histogram), flap at most once per EWMA window
+  (``gate_transitions_per_window``), and lose or duplicate nothing:
+  every submitted job must land in exactly one of
+  {admitted+finished, shed/, quarantine/} — the bench ASSERTS the
+  accounting and reports ``ok: false`` plus null trend metrics if it
+  does not hold.
+
+JSON rows go to stdout like the other bench emitters; on any failure
+the summary still prints with null metric values so ``bench/trend.py``
+skips (rather than crashes on) the round.
+
+Run:  timeout -k 10 600 python bench/intake_bench.py [--duration 8]
+      [--overload 2.0] [--calibrate 16]
+"""
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _row(name, steps, seed):
+    return {"name": name, "n": 8, "steps": steps,
+            "checkpoint_every": 0, "seed": seed}
+
+
+def _serve_until(sched, it, pred, deadline):
+    """Tick the scheduler (which pumps the intake) until ``pred()``
+    or the wall deadline; returns True when the predicate held."""
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        sched.run(max_ticks=sched.ticks + 1)
+    return pred()
+
+
+def run_bench(args):
+    from dccrg_tpu import coord, intake, telemetry
+    from dccrg_tpu.scheduler import FleetScheduler
+
+    telemetry.registry().reset()
+    tmp = tempfile.mkdtemp(prefix="intake_bench_")
+    rows = []
+    try:
+        spool = str(Path(tmp) / "spool")
+        it = intake.StreamIntake(
+            spool, kv=coord.InMemoryKV(), rank=0, lease_s=2.0,
+            window_s=1.0, age_bound_s=args.age_bound, poll_s=0.0,
+            seed=args.seed)
+        sched = FleetScheduler(str(Path(tmp) / "ck"), quantum=4,
+                               intake=it)
+
+        # -- warmup: absorb the compile outside the measured legs.
+        # The gate is held open through warmup + calibration (the
+        # spooled-up-front burst would spike the arrival EWMA and
+        # gate-pause the drain we are trying to measure); the real
+        # hysteresis band is restored for the overload leg.
+        real_hi = it.hi_ratio
+        it.hi_ratio = 1e9
+        for i in range(2):
+            intake.submit(spool, _row(f"w{i}", args.steps, i))
+        sched.run()
+
+        # -- calibrate: steady drain rate, jobs all spooled up front
+        for i in range(args.calibrate):
+            intake.submit(spool, _row(f"c{i:03d}", args.steps, i))
+        t0 = time.monotonic()
+        sched.run()
+        cal_wall = time.monotonic() - t0
+        it.hi_ratio = real_hi
+        drain = args.calibrate / max(cal_wall, 1e-9)
+        rows.append({"leg": "calibrate", "jobs": args.calibrate,
+                     "wall_s": round(cal_wall, 4),
+                     "drain_per_sec": round(drain, 3)})
+        print(json.dumps(rows[-1]), flush=True)
+
+        # -- overload: stream arrivals at --overload x the calibrated
+        # drain rate, serving tick-at-a-time on the real clock
+        rate = args.overload * drain
+        total = max(8, min(int(rate * args.duration), 400))
+        period = 1.0 / rate
+        names = [f"o{i:04d}" for i in range(total)]
+        base_tr = it.gate_transitions
+        t0 = time.monotonic()
+        nxt, i = t0, 0
+        while i < len(names):
+            now = time.monotonic()
+            if now >= nxt:
+                intake.submit(spool, _row(names[i], args.steps, i))
+                nxt += period
+                i += 1
+            else:
+                sched.run(max_ticks=sched.ticks + 1)
+        shed_dir = Path(spool) / "shed"
+        quar_dir = Path(spool) / "quarantine"
+
+        def settled():
+            done = set(sched.report)
+            done.update(p.stem for p in shed_dir.glob("*.json"))
+            done.update(p.stem for p in quar_dir.glob("*.json"))
+            return all(n in done for n in names) and it.idle()
+
+        ok = _serve_until(sched, it, settled,
+                          time.monotonic() + args.duration + 60)
+        wall = time.monotonic() - t0
+
+        # exactly-once accounting: each overload job in exactly one
+        # terminal place, and the admitted counter matches the set of
+        # names the scheduler actually finished (no duplicates)
+        finished = [n for n in names if n in sched.report]
+        shed = [n for n in names
+                if (shed_dir / f"{n}.json").exists()]
+        quar = [n for n in names
+                if (quar_dir / f"{n}.json").exists()]
+        places = {}
+        for bucket, got in (("finished", finished), ("shed", shed),
+                            ("quarantined", quar)):
+            for n in got:
+                places.setdefault(n, []).append(bucket)
+        lost = [n for n in names if n not in places]
+        dupes = [n for n, b in places.items() if len(b) > 1]
+        reg = telemetry.registry()
+        overload_admits = (reg.counter_total(
+            "dccrg_intake_admitted_total")
+            - 2 - args.calibrate - it.reclaimed)
+        ok = (ok and not lost and not dupes
+              and int(overload_admits) == len(finished))
+
+        hist = reg.histogram_total("dccrg_intake_queue_age_seconds")
+        p99 = hist.quantile(0.99) if hist is not None else None
+        transitions = it.gate_transitions - base_tr
+        per_window = transitions / max(1.0, wall / it.window_s)
+        rows.append({
+            "leg": "overload", "submitted": total,
+            "arrival_per_sec": round(rate, 3),
+            "wall_s": round(wall, 4), "finished": len(finished),
+            "shed": len(shed), "quarantined": len(quar),
+            "lost": len(lost), "duplicated": len(dupes),
+            "gate_transitions": transitions,
+            "gate_transitions_per_window": round(per_window, 3),
+            "ok": ok})
+        print(json.dumps(rows[-1]), flush=True)
+
+        summary = {
+            "intake_drain_per_sec": (round(drain, 3) if ok else None),
+            "intake_p99_queue_age_seconds": (
+                round(p99, 4) if ok and p99 is not None else None),
+            "gate_transitions_per_window": round(per_window, 3),
+            "overload": args.overload, "submitted": total,
+            "finished": len(finished), "shed": len(shed),
+            "ok": ok,
+            "note": ("sustained %.1fx overload; exactly-once "
+                     "accounting %s" % (args.overload,
+                                        "held" if ok else "FAILED")),
+        }
+    except Exception as e:  # null metrics: trend.py skips, not crashes
+        summary = {"intake_drain_per_sec": None,
+                   "intake_p99_queue_age_seconds": None,
+                   "ok": False, "error": f"{type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps({"summary": summary}), flush=True)
+    return 0 if summary.get("ok") else 1
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--calibrate", type=int, default=16,
+                    help="jobs in the drain-rate calibration leg")
+    ap.add_argument("--duration", type=float, default=8.0,
+                    help="overload-leg submission window (seconds)")
+    ap.add_argument("--overload", type=float, default=2.0,
+                    help="arrival rate as a multiple of drain rate")
+    ap.add_argument("--age-bound", type=float, default=4.0,
+                    help="intake age bound driving shed (seconds)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from dccrg_tpu.resilience import safe_devices
+    if safe_devices(timeout=120, retries=1, platform="cpu") is None:
+        print(json.dumps({"summary": {
+            "intake_drain_per_sec": None,
+            "intake_p99_queue_age_seconds": None,
+            "ok": False, "error": "device probe failed"}}))
+        return 1
+    return run_bench(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

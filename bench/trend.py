@@ -12,7 +12,11 @@ Metric direction is inferred from the name: throughput-style keys
 (``*updates_per_sec``, ``*runs_per_s``, ``value``, ``*vs_baseline``)
 are higher-is-better; error/latency-style keys (``*l2_error*``,
 ``*_seconds``, ``*_s``) are lower-is-better; anything else (strings,
-nulls, notes) is skipped.
+nulls, notes) is skipped. The streaming-intake saturation keys from
+``bench/intake_bench.py`` ride these patterns unchanged:
+``intake_drain_per_sec`` (higher) and
+``intake_p99_queue_age_seconds`` (lower); a failed intake round
+emits them as null, which load_rounds drops.
 
 Usage: python bench/trend.py [BENCH_r*.json ...] [--threshold F]
        [--json] [--strict]

@@ -82,6 +82,8 @@ from .supervise import (RESUMABLE_EXIT, CheckpointStore, PreemptedError,
 from .fleet import FleetJob, GridBatch
 from .scheduler import (FleetPreemptedError, FleetScheduler,
                         OwnershipLostError, SLOPolicy)
+from .intake import (IntakeError, IntakeRetryExhausted, StreamIntake,
+                     submit as submit_job)
 from .integrity import IntegrityError, register_conserved
 from . import telemetry
 from .telemetry import LogHistogram
@@ -142,6 +144,10 @@ __all__ = [
     "GridBatch",
     "FleetPreemptedError",
     "FleetScheduler",
+    "IntakeError",
+    "IntakeRetryExhausted",
+    "StreamIntake",
+    "submit_job",
     "IntegrityError",
     "register_conserved",
     "SLOPolicy",

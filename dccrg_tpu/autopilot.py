@@ -332,6 +332,57 @@ def _rule_retry_budget(before, inp):
     return new if new != before else None
 
 
+def _rule_intake_gate(before, inp):
+    """The streaming-intake backpressure gate with hysteresis: the
+    gate CLOSES (1) when the arrival/drain EWMA ratio crosses ``hi``
+    or the oldest waiting record's age exceeds ``age_bound_s``, and
+    only REOPENS (0) once the ratio has fallen below the strictly
+    lower ``lo`` with the queue age back in bounds — the hysteresis
+    band (plus the caller's per-EWMA-window evaluation cadence) is
+    what keeps the gate from flapping at the saturation boundary.
+    Thresholds travel inside the recorded inputs so replay is
+    self-contained."""
+    state = 1 if before else 0
+    ratio = inp.get("ratio")
+    age = float(inp.get("queue_age_s", 0.0))
+    hi = float(inp.get("hi", 1.2))
+    lo = float(inp.get("lo", 0.9))
+    bound = float(inp.get("age_bound_s", 30.0))
+    over = (ratio is not None and float(ratio) >= hi) or age > bound
+    calm = (ratio is None or float(ratio) <= lo) and age <= bound
+    if state == 0 and over:
+        return 1
+    if state == 1 and calm:
+        return 0
+    return None
+
+
+def _rule_intake_shed(before, inp):
+    """Narrate a journaled graceful shed under intake saturation:
+    ``n`` waiting spool records were moved aside because the backlog
+    implied an unbounded queue age (``backlog / drain`` beyond the
+    bound). The 'knob' is the cumulative shed count — the record
+    exists so ``explain`` reconstructs WHAT was shed, from WHICH
+    tenant and under WHICH saturation numbers from the journal
+    alone."""
+    n = int(inp.get("n", 0))
+    if n <= 0:
+        return None
+    return int(before) + n
+
+
+def _rule_intake_quarantine(before, inp):
+    """Narrate a poison-job quarantine: a spool record whose
+    admission failed ``attempts`` times (or failed permanently —
+    torn frame, malformed spec, unknown kernel) moved to
+    ``spool/quarantine/`` with a structured reason instead of
+    wedging the stream. The 'knob' is the cumulative quarantine
+    count."""
+    if not inp.get("name"):
+        return None
+    return int(before) + 1
+
+
 def _rule_fleet_reclaim(before, inp):
     """Narrate an elastic-fleet job reclaim in the decision journal:
     ``n`` jobs of a dead rank were taken over (lease expired, epoch
@@ -360,6 +411,9 @@ RULES = {
     "shed.cooldown": _rule_shed_cooldown,
     "retry.budget": _rule_retry_budget,
     "fleet.reclaim": _rule_fleet_reclaim,
+    "intake.backpressure": _rule_intake_gate,
+    "intake.shed": _rule_intake_shed,
+    "intake.quarantine": _rule_intake_quarantine,
 }
 
 #: the "expected effect" text journaled with each rule's decisions
@@ -396,6 +450,22 @@ EXPECTED = {
     "fleet.reclaim": ("a dead rank's jobs were reclaimed by lease "
                       "expiry and re-admitted from their checkpoint "
                       "stems on this rank"),
+    "intake.backpressure": ("hysteresis gate on spool admission: "
+                            "arrivals outrunning drain (or an aged "
+                            "queue) pause new admissions until the "
+                            "stream calms — the spool is the durable "
+                            "buffer, queue age stays bounded"),
+    "intake.shed": ("graceful shed under saturation: the backlog "
+                    "implied an unbounded queue age, so the newest "
+                    "records of the most-backlogged tenant moved "
+                    "aside (journaled, re-submittable) instead of "
+                    "aging forever behind a closed gate"),
+    "intake.quarantine": ("poison-job quarantine: a record that "
+                          "cannot admit (K retries exhausted or a "
+                          "permanent spec fault) moved to "
+                          "spool/quarantine/ with a structured "
+                          "reason so the stream keeps draining "
+                          "behind it"),
 }
 
 
@@ -506,6 +576,12 @@ class Autopilot:
         self._retry_seen: dict = {}
         #: cumulative elastic-fleet reclaims narrated in the journal
         self.reclaims = 0
+        #: streaming-intake control state narrated in the journal:
+        #: the backpressure gate (0 = open, 1 = closed) plus the
+        #: cumulative shed / quarantine counts
+        self.intake_gate = 0
+        self.intake_sheds = 0
+        self.intake_quarantines = 0
         # journal-driven cross-run warm start of the QUANTUM knob
         # (the capacity.learn/probe discipline): load_history recovers
         # the last run's journaled quantum.learn, the first tick
@@ -834,6 +910,41 @@ class Autopilot:
             {"n": len(jobs), "jobs": jobs, "dead_rank": int(dead_rank),
              "lease_s": float(lease_s)})
         self.reclaims = int(after)
+
+    # -- streaming-intake decisions (dccrg_tpu/intake.py) -------------
+
+    def record_intake_gate(self, inputs: dict) -> int:
+        """Evaluate the intake backpressure gate through the
+        ``intake.backpressure`` rule (journaled on every flip) and
+        return the new gate state (0 = open, 1 = closed). ``inputs``
+        must already be JSON-faithful (rounded floats) — they are
+        recorded verbatim and replay re-derives the flip from them
+        alone."""
+        after = self._apply("intake.backpressure", "intake_gate",
+                            int(self.intake_gate), dict(inputs))
+        self.intake_gate = int(after)
+        return self.intake_gate
+
+    def record_intake_shed(self, names, tenant, inputs: dict) -> None:
+        """A graceful intake shed happened: journal it through the
+        ``intake.shed`` rule so ``explain`` narrates what was shed
+        and under which saturation numbers."""
+        names = sorted(str(n) for n in names)
+        after = self._apply(
+            "intake.shed", "intake_sheds", int(self.intake_sheds),
+            dict(inputs, n=len(names), names=names,
+                 tenant=str(tenant)))
+        self.intake_sheds = int(after)
+
+    def record_intake_quarantine(self, name, reason: dict) -> None:
+        """A poison job moved to quarantine: journal it through the
+        ``intake.quarantine`` rule with the structured reason record
+        (error type, attempts, tenant)."""
+        after = self._apply(
+            "intake.quarantine", "intake_quarantines",
+            int(self.intake_quarantines),
+            dict(reason, name=str(name)))
+        self.intake_quarantines = int(after)
 
     def _tune_checkpoints(self, sched, inp) -> None:
         lo, hi = self.bounds["checkpoint_every"]

@@ -573,6 +573,26 @@ def default_kv():
     return _LOCAL_KV
 
 
+def prefix_census(kv, prefix: str):
+    """One-call ``{full_key: value}`` snapshot of every key under
+    ``prefix``, or None when the KV cannot list (callers then fall
+    back to per-key reads). On the real coordination service an
+    ABSENT key costs a full blocking-get timeout, so every tick-path
+    consumer (job leases, the streaming-intake front door) reads one
+    census instead of per-key; the service may list RELATIVE child
+    names, which are normalized back to full keys so lookups are
+    uniform across KV implementations."""
+    dir_get = getattr(kv, "dir_get", None)
+    if dir_get is None:
+        return None
+    raw = dir_get(str(prefix))
+    if raw is None:
+        return None
+    p = str(prefix).rstrip("/") + "/"
+    return {(str(k) if str(k).startswith(p) else p + str(k)): v
+            for k, v in raw.items()}
+
+
 # ---------------------------------------------------------------------
 # sealed records + fenced KV barrier (the distributed-AMR commit rides
 # these; see dccrg_tpu/distamr.py)

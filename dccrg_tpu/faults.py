@@ -205,6 +205,19 @@ DIST_AMR_FAULT_SITES = (
     ("amr.install", "commit"),
 )
 
+# streaming-intake fault sites (dccrg_tpu/intake.py): the spool
+# submission/scan/read points plus the claim->add exactly-once
+# admission window. Fire only when a StreamIntake drives admission,
+# so — like DIST_AMR_FAULT_SITES — they are deliberately NOT in
+# MUTATION_FAULT_SITES (the single-grid fuzzer would wait forever).
+INTAKE_FAULT_SITES = (
+    ("intake.spool.write.torn", None),
+    ("intake.spool.rename.torn", None),
+    ("intake.spool.scan", None),
+    ("intake.spool.read", None),
+    ("intake.claim", None),
+)
+
 _active: "FaultPlan | None" = None
 
 
@@ -457,6 +470,60 @@ class FaultPlan:
         abort, never action on the torn payload."""
         return self._add(site + ".torn", "torn", times, rank=rank)
 
+    # -- streaming-intake spool faults (dccrg_tpu/intake.py) ----------
+
+    def spool_torn_write(self, times=1, job=None):
+        """A submitter dies mid spec write: the spool file LANDS with
+        a truncated sealed frame (a partial spec write reaching the
+        final name). Queried — not raised — through
+        :func:`take_spool_torn` by :func:`intake.submit`, so the torn
+        bytes are durable and the intake reader's CRC conviction
+        (:class:`~dccrg_tpu.coord.TornRecordError`), bounded retries
+        and poison-job quarantine are what get exercised."""
+        return self._add("intake.spool.write.torn", "torn", times,
+                         job=job)
+
+    def spool_torn_rename(self, times=1, job=None):
+        """A submitter dies BETWEEN the temp write and the atomic
+        rename-in: the spec stays in the temp directory and never
+        becomes visible (the other half of the torn-submission fault
+        class). Queried — not raised — through
+        :func:`take_spool_torn_rename` by :func:`intake.submit`; the
+        stream must simply never see the job (durable-spool contract:
+        visibility IS the rename)."""
+        return self._add("intake.spool.rename.torn", "torn", times,
+                         job=job)
+
+    def spool_delay(self, times=1, rank=None):
+        """Delayed directory visibility: one spool scan fails to see
+        the newest not-yet-tracked entry (an NFS-ish lagging readdir).
+        Queried — not raised — through :func:`take_spool_delay` by the
+        intake scanner; the entry must be admitted by a LATER scan,
+        never lost."""
+        return self._add("intake.spool.scan", "delay", times,
+                         rank=rank)
+
+    def spool_io_error(self, times=1, job=None, rank=None):
+        """Transient I/O error reading a spool spec file (site
+        ``intake.spool.read``) — the retry/backoff envelope's bread
+        and butter: under ``times < K`` retries the job must still
+        admit; at ``times >= K`` it must quarantine with a structured
+        reason instead of wedging the stream."""
+        return self._add("intake.spool.read", "io", times, job=job,
+                         rank=rank)
+
+    def intake_death(self, rank=None, times=1, job=None):
+        """This rank dies BETWEEN the spool claim (intake lease
+        acquired, journal record written) and the scheduler add —
+        the exactly-once admission window. Raised at site
+        ``intake.claim`` as :class:`InjectedRankDeath`: in-process
+        tests catch it and drive a survivor intake's lease-expiry
+        reclaim; the REAL harness (tests/mp_harness.py
+        ``intake_kill``) hard-exits the OS process, and the surviving
+        fleet must re-admit from the journal record exactly once."""
+        return self._add("intake.claim", "rank_death", times,
+                         rank=rank, job=job)
+
     # -- installation -------------------------------------------------
 
     def __enter__(self):
@@ -564,6 +631,51 @@ def take_torn_record(site: str, rank=None) -> bool:
     if rule is None:
         return False
     plan.log.append((site + ".torn", "torn", dict(ctx)))
+    return True
+
+
+def take_spool_torn(job=None) -> bool:
+    """Consume a scheduled :meth:`~FaultPlan.spool_torn_write` for
+    this submission; True when one fired (the submitter then lands a
+    truncated sealed frame at the FINAL spool name)."""
+    plan = _active
+    if plan is None:
+        return False
+    ctx = {"job": job}
+    rule = plan._take("intake.spool.write.torn", ctx)
+    if rule is None:
+        return False
+    plan.log.append(("intake.spool.write.torn", "torn", dict(ctx)))
+    return True
+
+
+def take_spool_torn_rename(job=None) -> bool:
+    """Consume a scheduled :meth:`~FaultPlan.spool_torn_rename`; True
+    when one fired (the submitter then leaves the spec in the temp
+    directory — it never becomes visible)."""
+    plan = _active
+    if plan is None:
+        return False
+    ctx = {"job": job}
+    rule = plan._take("intake.spool.rename.torn", ctx)
+    if rule is None:
+        return False
+    plan.log.append(("intake.spool.rename.torn", "torn", dict(ctx)))
+    return True
+
+
+def take_spool_delay(rank=None) -> bool:
+    """Consume a scheduled :meth:`~FaultPlan.spool_delay` for this
+    spool scan; True when one fired (the scanner then hides the
+    newest not-yet-tracked entry until a later scan)."""
+    plan = _active
+    if plan is None:
+        return False
+    ctx = {"rank": rank}
+    rule = plan._take("intake.spool.scan", ctx)
+    if rule is None:
+        return False
+    plan.log.append(("intake.spool.scan", "delay", dict(ctx)))
     return True
 
 

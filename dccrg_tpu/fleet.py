@@ -97,6 +97,33 @@ def quantum_default(default: int = 8) -> int:
 FLEET_KERNELS: dict = {}
 
 
+class JobSpecError(ValueError):
+    """A job record that can NEVER become a valid :class:`FleetJob`
+    (missing name, malformed lengths, ...). A ValueError subclass so
+    pre-existing job-file handling keeps working; typed so the
+    streaming-intake front door can quarantine the record with a
+    structured reason instead of retrying a permanent failure."""
+
+
+class UnknownKernelError(KeyError):
+    """A job names a kernel the registry (including the lazily
+    imported model zoo) does not know. A KeyError subclass for
+    backward compatibility; typed so admission-time validation can
+    classify it as a permanent (quarantine) fault rather than a
+    transient one."""
+
+    def __init__(self, job: str, kernel, registered):
+        self.job = str(job)
+        self.kernel = kernel
+        self.registered = sorted(registered)
+        super().__init__(
+            f"job {self.job!r}: unknown kernel {kernel!r} "
+            f"(registered: {self.registered})")
+
+    def __str__(self) -> str:  # KeyError quotes its arg; keep prose
+        return self.args[0]
+
+
 def register_kernel(name: str, fn) -> None:
     """Register a grid step kernel under a name job files can
     reference. The kernel has the standard grid-kernel signature
@@ -310,9 +337,8 @@ class FleetJob:
             _kernel_spec(str(self.kernel))  # zoo registration on miss
             fn = FLEET_KERNELS.get(str(self.kernel))
         if fn is None:
-            raise KeyError(
-                f"job {self.name!r}: unknown kernel {self.kernel!r} "
-                f"(registered: {sorted(FLEET_KERNELS)})")
+            raise UnknownKernelError(self.name, self.kernel,
+                                     FLEET_KERNELS)
         return fn
 
     def bucket_key(self):
@@ -809,28 +835,40 @@ class GridBatch:
 # CLI: python -m dccrg_tpu.fleet <jobs.json> | --demo N
 # ---------------------------------------------------------------------
 
-def _jobs_from_spec(spec: dict) -> list:
-    """Parse a job-file dict (``{"jobs": [{...}]}``) into
-    :class:`FleetJob` objects. Per-job keys: ``name`` (required,
+def job_from_row(row: dict, *, validate_kernel: bool = False) -> FleetJob:
+    """Parse ONE job record into a :class:`FleetJob` — the single
+    validation/kernel-spec-registry path shared by job files
+    (:func:`_jobs_from_spec`) and the streaming-intake spool
+    (``dccrg_tpu/intake.py``). Per-job keys: ``name`` (required,
     unique), ``n`` (cube edge) or ``length`` [x, y, z], ``kernel``
     (registry name), ``steps``, ``params`` (list of floats; ``dt`` is
     shorthand for one), ``priority``, ``seed``, ``checkpoint_every``,
     ``periodic`` [bool, bool, bool], ``redundancy`` (2 = DMR: two
     slots step the job and their digests are compared every
     quantum), ``slo_ms`` (completion-deadline milliseconds for the
-    scheduler's latency-SLO admission; absent = best-effort)."""
-    jobs = []
-    for row in spec.get("jobs", []):
-        if "name" not in row:
-            raise ValueError(f"job row without a name: {row}")
-        length = (tuple(row["length"]) if "length" in row
-                  else (int(row.get("n", 16)),) * 3)
+    scheduler's latency-SLO admission; absent = best-effort).
+
+    Malformed records raise the typed :class:`JobSpecError`;
+    ``validate_kernel=True`` additionally resolves the kernel name
+    eagerly so an unknown kernel surfaces HERE as the typed
+    :class:`UnknownKernelError` (the intake quarantine reason)
+    instead of a raw ``KeyError`` at first dispatch."""
+    if not isinstance(row, dict):
+        raise JobSpecError(f"job row is not a mapping: {row!r}")
+    if "name" not in row:
+        raise JobSpecError(f"job row without a name: {row}")
+    try:
+        length = (tuple(int(v) for v in row["length"])
+                  if "length" in row else (int(row.get("n", 16)),) * 3)
+        if len(length) != 3 or any(v < 1 for v in length):
+            raise JobSpecError(
+                f"job {row['name']!r}: bad length {length}")
         params = row.get("params")
         if params is None and "dt" in row:
             params = [float(row["dt"])]
         # params None falls through to the kernel's registered spec
         # default (the model zoo) or the classic (0.1,) in FleetJob
-        jobs.append(FleetJob(
+        job = FleetJob(
             row["name"], length=length,
             kernel=row.get("kernel", "diffuse"),
             n_steps=int(row.get("steps", 10)), params=params,
@@ -840,8 +878,22 @@ def _jobs_from_spec(spec: dict) -> list:
             checkpoint_every=int(row.get("checkpoint_every", 8)),
             redundancy=int(row.get("redundancy", 1)),
             slo_ms=row.get("slo_ms"),
-        ))
-    return jobs
+        )
+    except JobSpecError:
+        raise
+    except (TypeError, ValueError, KeyError) as e:
+        raise JobSpecError(
+            f"job {row.get('name')!r}: malformed record: {e}") from e
+    if validate_kernel and not callable(job.kernel):
+        job.resolved_kernel()  # UnknownKernelError on a registry miss
+    return job
+
+
+def _jobs_from_spec(spec: dict) -> list:
+    """Parse a job-file dict (``{"jobs": [{...}]}``) into
+    :class:`FleetJob` objects via :func:`job_from_row` (one shared
+    validation path — see its docstring for the per-job keys)."""
+    return [job_from_row(row) for row in spec.get("jobs", [])]
 
 
 def _main(argv=None) -> int:

@@ -180,17 +180,7 @@ class JobLeases:
         path reads the census once instead of per-key; publish-time
         fencing (:meth:`check` from ``_save_job``/``_finish``) stays
         on fresh per-key reads."""
-        dir_get = getattr(self.kv, "dir_get", None)
-        if dir_get is None:
-            return None
-        raw = dir_get(self.prefix)
-        if raw is None:
-            return None
-        # the service may list relative child names; normalize to
-        # full keys so lookups are uniform
-        p = self.prefix.rstrip("/") + "/"
-        return {(str(k) if str(k).startswith(p) else p + str(k)): v
-                for k, v in raw.items()}
+        return coord.prefix_census(self.kv, self.prefix)
 
     def _read(self, key, census=None):
         return census.get(key) if census is not None \
@@ -565,7 +555,8 @@ class FleetScheduler:
                  resume=True, devices=None,
                  install_signal_handlers=False, audit_every=None,
                  quarantine_after=None, slo_policy=None,
-                 autopilot=None, rank_aware=None, membership=None):
+                 autopilot=None, rank_aware=None, membership=None,
+                 intake=None):
         self.dir = str(checkpoint_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.max_batch = (max_batch_default() if max_batch is None
@@ -662,6 +653,20 @@ class FleetScheduler:
                 # seconds-long XLA compile mid-tick is never read as
                 # a death (fake-clock tests beat by hand)
                 membership.start_auto()
+        # streaming intake front door: OFF by default — None means
+        # the serving loop takes ZERO new branches (the negative pin
+        # in tests/test_intake.py); DCCRG_INTAKE=1 constructs one
+        # over DCCRG_INTAKE_SPOOL, or inject a StreamIntake directly
+        self.intake = None
+        if intake is None and os.environ.get(
+                "DCCRG_INTAKE", "") not in ("", "0", "off", "false",
+                                            "no"):
+            from . import intake as intake_mod
+
+            intake = intake_mod.StreamIntake.from_env(self)
+        if intake is not None:
+            self.intake = intake
+            intake.attach(self)
         for j in jobs:
             self.add(j)
 
@@ -1903,6 +1908,11 @@ class FleetScheduler:
                         f"injected host death at tick {self.ticks}")
                 if self.rank_aware:
                     self._rank_tick()
+                if self.intake is not None:
+                    # the streaming front door: scan / crash-recover /
+                    # gate / admit before this tick's admission pass
+                    # reads the queue
+                    self.intake.pump()
                 self._release_parked()
                 self._admit_pending()
                 active = [b for insts in self.buckets.values()
@@ -1928,6 +1938,18 @@ class FleetScheduler:
                             break
                         time.sleep(min(0.05,
                                        self.membership.heartbeat_s / 4))
+                        continue
+                    if self.intake is not None \
+                            and not self.intake.idle():
+                        # local work drained but the front door has
+                        # waiting or in-flight records: idle-continue
+                        # at the intake poll cadence
+                        self.ticks += 1
+                        if max_ticks is not None \
+                                and self.ticks >= int(max_ticks):
+                            break
+                        if self.intake.poll_s > 0:
+                            time.sleep(self.intake.poll_s)
                         continue
                     if self.autopilot is not None:
                         # a clean drain: seeded keys that never

@@ -249,6 +249,25 @@ class Registry:
     def histogram(self, name: str, **labels) -> "LogHistogram | None":
         return self.histograms.get(_key(name, labels))
 
+    def histogram_total(self, name: str, **labels) -> "LogHistogram | None":
+        """Merge every series of ``name`` whose labels include the
+        given ones into one :class:`LogHistogram` (the counter_total
+        analogue — e.g. the p99 queue age across all per-tenant
+        intake series), or None when no series matches."""
+        want = set(labels.items())
+        merged = None
+        for (n, lab), h in list(self.histograms.items()):
+            if n != name or not want <= set(lab):
+                continue
+            if merged is None:
+                merged = LogHistogram()
+            merged.counts = [a + b for a, b in
+                             zip(merged.counts, h.counts)]
+            merged.total += h.total
+            merged.sum_seconds += h.sum_seconds
+            merged.max_seconds = max(merged.max_seconds, h.max_seconds)
+        return merged
+
     def reset(self) -> None:
         self.counters.clear()
         self.gauges.clear()

@@ -111,6 +111,15 @@ drives the scenarios the faked splits cannot truthfully exercise:
   process really exits; rank 0's writer aborts typed at its barrier
   bound, the previous checkpoint stays bitwise intact, and nothing
   is ever published.
+- ``intake_kill``    — the streaming-intake exactly-once admission
+  proof (dccrg_tpu/intake.py): rank 0 drops job records into the
+  shared spool; rank 1 claims one through the intake CAS lease,
+  writes its journal record, and REALLY exits between the claim and
+  the scheduler add (FaultPlan ``intake_death`` at site
+  ``intake.claim``). Rank 0 must reclaim the orphaned admission
+  within the lease bound, re-admit from the journal record, and
+  drain EVERY job exactly once with bitwise-solo digests — no job
+  lost, none run twice.
 
 Runs are DETERMINISTIC: ``--seed`` drives the field values and fault
 placement the same way fuzz.py's seeds do — two runs with the same
@@ -150,7 +159,8 @@ SCENARIOS = ("save_restore", "psum", "barrier_timeout", "rank_kill",
              "consensus", "sdc_rank", "preempt", "delta_rank_kill",
              "trace_merge", "host_death", "zombie_fence",
              "host_rejoin", "amr_commit", "amr_rank_kill",
-             "amr_zombie", "async_save", "async_save_kill")
+             "amr_zombie", "async_save", "async_save_kill",
+             "intake_kill")
 # elastic-fleet scenario knobs: tight heartbeat/lease bounds so the
 # whole detect->reclaim->drain recovery fits inside the ~10 s window
 # jax's coordination service grants survivors after a peer dies
@@ -192,7 +202,8 @@ AMR_KILL_SITES = {"propose": ("amr.propose", None),
 # Kept 2-proc-only: with >2 procs another survivor may still need the
 # leader-hosted coordination service for its own asserts.
 PEER_DEATH_SCENARIOS = frozenset(
-    {"rank_kill", "delta_kill", "amr_kill", "async_save_kill"})
+    {"rank_kill", "delta_kill", "amr_kill", "async_save_kill",
+     "intake_kill"})
 
 
 # =====================================================================
@@ -1380,6 +1391,87 @@ def scenario_async_save_kill(args):
     assert resilience.verify_checkpoint(fn) == []
 
 
+def scenario_intake_kill(args):
+    """The exactly-once admission proof with a REAL OS process death
+    (see module docstring): rank 1 dies between the spool claim
+    (intake lease + journal record durable in the coordination KV)
+    and the scheduler add; rank 0 reclaims within the lease bound and
+    drains every job bitwise-solo, exactly once."""
+    import jax
+
+    from dccrg_tpu import coord, faults, intake, telemetry
+    from dccrg_tpu.scheduler import FleetScheduler
+
+    os.environ["DCCRG_BARRIER_TIMEOUT"] = "5"
+    specs = _fleet_job_specs(args.seed, count=4, steps=16)
+    for s in specs:
+        s["name"] = s["name"].replace("fj", "ij")
+    names = [s["name"] for s in specs]
+    refs = _solo_refs(specs)  # the slow compile, up front
+    spool = os.path.join(args.tmp, "spool")  # shared by both ranks
+    store = os.path.join(args.tmp, f"fleet.rank{args.rank}")
+    os.makedirs(store, exist_ok=True)
+    m = coord.Membership(args.rank, args.procs,
+                         heartbeat_s=FLEET_HEARTBEAT_S,
+                         lease_s=FLEET_LEASE_S)
+    it = intake.StreamIntake(spool, membership=m,
+                             lease_s=FLEET_LEASE_S, poll_s=0.02)
+    sched = FleetScheduler(store, (), quantum=4, membership=m,
+                           devices=[jax.local_devices()[0]],
+                           intake=it)
+    if args.rank == 1:
+        # claim a spool record, then REALLY die between the claim and
+        # the scheduler add (InjectedRankDeath -> os._exit(DEATH_RC))
+        plan = faults.FaultPlan(seed=args.seed)
+        plan.intake_death(rank=args.rank)
+        with plan:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 60:
+                sched.run(max_ticks=sched.ticks + 1)
+                time.sleep(0.02)
+        raise AssertionError("rank 1 should have died at the claim")
+    # rank 0: drop the records in, then HOLD until rank 1's claim is
+    # durable (its journal record in the KV) so the death window is
+    # guaranteed to open before this rank competes for admissions
+    for spec in specs:
+        intake.submit(spool, dict(
+            name=spec["name"], length=list(spec["length"]),
+            steps=spec["n_steps"], params=list(spec["params"]),
+            seed=spec["seed"],
+            checkpoint_every=spec["checkpoint_every"]))
+    kv = m.kv
+    claimed = None
+    deadline = time.monotonic() + 60
+    while claimed is None and time.monotonic() < deadline:
+        for n in names:
+            if kv.get(f"dccrg/intake/journal/{n}") is not None:
+                claimed = n
+                break
+        time.sleep(0.05)
+    assert claimed is not None, "rank 1 never claimed a spool record"
+    # serve: the run-loop pump must reclaim the orphaned admission
+    # (lease expiry + membership DEAD) and drain the whole fleet
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 90:
+        sched.run(max_ticks=sched.ticks + 1)
+        if all(n in sched.report for n in names) and it.idle():
+            break
+        time.sleep(0.02)
+    assert all(n in sched.report for n in names), sched.report
+    assert it.idle(), (it.backlog(), dict(it.leases.owned))
+    _assert_fleet_solo(args, sched, specs, refs)
+    # exactly once: the orphan was reclaimed (not re-submitted), every
+    # admission happened on THIS rank exactly once, and each job's
+    # terminal intake marker is in place
+    assert it.reclaimed == 1, it.reclaimed
+    admitted = int(telemetry.registry().counter_total(
+        "dccrg_intake_admitted_total"))
+    assert admitted == len(names), (admitted, names)
+    for n in names:
+        assert kv.get(f"dccrg/intake/done/{n}") is not None, n
+    print(f"[rank {args.rank}] RECLAIMED ['{claimed}']", flush=True)
+
+
 CHILD_SCENARIOS = {
     "probe": scenario_probe,
     "save_restore": scenario_save_restore,
@@ -1402,6 +1494,7 @@ CHILD_SCENARIOS = {
     "amr_zombie": scenario_amr_zombie,
     "async_save": scenario_async_save,
     "async_save_kill": scenario_async_save_kill,
+    "intake_kill": scenario_intake_kill,
 }
 
 
@@ -1851,7 +1944,7 @@ def parent_main(args) -> int:
         if sc == "amr_zombie":  # parent-orchestrated real SIGSTOP
             def run(_sc, args_, expect_rcs=None):  # noqa: ARG001
                 return _run_amr_zombie(args_)
-        if sc == "async_save_kill":
+        if sc in ("async_save_kill", "intake_kill"):
             expect = [DEATH_RC if r == 1 else 0
                       for r in range(args.procs)]
         verdict = run(sc, args, expect_rcs=expect)

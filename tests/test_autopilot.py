@@ -102,6 +102,19 @@ def test_rules_pure_and_json_faithful():
                              "lo": 1, "hi": 8}),
         "fleet.reclaim": (0, {"n": 2, "jobs": ["a", "b"],
                               "dead_rank": 1, "lease_s": 8.0}),
+        "intake.backpressure": (0, {"ratio": 1.5,
+                                    "arrival_per_s": 3.0,
+                                    "drain_per_s": 2.0,
+                                    "queue_age_s": 0.5, "backlog": 6,
+                                    "hi": 1.2, "lo": 0.9,
+                                    "age_bound_s": 30.0}),
+        "intake.shed": (0, {"n": 2, "tenant": "default",
+                            "names": ["a", "b"], "backlog": 8,
+                            "drain_per_s": 1.0, "age_bound_s": 4.0}),
+        "intake.quarantine": (0, {"name": "j1", "tenant": "default",
+                                  "attempts": 4,
+                                  "error_type":
+                                      "IntakeRetryExhausted"}),
     }
     assert set(cases) == set(RULES)
     for rule, (before, inp) in cases.items():

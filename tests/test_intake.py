@@ -1,0 +1,596 @@
+"""Durable streaming-intake tests (dccrg_tpu/intake.py).
+
+Everything here is tier-1: fake clock, in-memory KV, single process.
+The exactly-once admission claim under a REAL kill -9 between spool
+claim and scheduler add is proven by the ``intake_kill`` scenario in
+tests/mp_harness.py (run via tests/ci_mp_leg.sh); this file proves
+the same protocol with an in-process injected death, plus the retry/
+quarantine envelope, the backpressure gate's hysteresis, tenant
+shaping, the journaled graceful shed, and the decision-journal
+replay property. The negative pin: a scheduler constructed without
+an intake (and without ``DCCRG_INTAKE=1``) has ``sched.intake is
+None`` and takes zero new branches.
+"""
+
+import json
+import os
+
+import pytest
+
+from dccrg_tpu import coord, faults, fleet, intake, telemetry
+from dccrg_tpu.autopilot import RULES, Autopilot, read_journal, replay
+from dccrg_tpu.fleet import (FleetJob, JobSpecError, UnknownKernelError,
+                             job_from_row, run_solo)
+from dccrg_tpu.intake import IntakeError, StreamIntake, submit
+from dccrg_tpu.scheduler import FleetScheduler
+
+pytestmark = pytest.mark.intake
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Intake knobs out of the env, a fresh telemetry registry, and
+    both again on the way out (the registry is process-global)."""
+    for var in ("DCCRG_INTAKE", "DCCRG_INTAKE_SPOOL",
+                "DCCRG_INTAKE_RETRIES", "DCCRG_INTAKE_BACKOFF_S",
+                "DCCRG_INTAKE_BACKOFF_CAP_S", "DCCRG_INTAKE_AGE_S",
+                "DCCRG_TENANT_RATE", "DCCRG_TENANT_WEIGHT",
+                "DCCRG_TENANT_BURST", "DCCRG_AUTOPILOT",
+                "DCCRG_DECISION_FILE"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.registry().reset()
+    yield
+    telemetry.registry().reset()
+
+
+def _row(name, steps=4, **kw):
+    d = {"name": name, "n": 8, "steps": steps,
+         "checkpoint_every": 4}
+    d.update(kw)
+    return d
+
+
+class _Env:
+    """One spool + shared KV + fake clock + N (intake, scheduler)
+    pairs — the in-process fleet the admission protocol runs on."""
+
+    def __init__(self, tmp_path, ranks=1, **intake_kw):
+        self.spool = str(tmp_path / "spool")
+        self.kv = coord.InMemoryKV()
+        self.t = [0.0]
+        self.pairs = []
+        kw = dict(lease_s=1.0, window_s=0.5, poll_s=0.0,
+                  backoff_s=0.01, backoff_cap_s=0.05)
+        kw.update(intake_kw)
+        for r in range(ranks):
+            it = StreamIntake(self.spool, kv=self.kv, rank=r,
+                              clock=lambda: self.t[0], **kw)
+            sched = FleetScheduler(str(tmp_path / f"ck{r}"),
+                                   quantum=4, intake=it)
+            self.pairs.append((it, sched))
+
+    def submit(self, row, **kw):
+        return submit(self.spool, row, **kw)
+
+    def tick(self, dt=0.1):
+        self.t[0] += dt
+
+
+# -- exactly-once admission ------------------------------------------
+
+def test_submit_pump_run_admits_exactly_once(tmp_path):
+    """The happy path end to end: a spool record is claimed, added,
+    served to completion, finalized (done marker, spool archive,
+    journal GC, lease released)."""
+    env = _Env(tmp_path)
+    it, sched = env.pairs[0]
+    env.submit(_row("j1"), tenant="acme")
+    assert it.pump()["admitted"] == 1
+    env.tick()
+    report = sched.run(max_ticks=100)
+    assert report["j1"]["status"] == "done"
+    env.tick()
+    it.pump()  # the finalize pass
+    assert it.idle()
+    assert env.kv.get("dccrg/intake/done/j1") == "admitted:0"
+    assert env.kv.get("dccrg/intake/journal/j1") is None
+    assert not it.leases.owned
+    assert os.path.exists(os.path.join(env.spool, "admitted",
+                                       "j1.json"))
+    # the bitwise-solo pin: streaming admission changes WHEN a job
+    # runs, never what it computes
+    solo = run_solo(FleetJob("j1", length=(8, 8, 8), n_steps=4,
+                             checkpoint_every=4))
+    assert report["j1"]["digest"] == solo
+    assert (telemetry.registry().counter_total(
+        "dccrg_intake_admitted_total", tenant="acme") == 1)
+
+
+def test_duplicate_name_resubmission_deduped_by_done_marker(tmp_path):
+    """Re-submitting a finished job under the same name archives the
+    duplicate without a second admission."""
+    env = _Env(tmp_path)
+    it, sched = env.pairs[0]
+    env.submit(_row("j1"))
+    it.pump()
+    sched.run(max_ticks=100)
+    env.tick()
+    it.pump()
+    assert env.kv.get("dccrg/intake/done/j1") is not None
+    env.submit(_row("j1"))
+    env.tick()
+    stats = it.pump()
+    assert stats["admitted"] == 0 and it.deduped == 1
+
+
+def test_same_content_different_name_deduped_by_nonce(tmp_path):
+    """The content nonce (CAS ``nonce/`` key) rejects the same spec
+    submitted under two names — the retried-submitter double-fire."""
+    env = _Env(tmp_path)
+    it, sched = env.pairs[0]
+    nonce = intake.record_nonce(_row("j1"), "default")
+    env.submit(_row("j1"), nonce=nonce)
+    env.submit(_row("j2"), nonce=nonce)  # a renamed duplicate
+    it.pump()
+    assert it.admitted == 1 and it.deduped == 1
+    assert "j2" not in sched._by_name
+
+
+def test_kill_between_claim_and_add_reclaimed_exactly_once(tmp_path):
+    """The tentpole protocol in-process: rank 0 dies at the
+    ``intake.claim`` site (lease held, journal written, job NOT yet
+    added); rank 1 reclaims after lease expiry with the epoch-fenced
+    CAS and re-admits from the journal record; the job runs exactly
+    once and the decision journal replays clean."""
+    env = _Env(tmp_path, ranks=2)
+    it0, _s0 = env.pairs[0]
+    it1, s1 = env.pairs[1]
+    env.submit(_row("j1"))
+    plan = faults.FaultPlan()
+    plan.intake_death(rank=0)
+    with plan:
+        with pytest.raises(faults.InjectedRankDeath):
+            it0.pump()
+    # the half-admitted state a SIGKILL leaves behind
+    assert env.kv.get("dccrg/intake/j1") is not None
+    assert env.kv.get("dccrg/intake/journal/j1") is not None
+    assert "j1" not in s1._by_name
+    # before expiry the survivor must NOT steal the admission
+    it1.pump()
+    assert it1.reclaimed == 0 and "j1" not in s1._by_name
+    env.tick(1.5)  # past lease_s=1.0
+    stats = it1.pump()
+    assert stats["reclaimed"] == 1
+    assert "j1" in s1._by_name
+    report = s1.run(max_ticks=200)
+    assert report["j1"]["status"] == "done"
+    env.tick()
+    it1.pump()
+    assert env.kv.get("dccrg/intake/done/j1") == "admitted:1"
+    assert it1.idle() and it1.admitted == 1
+
+
+def test_reclaim_respects_membership_liveness(tmp_path):
+    """An attached membership vetoes reclaim while the claimant is
+    merely SUSPECT — only DEAD releases the admission."""
+    kv = coord.InMemoryKV()
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    m0 = coord.Membership(0, 2, kv=kv, clock=clock,
+                          heartbeat_s=0.25, lease_s=2.0)
+    m1 = coord.Membership(1, 2, kv=kv, clock=clock,
+                          heartbeat_s=0.25, lease_s=2.0)
+    m0.heartbeat(force=True)
+    m1.heartbeat(force=True)
+    m1.poll(timeout=0.05)  # baseline rank 0's beat at t=0
+    spool = str(tmp_path / "spool")
+    submit(spool, _row("j1"))
+    it0 = StreamIntake(spool, kv=kv, rank=0, clock=clock,
+                       membership=m0, lease_s=0.5, poll_s=0.0)
+    s0 = FleetScheduler(str(tmp_path / "ck0"), quantum=4, intake=it0)
+    it1 = StreamIntake(spool, kv=kv, rank=1, clock=clock,
+                       membership=m1, lease_s=0.5, poll_s=0.0)
+    FleetScheduler(str(tmp_path / "ck1"), quantum=4, intake=it1)
+    plan = faults.FaultPlan()
+    plan.intake_death(rank=0)
+    with plan:
+        with pytest.raises(faults.InjectedRankDeath):
+            it0.pump()
+    it1.pump()  # observes the orphaned lease (starts its aging)
+    t[0] += 0.7  # intake lease expired; rank 0 SUSPECT, not DEAD
+    m1.poll(timeout=0.05)
+    assert m1.state(0) == coord.Membership.SUSPECT
+    it1.pump()
+    assert it1.reclaimed == 0
+    t[0] += 2.0  # now DEAD
+    m1.poll(timeout=0.05)
+    assert m1.state(0) == coord.Membership.DEAD
+    it1.pump()
+    assert it1.reclaimed == 1
+    del s0
+
+
+# -- retry envelope + poison quarantine ------------------------------
+
+def test_torn_spool_record_quarantined_with_reason(tmp_path):
+    """A torn sealed frame (submitter died mid-write) retries K times
+    under jittered backoff, then moves to ``spool/quarantine/`` with
+    a structured reason record — and the stream keeps draining."""
+    env = _Env(tmp_path, retries=3)
+    it, sched = env.pairs[0]
+    plan = faults.FaultPlan()
+    plan.spool_torn_write(job="poison")
+    with plan:
+        env.submit(_row("poison"))
+    env.submit(_row("good"))
+    for _ in range(12):
+        it.pump()
+        env.tick(0.1)  # clears every backoff (cap 0.05s)
+    assert it.quarantined == 1
+    qdir = os.path.join(env.spool, "quarantine")
+    assert os.path.exists(os.path.join(qdir, "poison.json"))
+    with open(os.path.join(qdir, "poison.reason.json")) as f:
+        reason = json.load(f)
+    assert reason["name"] == "poison"
+    assert reason["attempts"] == 3
+    assert reason["error_type"] == "IntakeRetryExhausted"
+    # the stream continued behind the poison record
+    report = sched.run(max_ticks=100)
+    assert report["good"]["status"] == "done"
+    assert (telemetry.registry().counter_total(
+        "dccrg_intake_quarantined_total") == 1)
+
+
+def test_transient_io_fault_retries_then_admits(tmp_path):
+    """Injected I/O faults under the K budget back off and admit —
+    no quarantine, no duplicate."""
+    env = _Env(tmp_path, retries=4)
+    it, sched = env.pairs[0]
+    env.submit(_row("j1"))
+    plan = faults.FaultPlan()
+    plan.spool_io_error(times=2, job="j1")
+    with plan:
+        for _ in range(10):
+            it.pump()
+            env.tick(0.1)
+    assert it.quarantined == 0 and it.admitted == 1
+    assert (telemetry.registry().counter_total(
+        "dccrg_intake_retries_total") == 2)
+    assert sched.run(max_ticks=100)["j1"]["status"] == "done"
+
+
+def test_unknown_kernel_is_typed_poison(tmp_path):
+    """A spec naming an unregistered kernel quarantines immediately
+    (no retry burn) with the typed ``UnknownKernelError`` reason —
+    the satellite contract replacing the raw KeyError."""
+    env = _Env(tmp_path)
+    it, _sched = env.pairs[0]
+    env.submit(_row("bad", kernel="no-such-kernel"))
+    it.pump()
+    assert it.quarantined == 1
+    with open(os.path.join(env.spool, "quarantine",
+                           "bad.reason.json")) as f:
+        reason = json.load(f)
+    assert reason["error_type"] == "UnknownKernelError"
+    assert reason["attempts"] == 1
+    assert "no-such-kernel" in reason["error"]
+
+
+def test_malformed_row_is_typed_poison(tmp_path):
+    """A structurally hopeless row (no job name) is JobSpecError
+    poison at admission time."""
+    env = _Env(tmp_path)
+    it, _sched = env.pairs[0]
+    # bypass submit()'s own validation: land a sealed record whose
+    # payload has a job row without a name
+    payload = {"job": {"n": 8}, "tenant": "default", "nonce": "x1"}
+    sealed = coord.seal_record(json.dumps(payload, sort_keys=True))
+    with open(os.path.join(env.spool, "noname.json"), "w") as f:
+        f.write(sealed)
+    it.pump()
+    assert it.quarantined == 1
+    with open(os.path.join(env.spool, "quarantine",
+                           "noname.reason.json")) as f:
+        assert json.load(f)["error_type"] == "JobSpecError"
+
+
+def test_torn_rename_never_becomes_visible(tmp_path):
+    """The other torn half: a submitter dying between temp write and
+    rename leaves NO visible record (the atomic-rename contract) —
+    nothing admits, nothing quarantines."""
+    env = _Env(tmp_path)
+    it, _sched = env.pairs[0]
+    plan = faults.FaultPlan()
+    plan.spool_torn_rename(job="ghost")
+    with plan:
+        env.submit(_row("ghost"))
+    assert not os.path.exists(os.path.join(env.spool, "ghost.json"))
+    assert it.pump()["backlog"] == 0
+
+
+def test_delayed_directory_visibility_heals_next_scan(tmp_path):
+    """The delayed-visibility fault hides a fresh entry for one scan;
+    the next pump sees and admits it."""
+    env = _Env(tmp_path)
+    it, _sched = env.pairs[0]
+    env.submit(_row("late"))
+    plan = faults.FaultPlan()
+    plan.spool_delay(rank=0)
+    with plan:
+        assert it.pump()["admitted"] == 0
+    env.tick()
+    assert it.pump()["admitted"] == 1
+
+
+# -- backpressure gate + graceful shed -------------------------------
+
+def _gate_inputs(ratio, age=0.0, **kw):
+    d = {"ratio": ratio, "queue_age_s": age, "hi": 1.2, "lo": 0.9,
+         "age_bound_s": 30.0}
+    d.update(kw)
+    return d
+
+
+def test_gate_rule_hysteresis_band():
+    """The pure rule: closes at ratio >= hi, reopens only at
+    ratio <= lo — inside the band it holds state (no flap)."""
+    rule = RULES["intake.backpressure"]
+    assert rule(0, _gate_inputs(1.3)) == 1       # overload: close
+    assert rule(1, _gate_inputs(1.0)) is None    # in the band: hold
+    assert rule(0, _gate_inputs(1.0)) is None    # in the band: hold
+    assert rule(1, _gate_inputs(0.8)) == 0       # calm: reopen
+    assert rule(0, _gate_inputs(None, age=45.0)) == 1  # age bound
+    assert rule(1, _gate_inputs(None, age=1.0)) == 0
+    assert rule(0, _gate_inputs(0.5)) is None
+
+
+def test_gate_evaluates_once_per_window(tmp_path):
+    """<= 1 transition per EWMA window by construction: many pumps
+    inside one window evaluate the gate once."""
+    env = _Env(tmp_path, window_s=1.0, hi_ratio=1.2, lo_ratio=0.9)
+    it, _sched = env.pairs[0]
+    it.pump()  # arms the window
+    # force an overload verdict, then pump repeatedly INSIDE the
+    # window with oscillating EWMAs — the gate must not follow
+    for ratio_num in (10.0, 0.1, 10.0, 0.1):
+        it.arrival.value = ratio_num
+        it.drain.value = 1.0
+        env.tick(0.01)
+        it.pump()
+    assert it.gate_transitions <= 1
+    env.tick(1.1)  # a new window: one more evaluation allowed
+    it.arrival.value = 10.0
+    it.drain.value = 1.0
+    it.pump()
+    assert it.gate == 1 and it.gate_transitions == 1
+    # calm EWMAs + a new window reopen it: exactly 2 transitions
+    env.tick(1.1)
+    it.arrival.value = 0.1
+    it.drain.value = 1.0
+    it.pump()
+    assert it.gate == 0 and it.gate_transitions == 2
+
+
+def test_closed_gate_pauses_admission_until_reopen(tmp_path):
+    """A closed gate admits nothing (the spool is the durable
+    buffer); reopening drains the backlog in arrival order."""
+    env = _Env(tmp_path, window_s=0.5)
+    it, sched = env.pairs[0]
+    it.pump()
+    it.arrival.value = 10.0
+    it.drain.value = 1.0
+    env.tick(0.6)
+    it.pump()
+    assert it.gate == 1
+    env.submit(_row("j1"))
+    env.submit(_row("j2"))
+    env.tick(0.01)
+    assert it.pump()["admitted"] == 0
+    assert it.backlog() == 2
+    it.arrival.value = 0.1
+    env.tick(0.6)
+    stats = it.pump()
+    assert it.gate == 0 and stats["admitted"] == 2
+    report = sched.run(max_ticks=200)
+    assert {n: r["status"] for n, r in report.items()} == {
+        "j1": "done", "j2": "done"}
+
+
+def test_saturation_shed_is_journaled_and_resubmittable(tmp_path):
+    """Under saturation (backlog / drain > age bound) the newest
+    records of the most-backlogged tenant move to ``spool/shed/`` as
+    a journaled autopilot decision; shed files re-submit cleanly."""
+    ap = Autopilot(quantum=4, clock=lambda: 0.0)
+    env = _Env(tmp_path, window_s=0.5, age_bound_s=2.0)
+    it, _sched = env.pairs[0]
+    it.autopilot = ap
+    it.pump()
+    it.arrival.value = 10.0
+    it.drain.value = 1.0
+    env.tick(0.6)
+    it.pump()  # closes the gate; nothing waiting yet
+    assert it.gate == 1
+    for i in range(6):
+        env.submit(_row(f"big{i}"), tenant="whale")
+    env.submit(_row("small0"), tenant="minnow")
+    it.arrival.value = 10.0
+    it.drain.value = 1.0  # 7 waiting / 1 per s >> 2 s bound
+    env.tick(0.6)
+    it.pump()  # still saturated: the journaled shed fires
+    assert it.gate == 1 and it.shed > 0
+    sdir = os.path.join(env.spool, "shed")
+    shed_files = sorted(os.listdir(sdir))
+    assert shed_files and all(f.startswith("big") for f in shed_files)
+    # minnow's record survived the whale's shed
+    assert os.path.exists(os.path.join(env.spool, "small0.json"))
+    recs = [r for r in ap.decisions if r["rule"] == "intake.shed"]
+    assert len(recs) == 1
+    assert recs[0]["inputs"]["tenant"] == "whale"
+    assert recs[0]["inputs"]["names"] == sorted(
+        f[:-5] for f in shed_files)
+    assert replay(list(ap.decisions)) == []
+    # shed is graceful: the file is intact and re-submittable
+    with open(os.path.join(sdir, shed_files[0])) as f:
+        raw = f.read()
+    payload = json.loads(coord.unseal_record(raw))
+    assert payload["job"]["name"] == shed_files[0][:-5]
+
+
+# -- tenant shaping ---------------------------------------------------
+
+def test_token_bucket_caps_tenant_rate(tmp_path):
+    """A rate-limited tenant admits its burst, then one token per
+    1/rate seconds — the rest wait in the spool."""
+    env = _Env(tmp_path, rates={"*": 1.0}, burst=2.0)
+    it, _sched = env.pairs[0]
+    for i in range(5):
+        env.submit(_row(f"j{i}"))
+    assert it.pump()["admitted"] == 2  # the burst
+    env.tick(0.2)
+    assert it.pump()["admitted"] == 0  # no token yet
+    env.tick(1.0)
+    assert it.pump()["admitted"] == 1  # one token refilled
+    assert (telemetry.registry().counter_total(
+        "dccrg_intake_throttled_total") > 0)
+
+
+def test_weighted_fairness_orders_tenants(tmp_path):
+    """Virtual-time fairness: weight 3 vs 1 admits ~3:1 when both
+    tenants have deep backlogs."""
+    env = _Env(tmp_path, weights={"gold": 3.0, "*": 1.0},
+               max_admit=8)
+    it, sched = env.pairs[0]
+    for i in range(8):
+        env.submit(_row(f"g{i}"), tenant="gold")
+        env.submit(_row(f"b{i}"), tenant="bronze")
+    it.pump()
+    admitted = set(sched._by_name)
+    gold = sum(1 for n in admitted if n.startswith("g"))
+    bronze = sum(1 for n in admitted if n.startswith("b"))
+    assert gold + bronze == 8
+    assert gold == 6 and bronze == 2  # 3:1 by virtual time
+
+
+# -- control-plane + telemetry ---------------------------------------
+
+def test_decisions_replay_divergence_free_end_to_end(tmp_path):
+    """Gate flips, a quarantine and a shed all journal through the
+    autopilot decision file, and ``replay`` re-derives every one from
+    its recorded inputs alone."""
+    journal = tmp_path / "decisions.jsonl"
+    ap = Autopilot(quantum=4, clock=lambda: 0.0,
+                   decision_file=str(journal))
+    env = _Env(tmp_path, window_s=0.5, age_bound_s=2.0)
+    it, _sched = env.pairs[0]
+    it.autopilot = ap
+    env.submit(_row("bad", kernel="no-such-kernel"))
+    for i in range(5):
+        env.submit(_row(f"j{i}"))
+    it.pump()  # quarantines "bad", admits the rest
+    it.arrival.value = 10.0
+    it.drain.value = 0.5
+    for i in range(5, 11):
+        env.submit(_row(f"j{i}"))
+    env.tick(0.6)
+    it.pump()  # closes the gate, sheds under saturation
+    it.arrival.value = 0.0
+    env.tick(0.6)
+    it.pump()  # reopens
+    rules = [r["rule"] for r in ap.decisions]
+    assert "intake.quarantine" in rules
+    assert "intake.shed" in rules
+    assert rules.count("intake.backpressure") == 2  # close + reopen
+    assert replay(read_journal(str(journal))) == []
+
+
+def test_queue_age_histogram_and_lag_gauge(tmp_path):
+    """Telemetry grows per-tenant queue-age observations and the
+    intake-lag gauge tracks the backlog."""
+    env = _Env(tmp_path)
+    it, _sched = env.pairs[0]
+    env.submit(_row("j1"), tenant="acme")
+    it.pump()
+    env.tick(0.5)
+    env.submit(_row("j2"), tenant="acme")
+    it.pump()
+    reg = telemetry.registry()
+    h = reg.histogram_total("dccrg_intake_queue_age_seconds",
+                            tenant="acme")
+    assert h is not None and h.total == 2
+    assert reg.counter_total("dccrg_intake_admitted_total",
+                             tenant="acme") == 2
+
+
+# -- wiring + negative pins ------------------------------------------
+
+def test_scheduler_without_intake_is_unchanged(tmp_path):
+    """The negative pin: no env knob, no injected intake — the
+    scheduler has no front door and a plain run is untouched."""
+    jobs = [FleetJob("a", length=(8, 8, 8), n_steps=4,
+                     checkpoint_every=4)]
+    sched = FleetScheduler(str(tmp_path / "ck"), jobs, quantum=4)
+    assert sched.intake is None
+    assert sched.run(max_ticks=100)["a"]["status"] == "done"
+
+
+def test_env_construction_and_missing_spool(tmp_path, monkeypatch):
+    """``DCCRG_INTAKE=1`` builds an intake over
+    ``DCCRG_INTAKE_SPOOL``; forgetting the spool is a typed error."""
+    monkeypatch.setenv("DCCRG_INTAKE", "1")
+    with pytest.raises(IntakeError):
+        FleetScheduler(str(tmp_path / "ck0"), quantum=4)
+    spool = str(tmp_path / "spool")
+    monkeypatch.setenv("DCCRG_INTAKE_SPOOL", spool)
+    sched = FleetScheduler(str(tmp_path / "ck1"), quantum=4)
+    assert isinstance(sched.intake, StreamIntake)
+    assert sched.intake.spool == spool
+    assert sched.intake.sched is sched
+
+
+def test_run_loop_pumps_arrivals_to_completion(tmp_path):
+    """Jobs landing in the spool BEFORE serving starts drain through
+    ``run`` with no manual pumping (the run-loop integration)."""
+    env = _Env(tmp_path)
+    it, sched = env.pairs[0]
+    env.submit(_row("j1"))
+    env.submit(_row("j2"))
+    report = sched.run(max_ticks=300)
+    assert {n: r["status"] for n, r in report.items()} == {
+        "j1": "done", "j2": "done"}
+    env.tick()
+    it.pump()
+    assert it.idle()
+
+
+# -- fleet satellite: job_from_row typed validation ------------------
+
+def test_job_from_row_builds_and_validates():
+    job = job_from_row({"name": "x", "n": 8, "steps": 3,
+                        "kernel": "diffuse"})
+    assert job.name == "x" and job.n_steps == 3
+    assert job.length == (8, 8, 8)
+
+
+def test_job_from_row_typed_errors():
+    with pytest.raises(JobSpecError):
+        job_from_row("not a dict")
+    with pytest.raises(JobSpecError):
+        job_from_row({"n": 8})  # no name
+    with pytest.raises(JobSpecError):
+        job_from_row({"name": "x", "length": "wat"})
+    # unknown kernel: lazy by default, typed at validate time
+    job = job_from_row({"name": "x", "n": 8, "kernel": "nope"})
+    with pytest.raises(UnknownKernelError) as ei:
+        job.resolved_kernel()
+    assert "nope" in str(ei.value)
+    assert isinstance(ei.value, KeyError)  # backcompat
+    with pytest.raises(UnknownKernelError):
+        job_from_row({"name": "x", "n": 8, "kernel": "nope"},
+                     validate_kernel=True)
+
+
+def test_submit_rejects_unsafe_rows(tmp_path):
+    with pytest.raises(JobSpecError):
+        submit(str(tmp_path / "s"), {"n": 8})  # no name
+    with pytest.raises(JobSpecError):
+        submit(str(tmp_path / "s"), {"name": "../escape"})

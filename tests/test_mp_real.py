@@ -21,7 +21,8 @@ SCENARIOS = ("save_restore", "psum", "barrier_timeout", "rank_kill",
              "consensus", "sdc_rank", "preempt", "delta_rank_kill",
              "trace_merge", "host_death", "zombie_fence",
              "host_rejoin", "amr_commit", "amr_rank_kill",
-             "amr_zombie", "async_save", "async_save_kill")
+             "amr_zombie", "async_save", "async_save_kill",
+             "intake_kill")
 
 
 def _run(scenario, seed=0, timeout=300):
