@@ -16,7 +16,10 @@ nulls, notes) is skipped. The streaming-intake saturation keys from
 ``bench/intake_bench.py`` ride these patterns unchanged:
 ``intake_drain_per_sec`` (higher) and
 ``intake_p99_queue_age_seconds`` (lower); a failed intake round
-emits them as null, which load_rounds drops.
+emits them as null, which load_rounds drops. So do the warm-start
+keys from ``bench/warmstart_bench.py``:
+``cold_first_dispatch_seconds`` / ``warm_first_dispatch_seconds``
+(lower) and ``warm_speedup_vs_baseline`` (higher).
 
 Usage: python bench/trend.py [BENCH_r*.json ...] [--threshold F]
        [--json] [--strict]

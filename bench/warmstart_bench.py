@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Warm-start bench: cold vs warm first-dispatch latency over the
+persistent compile cache (``dccrg_tpu/warmstart.py``).
+
+Two child processes (fresh interpreters — the in-process program
+cache and jax's in-memory executable cache would otherwise pollute
+the warm measurement) share one ``DCCRG_COMPILE_CACHE`` dir:
+
+- ``cold`` — empty cache: every bucket's first dispatch pays the
+  trace+compile; the manifest records land.
+- ``warm`` — the restart: the pool pre-compiles every manifested
+  bucket off the serve clock, the first dispatch must pay none of it.
+
+Reported (the trend.py keys):
+
+- ``cold_first_dispatch_seconds`` / ``warm_first_dispatch_seconds``
+  — the WORST per-bucket first-dispatch latency each side (lower is
+  better; the ``seconds`` the scheduler's first-dispatch hook
+  measures, i.e. what a rejoining host's first job actually waits),
+- ``warm_speedup_vs_baseline`` — cold/warm (higher is better; the
+  ISSUE bound is >=10x, asserted by tests/mp_harness.py rejoin_warm,
+  merely reported here),
+- ``compiles_avoided`` — programs the warm side served from the pool
+  instead of compiling.
+
+JSON rows go to stdout like the other bench emitters; on any failure
+the summary still prints with null metric values so ``bench/trend.py``
+skips (rather than crashes on) the round.
+
+Run:  timeout -k 10 600 python bench/warmstart_bench.py [--buckets 3]
+      [--steps 16]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def child(args) -> int:
+    """One serve leg (fresh interpreter): build the job set, serve it
+    through FleetScheduler with the warm pool on, print a JSON row
+    with the worst first-dispatch latency."""
+    os.environ["DCCRG_COMPILE_CACHE"] = args.cache
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from dccrg_tpu.fleet import FleetJob
+    from dccrg_tpu.scheduler import FleetScheduler
+
+    lengths = [(8, 8, 8 + 2 * i) for i in range(args.buckets)]
+    jobs = [FleetJob(f"b{i}", length=ln, n_steps=args.steps,
+                     params=(0.05,), seed=args.seed + i,
+                     checkpoint_every=0)
+            for i, ln in enumerate(lengths)]
+    sched = FleetScheduler(args.store, jobs)
+    pool = sched.warm
+    assert pool is not None, "no warm pool (DCCRG_COMPILE_CACHE set?)"
+    if args.phase == "warm" and pool._worker is not None:
+        # the pre-compile sweep runs off the serve clock
+        t0 = time.perf_counter()
+        assert pool._worker.wait(300)
+        assert pool._worker.error is None, pool._worker.error
+        prewarm_s = time.perf_counter() - t0
+    else:
+        prewarm_s = 0.0
+    firsts = {}
+    orig = pool.note_dispatch
+
+    def spy(batch, seconds):
+        firsts.setdefault(batch.key, float(seconds))
+        return orig(batch, seconds)
+
+    pool.note_dispatch = spy
+    t0 = time.perf_counter()
+    report = sched.run()
+    wall = time.perf_counter() - t0
+    assert {r["status"] for r in report.values()} == {"done"}, report
+    print(json.dumps({
+        "phase": args.phase,
+        "first_dispatch_s": round(max(firsts.values()), 6),
+        "served_warm": len(pool._served),
+        "prewarm_s": round(prewarm_s, 4),
+        "wall_s": round(wall, 4),
+        "digests": {n: r["digest"] for n, r in report.items()},
+    }), flush=True)
+    return 0
+
+
+def _spawn_child(args, phase, cache, store):
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--phase", phase, "--cache", cache, "--store", store,
+           "--buckets", str(args.buckets), "--steps", str(args.steps),
+           "--seed", str(args.seed)]
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=540)
+    if out.returncode != 0:
+        raise RuntimeError(f"{phase} child rc {out.returncode}:\n"
+                           f"{out.stdout[-2000:]}\n{out.stderr[-2000:]}")
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def run_bench(args) -> int:
+    tmp = tempfile.mkdtemp(prefix="warmstart_bench_")
+    try:
+        cache = str(Path(tmp) / "cache")
+        cold = _spawn_child(args, "cold", cache,
+                            str(Path(tmp) / "ck_cold"))
+        warm = _spawn_child(args, "warm", cache,
+                            str(Path(tmp) / "ck_warm"))
+        c, w = cold["first_dispatch_s"], warm["first_dispatch_s"]
+        ok = (warm["served_warm"] >= args.buckets
+              and warm["digests"] == cold["digests"] and w < c)
+        summary = {
+            "cold_first_dispatch_seconds": c if ok else None,
+            "warm_first_dispatch_seconds": w if ok else None,
+            "warm_speedup_vs_baseline": (
+                round(c / max(w, 1e-9), 2) if ok else None),
+            "compiles_avoided": warm["served_warm"],
+            "prewarm_s": warm["prewarm_s"],
+            "ok": ok,
+            "note": ("%d buckets; warm served from the pool, digests "
+                     "bitwise-equal cold" % args.buckets if ok
+                     else "warm leg not warm / digest mismatch"),
+        }
+    except Exception as e:  # null metrics: trend.py skips, not crashes
+        summary = {"cold_first_dispatch_seconds": None,
+                   "warm_first_dispatch_seconds": None,
+                   "warm_speedup_vs_baseline": None,
+                   "ok": False, "error": f"{type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps({"summary": summary}), flush=True)
+    return 0 if summary.get("ok") else 1
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--phase", default="cold",
+                    choices=("cold", "warm"))
+    ap.add_argument("--cache", default="")
+    ap.add_argument("--store", default="")
+    ap.add_argument("--buckets", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.child:
+        return child(args)
+
+    from dccrg_tpu.resilience import safe_devices
+    if safe_devices(timeout=120, retries=1, platform="cpu") is None:
+        print(json.dumps({"summary": {
+            "cold_first_dispatch_seconds": None,
+            "warm_first_dispatch_seconds": None,
+            "warm_speedup_vs_baseline": None,
+            "ok": False, "error": "device probe failed"}}))
+        return 1
+    return run_bench(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,7 @@ from . import telemetry
 from .telemetry import LogHistogram
 from . import autopilot
 from .autopilot import Autopilot
+from .warmstart import WarmCacheError, WarmPool
 
 __version__ = "0.1.0"
 
@@ -155,4 +156,6 @@ __all__ = [
     "telemetry",
     "autopilot",
     "Autopilot",
+    "WarmCacheError",
+    "WarmPool",
 ]

@@ -395,6 +395,32 @@ def _rule_fleet_reclaim(before, inp):
     return int(before) + n
 
 
+def _rule_warm_cache(before, inp):
+    """Narrate one warm-start cache decision: a bucket program was
+    served ``warm`` (pre-compiled ahead of the dispatch), compiled
+    ``cold`` (first dispatch carried the compile), ``reject``-ed (a
+    persisted artifact could not be trusted — epoch drift, registry
+    drift, I/O failure — and fell cold) or ``quarantine``-d (a torn
+    or corrupt manifest record moved aside). The 'knob' is the
+    cumulative decision count — the record exists so ``explain``
+    reconstructs every warm claim and every degradation from the
+    journal alone."""
+    if inp.get("decision") not in ("warm", "cold", "reject",
+                                  "quarantine"):
+        return None
+    return int(before) + 1
+
+
+def _rule_warm_gc(before, inp):
+    """Narrate an applied warm-cache retention GC: ``n`` files
+    pruned (least-recently-hit first) under the configured size/age
+    bounds. The 'knob' is the cumulative pruned count."""
+    n = int(inp.get("n", 0))
+    if n <= 0:
+        return None
+    return int(before) + n
+
+
 #: rule name -> pure derivation. `replay` and the live controller
 #: share these by construction — one source of truth.
 RULES = {
@@ -414,6 +440,8 @@ RULES = {
     "intake.backpressure": _rule_intake_gate,
     "intake.shed": _rule_intake_shed,
     "intake.quarantine": _rule_intake_quarantine,
+    "warmstart.cache": _rule_warm_cache,
+    "warmstart.gc": _rule_warm_gc,
 }
 
 #: the "expected effect" text journaled with each rule's decisions
@@ -466,6 +494,15 @@ EXPECTED = {
                           "spool/quarantine/ with a structured "
                           "reason so the stream keeps draining "
                           "behind it"),
+    "warmstart.cache": ("persistent compile cache decision: warm "
+                        "serves skip the compile storm, cold/reject/"
+                        "quarantine degradations never trust a "
+                        "drifted or damaged artifact — no wrong "
+                        "program, no silent warm claim"),
+    "warmstart.gc": ("size/age-bounded cache retention: prune "
+                     "least-recently-hit entries so the cache dir "
+                     "stays bounded without touching keys being "
+                     "pre-warmed"),
 }
 
 
@@ -582,6 +619,10 @@ class Autopilot:
         self.intake_gate = 0
         self.intake_sheds = 0
         self.intake_quarantines = 0
+        #: warm-start narration state: cumulative cache decisions
+        #: (warm/cold/reject/quarantine) and cumulative GC prunes
+        self.warm_events = 0
+        self.warm_gcs = 0
         # journal-driven cross-run warm start of the QUANTUM knob
         # (the capacity.learn/probe discipline): load_history recovers
         # the last run's journaled quantum.learn, the first tick
@@ -945,6 +986,27 @@ class Autopilot:
             int(self.intake_quarantines),
             dict(reason, name=str(name)))
         self.intake_quarantines = int(after)
+
+    # -- warm-start decisions (dccrg_tpu/warmstart.py) -----------------
+
+    def record_warm(self, decision, kid, inputs: dict) -> None:
+        """A warm-start cache decision happened (``warm``/``cold``/
+        ``reject``/``quarantine``): journal it through the
+        ``warmstart.cache`` rule so ``explain`` narrates every warm
+        claim and every degradation-to-cold with its inputs."""
+        after = self._apply(
+            "warmstart.cache", "warm_events", int(self.warm_events),
+            dict(inputs, decision=str(decision), key=str(kid)))
+        self.warm_events = int(after)
+
+    def record_warm_gc(self, pruned, inputs: dict) -> None:
+        """An applied warm-cache retention GC pruned ``pruned``
+        files: journal it through the ``warmstart.gc`` rule."""
+        pruned = sorted(str(p) for p in pruned)
+        after = self._apply(
+            "warmstart.gc", "warm_gcs", int(self.warm_gcs),
+            dict(inputs, n=len(pruned), pruned=pruned))
+        self.warm_gcs = int(after)
 
     def _tune_checkpoints(self, sched, inp) -> None:
         lo, hi = self.bounds["checkpoint_every"]

@@ -335,3 +335,61 @@ class AsyncSaver:
             for cb in box["on_fail"]:
                 cb(err)
             raise err
+
+
+# ---------------------------------------------------------------------
+# warm-start pre-compiles
+# ---------------------------------------------------------------------
+
+class PrewarmWorker:
+    """One abortable background pre-compile sweep (the warm-start
+    pool's thread; see dccrg_tpu/warmstart.py).
+
+    Same discipline as :class:`PlanBuildWorker`: a daemon thread whose
+    failure is captured, never raised into the serving path, and whose
+    work is bitwise-neutral to live dispatches — ``fn(abort)`` must
+    only *compile* (``jit.lower(...).compile()`` traces and compiles
+    without allocating state buffers or dispatching device work, so it
+    never contends with the main thread's ``block_until_ready`` — the
+    deadlock class the PR-13 writer-thread rule exists for). ``fn``
+    checks ``abort`` between items; :meth:`stop` sets it and joins, so
+    a scheduler teardown (or a GC pass that must not race an in-flight
+    compile) has a bounded wait."""
+
+    def __init__(self, fn, name: str = "dccrg-warm-prewarm"):
+        self.fn = fn
+        self.error = None
+        self.done = threading.Event()
+        self.abort = threading.Event()
+        self.thread = threading.Thread(target=self._work, name=name,
+                                       daemon=True)
+
+    def start(self) -> "PrewarmWorker":
+        self.thread.start()
+        return self
+
+    def _work(self) -> None:
+        t0 = time.perf_counter()
+        try:
+            self.fn(self.abort)
+        except BaseException as e:  # noqa: BLE001 - surfaced via .error
+            self.error = e
+            telemetry.inc("dccrg_prewarm_errors_total")
+        finally:
+            telemetry.observe("dccrg_prewarm_seconds",
+                              time.perf_counter() - t0)
+            self.done.set()
+
+    def ready(self) -> bool:
+        return self.done.is_set()
+
+    def wait(self, timeout=None) -> bool:
+        self.done.wait(timeout)
+        return self.done.is_set()
+
+    def stop(self, timeout=5.0) -> bool:
+        """Abort and join (bounded). Returns whether the thread
+        actually finished — a straggler mid-XLA-compile is left to
+        die with the process (daemon), never blocked on forever."""
+        self.abort.set()
+        return self.wait(timeout)

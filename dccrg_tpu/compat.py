@@ -40,6 +40,42 @@ def pallas_compiler_params(**kw):
     return cls(**kw)
 
 
+def enable_persistent_cache(directory: str) -> bool:
+    """Point jax's persistent compilation cache at ``directory``,
+    across the API drift between releases: the config keys
+    (``jax_compilation_cache_dir`` plus the min-compile-time /
+    min-entry-size gates that default CPU programs OUT of the cache)
+    on newer jax, ``compilation_cache.set_cache_dir`` on older ones.
+    Idempotent; returns False when no spelling is accepted (the
+    caller degrades to cold compiles — never an error)."""
+    import jax
+
+    ok = False
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(directory))
+        ok = True
+    except Exception:  # noqa: BLE001 - drift probe, fallback below
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache)
+
+            compilation_cache.set_cache_dir(str(directory))
+            ok = True
+        except Exception:  # noqa: BLE001
+            return False
+    # CPU programs compile in milliseconds and serialize small: both
+    # default gates would silently keep them out of the cache
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs",
+                       0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes",
+                       -1)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # noqa: BLE001 - older jax: gate absent
+            pass
+    return ok
+
+
 def pallas_interpret_mode(interpret: bool):
     """The value ``pl.pallas_call(..., interpret=...)`` wants for TPU
     interpret mode: newer jax models it as ``pltpu.InterpretParams()``;

@@ -632,6 +632,44 @@ def unseal_record(record: str, key: str = "") -> str:
     return data
 
 
+def atomic_file_write(path: str, data: str, *, tmp_dir=None) -> str:
+    """Durably land a small file: write to a temp sibling (or
+    ``tmp_dir``), fsync, then atomically ``os.replace`` onto ``path``
+    — the intake-spool discipline, shared by every small on-disk
+    record in the package (a crashed writer leaves either the old
+    complete file or an invisible temp, never a torn visible one).
+    The temp name carries the writer's pid so crash litter is
+    attributable (swept by the stale-temp GC patterns)."""
+    d = tmp_dir if tmp_dir is not None else (os.path.dirname(path)
+                                             or ".")
+    tmp = os.path.join(
+        str(d), f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def write_sealed_file(path: str, payload: str, *, tmp_dir=None) -> str:
+    """:func:`seal_record` + :func:`atomic_file_write`: a CRC-framed
+    durable small-file record any reader can convict instead of
+    trusting (the warm-start manifest entries ride this)."""
+    return atomic_file_write(path, seal_record(payload),
+                             tmp_dir=tmp_dir)
+
+
+def read_sealed_file(path: str, key: str = "") -> str:
+    """Read and verify a :func:`write_sealed_file` record; raises
+    :class:`TornRecordError` (naming ``key``, default the path) on a
+    damaged frame. OSErrors propagate — absent and unreadable are the
+    caller's distinction to make."""
+    with open(path) as f:
+        raw = f.read()
+    return unseal_record(raw, key or str(path))
+
+
 def kv_barrier(kv, tag: str, rank: int, ranks, timeout=None, *,
                value: str = "1", poll_s: float = 0.02, fence=None,
                abort_key=None, membership=None) -> dict:

@@ -151,7 +151,7 @@ class _Rule:
         if self.site != site or self.fired >= self.times:
             return False
         for key in ("mode", "step", "phase", "tag", "rank", "job",
-                    "tick"):
+                    "tick", "key", "op"):
             want = self.params.get(key)
             if want is None:
                 continue
@@ -216,6 +216,22 @@ INTAKE_FAULT_SITES = (
     ("intake.spool.scan", None),
     ("intake.spool.read", None),
     ("intake.claim", None),
+)
+
+# warm-start cache fault sites (dccrg_tpu/warmstart.py): the persisted
+# compile-cache manifest's torn/corrupt/stale-version write faults,
+# cache-dir I/O errors and a rank death mid-prewarm. Each must degrade
+# to a COLD compile with a typed error + quarantined entry — never a
+# wrong program. Fire only when a WarmPool drives a cache, so — like
+# DIST_AMR_FAULT_SITES / INTAKE_FAULT_SITES — they are deliberately
+# NOT in MUTATION_FAULT_SITES (the single-grid fuzzer would wait
+# forever for them).
+WARMSTART_FAULT_SITES = (
+    ("warm.manifest.write.torn", None),
+    ("warm.manifest.write.corrupt", None),
+    ("warm.manifest.write.stale", None),
+    ("warm.cache.io", None),
+    ("warm.prewarm", None),
 )
 
 _active: "FaultPlan | None" = None
@@ -524,6 +540,54 @@ class FaultPlan:
         return self._add("intake.claim", "rank_death", times,
                          rank=rank, job=job)
 
+    # -- warm-start cache faults (dccrg_tpu/warmstart.py) -------------
+
+    def warm_torn_manifest(self, times=1, key=None):
+        """A manifest writer dies mid-write: the per-key record LANDS
+        at its final name with a truncated sealed frame. Queried — not
+        raised — through :func:`take_warm_torn` by the warmstart
+        manifest writer, so the torn bytes are durable and every
+        loader's CRC conviction (:class:`~dccrg_tpu.coord
+        .TornRecordError` -> typed ``WarmCacheError``, entry
+        quarantined, cold compile) is what gets exercised."""
+        return self._add("warm.manifest.write.torn", "torn", times,
+                         key=key)
+
+    def warm_corrupt_entry(self, times=1, key=None):
+        """Silent corruption of a landed manifest entry's payload
+        bytes (one flipped byte INSIDE the sealed frame — the CRC
+        still reads as a frame, the payload no longer matches it).
+        Queried through :func:`take_warm_corrupt` by the writer; the
+        loader must convict, quarantine and fall cold."""
+        return self._add("warm.manifest.write.corrupt", "corrupt",
+                         times, key=key)
+
+    def warm_stale_epoch(self, times=1, key=None):
+        """A manifest entry lands stamped with a DIFFERENT cache
+        epoch (the record of a run on older jax/jaxlib/package
+        versions). Queried through :func:`take_warm_stale` by the
+        writer; the loader must REJECT it to cold compile — a drifted
+        cache is never trusted."""
+        return self._add("warm.manifest.write.stale", "stale", times,
+                         key=key)
+
+    def warm_io_error(self, times=1, op=None):
+        """Transient I/O error at a warm-cache dir operation (site
+        ``warm.cache.io``; ``op`` narrows to ``read``/``write``/
+        ``scan``/``gc``). The pool must degrade that one entry (or
+        pass) to cold compile and keep serving — telemetry-discipline
+        best-effort, never a crash."""
+        return self._add("warm.cache.io", "io", times, op=op)
+
+    def warm_prewarm_death(self, times=1, rank=None):
+        """This rank dies mid-prewarm (site ``warm.prewarm``, raised
+        as :class:`InjectedRankDeath` between two background
+        pre-compiles): the manifest and cache dir must stay
+        loadable — the next boot simply re-warms — and an in-process
+        caller sees the typed death, not a wedged pool."""
+        return self._add("warm.prewarm", "rank_death", times,
+                         rank=rank)
+
     # -- installation -------------------------------------------------
 
     def __enter__(self):
@@ -677,6 +741,42 @@ def take_spool_delay(rank=None) -> bool:
         return False
     plan.log.append(("intake.spool.scan", "delay", dict(ctx)))
     return True
+
+
+def _take_query(site: str, kind: str, ctx: dict) -> bool:
+    """Shared body of the queried (not raised) fault consumers."""
+    plan = _active
+    if plan is None:
+        return False
+    rule = plan._take(site, ctx)
+    if rule is None:
+        return False
+    plan.log.append((site, kind, dict(ctx)))
+    return True
+
+
+def take_warm_torn(key=None) -> bool:
+    """Consume a scheduled :meth:`~FaultPlan.warm_torn_manifest` for
+    this manifest write; True when one fired (the writer then lands a
+    truncated sealed frame at the final record name)."""
+    return _take_query("warm.manifest.write.torn", "torn",
+                       {"key": key})
+
+
+def take_warm_corrupt(key=None) -> bool:
+    """Consume a scheduled :meth:`~FaultPlan.warm_corrupt_entry`;
+    True when one fired (the writer then lands a payload-corrupted
+    sealed frame — the loader's CRC conviction is exercised)."""
+    return _take_query("warm.manifest.write.corrupt", "corrupt",
+                       {"key": key})
+
+
+def take_warm_stale(key=None) -> bool:
+    """Consume a scheduled :meth:`~FaultPlan.warm_stale_epoch`; True
+    when one fired (the writer then stamps a drifted cache epoch —
+    the loader's version-rejection is exercised)."""
+    return _take_query("warm.manifest.write.stale", "stale",
+                       {"key": key})
 
 
 def take_host_death(rank: int, tick: int) -> bool:

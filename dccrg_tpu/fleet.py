@@ -57,7 +57,7 @@ import jax
 import jax.numpy as jnp
 
 from . import checkpoint as checkpoint_mod
-from . import faults, integrity
+from . import faults, integrity, warmstart
 from .grid import DEFAULT_NEIGHBORHOOD_ID, Grid, default_mesh
 
 logger = logging.getLogger("dccrg_tpu.fleet")
@@ -439,7 +439,8 @@ class GridBatch:
     which is how jobs at different step counts, finished jobs and
     tripped/masked slots coexist in one program."""
 
-    def __init__(self, proto: FleetJob, capacity: int, device=None):
+    def __init__(self, proto: FleetJob, capacity: int, device=None,
+                 skeleton=False):
         self.key = proto.bucket_key()
         self.capacity = int(capacity)
         self.device = device
@@ -488,16 +489,22 @@ class GridBatch:
         self._extras = np.zeros((self.capacity, self.n_extra),
                                 dtype=np.float32)
         self.state = {}
-        for name, (shape, dtype) in self.schema.items():
-            z = jnp.zeros((self.capacity, self.R) + shape, dtype=dtype)
-            if device is not None:
-                z = jax.device_put(z, device)
-            self.state[name] = z
+        # a skeleton batch carries only the program-construction
+        # inputs (plan tables, schema, kernel) — no [capacity, R, ...]
+        # state allocation. The warm-start pool builds one per
+        # manifested key to pre-compile programs without touching HBM.
+        if not skeleton:
+            for name, (shape, dtype) in self.schema.items():
+                z = jnp.zeros((self.capacity, self.R) + shape,
+                              dtype=dtype)
+                if device is not None:
+                    z = jax.device_put(z, device)
+                self.state[name] = z
         self.dispatches = 0
 
     # -- program construction (shared per bucket key) -----------------
 
-    def _programs(self):
+    def _program_key(self):
         # the integrity flag is part of the cache key: with
         # DCCRG_INTEGRITY=0 the quantum program is BIT-IDENTICAL to
         # the pre-SDC one (no fingerprint ops, no extra outputs) —
@@ -512,10 +519,29 @@ class GridBatch:
 
         want_bulk = (roll_executor.bulk_mode() == "pallas"
                      and self.bulk_kernel is not None)
-        key = (self.key, self.capacity, int_on, want_bulk)
+        return (self.key, self.capacity, int_on, want_bulk)
+
+    def _programs(self):
+        key = self._program_key()
         hit = _FLEET_PROGRAMS.get(key)
         if hit is not None:
             return hit
+        # a pre-compiled program from the warm-start pool is the
+        # exact tuple _build_programs would produce, with the trace +
+        # compile already paid on the background thread (None when no
+        # DCCRG_COMPILE_CACHE pool is active — the negative pin)
+        hit = warmstart.take_prewarmed(key, device=self.device)
+        if hit is None:
+            hit = self._build_programs(key)
+        if len(_FLEET_PROGRAMS) >= _FLEET_PROGRAMS_MAX:
+            _FLEET_PROGRAMS.pop(next(iter(_FLEET_PROGRAMS)))
+        _FLEET_PROGRAMS[key] = hit
+        return hit
+
+    def _build_programs(self, key):
+        int_on, want_bulk = key[2], key[3]
+        from .ops import roll_executor
+
         bulk_step = None
         if want_bulk:
             bulk_step = roll_executor.make_fleet_bulk_step(
@@ -604,12 +630,8 @@ class GridBatch:
         # audit must know whether this program's arithmetic is the
         # table kernel's (bitwise-comparable to Grid.run_steps) or the
         # bulk twin's (matches only to float re-association)
-        hit = (jax.jit(run_quantum), jax.jit(finite), fp_now,
-               bulk_step is not None)
-        if len(_FLEET_PROGRAMS) >= _FLEET_PROGRAMS_MAX:
-            _FLEET_PROGRAMS.pop(next(iter(_FLEET_PROGRAMS)))
-        _FLEET_PROGRAMS[key] = hit
-        return hit
+        return (jax.jit(run_quantum), jax.jit(finite), fp_now,
+                bulk_step is not None)
 
     # -- slot management ----------------------------------------------
 

@@ -644,6 +644,11 @@ class StreamIntake:
             pass
         else:
             self.sched.add(job)
+        warm = getattr(self.sched, "warm", None)
+        if warm is not None:
+            # the stream knows what is about to dispatch: bump this
+            # bucket key to the front of the prewarm queue
+            warm.note_incoming(job.bucket_key())
         self._meta[name] = {"tenant": tenant}
         self._retry.pop(name, None)
         age = now - self._seen.get(name, now)

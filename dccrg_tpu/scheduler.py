@@ -93,7 +93,8 @@ from contextlib import nullcontext
 import numpy as np
 
 from . import autopilot as autopilot_mod
-from . import coord, faults, integrity, resilience, supervise, telemetry
+from . import (coord, faults, integrity, resilience, supervise,
+               telemetry, warmstart)
 from .fleet import (SHADOW, FleetJob, GridBatch, max_batch_default,
                     quantum_default)
 from .grid import bucket_capacity
@@ -389,6 +390,11 @@ class SLOPolicy:
         #: fresh, smaller bucket must re-measure before re-shedding)
         self.shed_cooldown = int(shed_cooldown)
         self._ewma: dict = {}  # bucket key -> EWMA quantum seconds
+        #: warm-start hook (``WarmPool.projection_cost``): extra
+        #: up-front seconds to charge a bucket key whose first
+        #: dispatch will pay a cold compile — 0.0 once pre-warmed.
+        #: None (the default) leaves every projection untouched.
+        self.warm_cost = None
 
     def observe(self, key, seconds: float) -> None:
         """Fold one measured quantum dispatch latency into the
@@ -411,13 +417,19 @@ class SLOPolicy:
     def projected_completion_s(self, job) -> float:
         """Projected seconds to finish ``job``: remaining quanta x
         the EWMA latency of its bucket key (0 when unmeasured — no
-        data never reorders the queue)."""
-        lat = self._ewma.get(job.bucket_key())
+        data never reorders the queue), plus — when a warm-start pool
+        is attached — the bucket's measured cold-compile cost while
+        it is not yet pre-warmed: the compile storm is charged up
+        front instead of discovered mid-tick."""
+        key = job.bucket_key()
+        extra = 0.0 if self.warm_cost is None else float(
+            self.warm_cost(key))
+        lat = self._ewma.get(key)
         if lat is None:
-            return 0.0
+            return extra
         remaining = max(0, job.n_steps - job.steps_done)
         quanta = -(-remaining // self.quantum)  # ceil
-        return quanta * lat
+        return quanta * lat + extra
 
     def slack_s(self, job):
         """Seconds of SLO budget left after the projected completion
@@ -556,7 +568,7 @@ class FleetScheduler:
                  install_signal_handlers=False, audit_every=None,
                  quarantine_after=None, slo_policy=None,
                  autopilot=None, rank_aware=None, membership=None,
-                 intake=None):
+                 intake=None, warm_pool=None):
         self.dir = str(checkpoint_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.max_batch = (max_batch_default() if max_batch is None
@@ -667,6 +679,16 @@ class FleetScheduler:
         if intake is not None:
             self.intake = intake
             intake.attach(self)
+        # warm-start pool: OFF by default — None means the serving
+        # loop takes ZERO new branches (the negative pin in
+        # tests/test_warmstart.py); DCCRG_COMPILE_CACHE constructs
+        # one over that dir, or inject a warmstart.WarmPool directly
+        self.warm = None
+        if warm_pool is None:
+            warm_pool = warmstart.WarmPool.from_env()
+        if warm_pool is not None:
+            self.warm = warm_pool
+            warm_pool.attach(self)
         for j in jobs:
             self.add(j)
 
@@ -1315,6 +1337,12 @@ class FleetScheduler:
         lat = time.perf_counter() - t_dispatch
         if batch.dispatches > 1:
             self.slo.observe(batch.key, lat)
+        elif self.warm is not None:
+            # the batch instance's FIRST dispatch: the warm pool
+            # classifies it warm (pre-compiled program served) or
+            # cold (this latency carried the compile), journals the
+            # decision and upserts the persistent manifest
+            self.warm.note_dispatch(batch, lat)
         telemetry.observe("dccrg_fleet_quantum_seconds", lat)
         for slot, job in active:
             if budget[slot] > 0:

@@ -48,7 +48,21 @@ the same quantity offline). The per-field ghost split counts its
 outer re-pass row slots in ``dccrg_outer_repass_rows_total{mode}``
 (vs ``dccrg_outer_repass_rows_full_total``, the full-re-pass
 baseline), and the mixed-kernel lane SLO shed marks each parked
-cohabitant in ``dccrg_fleet_lane_sheds_total{job}``.
+cohabitant in ``dccrg_fleet_lane_sheds_total{job}``. The warm-start
+layer (warmstart.py) counts pool-served vs compiled first dispatches
+in ``dccrg_warm_hits_total`` / ``dccrg_warm_misses_total`` (the
+``where=aot_fallback`` series marks an AOT executable that declined
+its arguments and fell back to the jit path), every journaled
+warm/cold/reject/quarantine call in
+``dccrg_warm_decisions_total{decision}``, convicted manifest records
+in ``dccrg_warm_quarantined_total`` with typed degradations in
+``dccrg_warm_cache_errors_total``, pre-compiled programs in
+``dccrg_warm_prewarmed_total`` with per-key sweep latency in the
+``dccrg_prewarm_seconds`` histogram (worker crashes in
+``dccrg_prewarm_errors_total``), and the time from pool construction
+to the first dispatch actually served in the
+``dccrg_warm_first_dispatch_ready_seconds`` gauge — the rejoin
+latency the mp harness's ``rejoin_warm`` scenario bounds.
 
 **Trace export** — :func:`flush_trace` appends the ring as JSONL (one
 event per line) to ``DCCRG_TRACE_FILE`` (auto-flushed at process

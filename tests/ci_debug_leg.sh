@@ -62,6 +62,13 @@
 # schema, and one ghost-split parity case (split vs full outer
 # re-pass bitwise, strictly fewer recomputed row slots).
 #
+# Also runs a warm-start smoke leg under DCCRG_DEBUG=1: a cold serve
+# manifests its compile-cache records and a fresh pool serves every
+# first dispatch warm with bitwise digests, the full warm-cache fault
+# matrix (torn/corrupt/stale/io/mid-prewarm death) degrades typed to
+# a cold compile — never a wrong program — and the negative pin holds
+# (DCCRG_COMPILE_CACHE unset: no pool, bitwise-identical behavior).
+#
 # Usage: tests/ci_debug_leg.sh [extra pytest args]
 set -e
 cd "$(dirname "$0")/.."
@@ -143,6 +150,11 @@ env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_models.py::test_mhd_conservation" \
     "tests/test_models.py::test_mhd_schema_fuzz_leg" \
     "tests/test_models.py::test_ghost_split_bitwise_and_strictly_fewer_rows" \
+    --dccrg-debug -p no:cacheprovider "$@"
+env JAX_PLATFORMS=cpu python -m pytest -q \
+    "tests/test_warmstart.py::test_cold_run_manifests_and_warm_run_hits" \
+    "tests/test_warmstart.py::test_every_warm_fault_site_degrades_typed" \
+    "tests/test_warmstart.py::test_negative_pin_no_cache_no_pool" \
     --dccrg-debug -p no:cacheprovider "$@"
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
     "tests/test_recommit.py::test_native_numpy_plans_bitwise_identical" \

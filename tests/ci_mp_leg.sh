@@ -25,7 +25,12 @@
 # coordination KV, a REAL rank death at each commit phase, a REAL
 # SIGSTOPped zombie proposer losing to the fence) and the async
 # writer-thread mp-save scenarios (async_save / async_save_kill) ride
-# the default 2-process sweep. The single-process dist-AMR fuzz leg
+# the default 2-process sweep, as do the streaming-intake intake_kill
+# scenario and the warm-start rejoin_warm trio (a cold baseline, a
+# warm restart REALLY SIGKILLed mid-manifest-write, then a rejoin
+# over the same persistent compile cache that must be >=10x faster to
+# first dispatch with bitwise digest parity). The single-process
+# dist-AMR fuzz leg
 # below additionally sweeps injected aborts at EVERY protocol phase —
 # including "prepare", which no real-process kill can cover (a
 # survivor inside the prepare device gather blocks in the gloo

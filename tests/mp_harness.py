@@ -120,6 +120,22 @@ drives the scenarios the faked splits cannot truthfully exercise:
   within the lease bound, re-admit from the journal record, and
   drain EVERY job exactly once with bitwise-solo digests — no job
   lost, none run twice.
+- ``rejoin_warm``    — the warm-start rejoin proof
+  (dccrg_tpu/warmstart.py): three single-process phases over ONE
+  shared ``DCCRG_COMPILE_CACHE`` dir (a SIGKILLed jax.distributed
+  member cannot re-enter its old cluster — the coordination service
+  reaps the survivors — so the rejoin is modeled the way it happens
+  in production: the same host restarting as a fresh process over
+  the same persistent cache). (cold) an empty cache: every first
+  dispatch pays the trace+compile, the manifest records land.
+  (serve) a warm restart that then upserts manifest records in a
+  tight loop until the parent's REAL ``kill -9`` lands mid-write.
+  (warm) the rejoin: the manifest must load with ONLY complete
+  records (per-entry atomic rename — no torn record is ever
+  visible), the pool pre-compiles every bucket before serving,
+  every first dispatch is a warm hit ≥10× faster than the cold
+  baseline, digests match the cold phase bitwise, and the intake
+  gate never flaps across the churn window.
 
 Runs are DETERMINISTIC: ``--seed`` drives the field values and fault
 placement the same way fuzz.py's seeds do — two runs with the same
@@ -160,7 +176,7 @@ SCENARIOS = ("save_restore", "psum", "barrier_timeout", "rank_kill",
              "trace_merge", "host_death", "zombie_fence",
              "host_rejoin", "amr_commit", "amr_rank_kill",
              "amr_zombie", "async_save", "async_save_kill",
-             "intake_kill")
+             "intake_kill", "rejoin_warm")
 # elastic-fleet scenario knobs: tight heartbeat/lease bounds so the
 # whole detect->reclaim->drain recovery fits inside the ~10 s window
 # jax's coordination service grants survivors after a peer dies
@@ -1472,6 +1488,121 @@ def scenario_intake_kill(args):
     print(f"[rank {args.rank}] RECLAIMED ['{claimed}']", flush=True)
 
 
+def scenario_rejoin_warm(args):
+    """Child side of the rejoin_warm scenario (one single-rank phase
+    per OS process; see the module docstring and _run_rejoin_warm):
+    every phase serves the SAME three single-job buckets through the
+    streaming-intake front door over the SAME persistent compile
+    cache dir and prints its worst first-dispatch latency."""
+    import jax
+
+    from dccrg_tpu import coord, intake, telemetry, warmstart
+    from dccrg_tpu.fleet import FleetJob
+    from dccrg_tpu.scheduler import FleetScheduler
+
+    phase = args.phase or "cold"
+    cache = os.path.join(args.tmp, "warmcache")  # SHARED across phases
+    os.environ["DCCRG_COMPILE_CACHE"] = cache
+    os.environ["DCCRG_BARRIER_TIMEOUT"] = "5"
+    # three DISTINCT single-job buckets: per-bucket demand is always
+    # exactly one job, so every phase derives the same capacity (part
+    # of the program key the warm pool must reproduce) regardless of
+    # intake admission timing
+    specs = [dict(name=f"wj{i}", length=ln, n_steps=16,
+                  params=(0.05,), seed=args.seed * 131 + i,
+                  checkpoint_every=4)
+             for i, ln in enumerate(((8, 8, 8), (8, 8, 12),
+                                     (12, 8, 8)))]
+    names = [s["name"] for s in specs]
+    bkeys = [FleetJob(**s).bucket_key() for s in specs]
+    spool = os.path.join(args.tmp, f"spool.{phase}")
+    store = os.path.join(args.tmp, f"fleet.{phase}")
+    os.makedirs(store, exist_ok=True)
+    m = coord.Membership(args.rank, args.procs,
+                         heartbeat_s=FLEET_HEARTBEAT_S,
+                         lease_s=FLEET_LEASE_S)
+    it = intake.StreamIntake(spool, membership=m,
+                             lease_s=FLEET_LEASE_S, poll_s=0.02)
+    sched = FleetScheduler(store, (), quantum=4, membership=m,
+                           devices=[jax.local_devices()[0]],
+                           intake=it)
+    pool = sched.warm
+    assert pool is not None, "DCCRG_COMPILE_CACHE set but no pool"
+    if phase != "cold":
+        # the rejoin contract: the manifest survived the previous
+        # process (kill -9 included) with ONLY complete records, and
+        # the pre-compile sweep finishes BEFORE the serve clock starts
+        assert pool._worker is not None and pool._worker.wait(120)
+        assert pool._worker.error is None, pool._worker.error
+        assert pool.errors == [], pool.errors
+        assert all(pool.warm_ready(bk) for bk in bkeys), (
+            sorted(pool.entries), bkeys)
+    # spy on the scheduler's first-dispatch hook: ``seconds`` is the
+    # measured dispatch latency — cold it carries the trace+compile,
+    # warm it must not
+    firsts = {}
+    orig_note = pool.note_dispatch
+
+    def _spy(batch, seconds):
+        firsts.setdefault(batch.key, float(seconds))
+        return orig_note(batch, seconds)
+
+    pool.note_dispatch = _spy
+    for spec in specs:
+        intake.submit(spool, dict(
+            name=spec["name"], length=list(spec["length"]),
+            steps=spec["n_steps"], params=list(spec["params"]),
+            seed=spec["seed"],
+            checkpoint_every=spec["checkpoint_every"]))
+    prog = os.path.join(args.tmp, f"rejoin_progress.{phase}")
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 120:
+        sched.run(max_ticks=sched.ticks + 1)
+        done = sum(1 for n in names if n in sched.report)
+        with open(prog, "w") as f:
+            f.write(f"{sched.ticks}:{done}:{len(names)}:0")
+        if done == len(names) and it.idle():
+            break
+        time.sleep(0.02)
+    assert all(n in sched.report for n in names), sched.report
+    # the PR-17 intake saturation bounds across the churn window: the
+    # backpressure gate never flapped and the spool fully drained
+    assert it.gate_transitions == 0, it.gate_transitions
+    assert it.idle() and it.oldest_age(it.clock()) == 0.0
+    if phase != "cold":
+        # every bucket's first dispatch was served from the pool
+        reg = telemetry.registry()
+        assert int(reg.counter_total(
+            "dccrg_warm_hits_total")) == len(names), dict(firsts)
+        assert int(reg.counter_total(
+            "dccrg_warm_misses_total")) == 0, dict(firsts)
+    ready = max(firsts.values())
+    for n in names:
+        print(f"[rank {args.rank}] DIGEST rejoin {n} "
+              f"{sched.report[n]['digest']}", flush=True)
+    print(f"[rank {args.rank}] READY {phase} {ready:.6f}", flush=True)
+    if phase == "serve":
+        # manifest-upsert churn: the parent's REAL kill -9 lands
+        # somewhere in this loop — every iteration re-seals and
+        # atomically replaces every record, so whatever instant the
+        # SIGKILL picks, the next phase must find complete records
+        n = 0
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            with pool._lock:
+                for kid, e in list(pool.entries.items()):
+                    rec = {k: v for k, v in e.items()
+                           if not k.startswith("_")}
+                    rec["hits"] = int(rec.get("hits", 0)) + 1
+                    rec["last_hit"] = round(time.time(), 3)
+                    warmstart.write_entry(pool.dir, kid, rec)
+            n += 1
+            with open(prog, "w") as f:
+                f.write(
+                    f"{sched.ticks}:{len(names)}:{len(names)}:{n}")
+        raise AssertionError("serve phase outlived the parent SIGKILL")
+
+
 CHILD_SCENARIOS = {
     "probe": scenario_probe,
     "save_restore": scenario_save_restore,
@@ -1495,6 +1626,7 @@ CHILD_SCENARIOS = {
     "async_save": scenario_async_save,
     "async_save_kill": scenario_async_save_kill,
     "intake_kill": scenario_intake_kill,
+    "rejoin_warm": scenario_rejoin_warm,
 }
 
 
@@ -1763,6 +1895,82 @@ def _run_stop_cont(scenario, args) -> str:
     return "ok"
 
 
+def _run_rejoin_warm(args) -> str:
+    """The warm-rejoin proof (see module docstring): three sequential
+    single-rank phases over one shared persistent compile-cache dir —
+    cold baseline, a warm restart REALLY SIGKILLed mid-manifest-write,
+    then the rejoin, whose worst first-dispatch latency must beat the
+    cold baseline ≥10× with bitwise digest parity."""
+    import re
+
+    base = os.path.join(args.tmp, "rejoin_warm")
+    pargs = argparse.Namespace(**vars(args))
+    pargs.procs = 1  # each phase is one fresh single-rank process
+    marker = os.path.join(base, "rejoin_warm.rank0.ok")
+
+    def one(phase, kill=False):
+        procs = _spawn("rejoin_warm", pargs, extra=("--phase", phase))
+        deadline = time.monotonic() + args.timeout
+        killed = False
+        if kill:
+            # wait until the manifest-upsert churn is demonstrably
+            # running (field 4 of the progress line), then land a
+            # REAL kill -9 mid-write-loop
+            prog = os.path.join(base, f"rejoin_progress.{phase}")
+            killed = _wait_progress(
+                prog, lambda t: int(t.split(":")[3]) >= 25,
+                deadline, procs)
+            if killed:
+                procs[0].kill()
+        outs, rcs = _collect(procs, deadline)
+        ok = (killed if kill
+              else rcs[0] == 0 or os.path.exists(marker))
+        return outs[0], rcs[0], ok
+
+    def ready_of(out):
+        m = re.search(r" READY \w+ ([0-9.]+)", out)
+        return float(m.group(1)) if m else None
+
+    def digests_of(out):
+        return dict(re.findall(r" DIGEST rejoin (\S+) (\S+)", out))
+
+    out_c, rc_c, ok_c = one("cold")
+    if rc_c == SKIP_RC:
+        return "skip"
+    if not ok_c:
+        _dump_fail("rejoin_warm[cold]", [out_c], [rc_c])
+        return "fail"
+    out_s, rc_s, ok_s = one("serve", kill=True)
+    if rc_s == SKIP_RC:
+        return "skip"
+    if not ok_s:
+        _dump_fail("rejoin_warm[serve]", [out_s], [rc_s],
+                   "(SIGKILL never sent)")
+        return "fail"
+    out_w, rc_w, ok_w = one("warm")
+    if rc_w == SKIP_RC:
+        return "skip"
+    cold, warm = ready_of(out_c), ready_of(out_w)
+    dg_c, dg_w = digests_of(out_c), digests_of(out_w)
+    if not ok_w or cold is None or warm is None:
+        _dump_fail("rejoin_warm[warm]", [out_c, out_w], [rc_c, rc_w])
+        return "fail"
+    # the headline bound: first-dispatch-ready ≥10× faster warm than
+    # cold, over a cache a kill -9 tore through mid-write
+    if warm * 10.0 > cold:
+        _dump_fail("rejoin_warm", [out_c, out_w], [rc_c, rc_w],
+                   f"(warm {warm:.4f}s * 10 > cold {cold:.4f}s)")
+        return "fail"
+    if not dg_c or dg_c != dg_w:
+        _dump_fail("rejoin_warm", [out_c, out_w], [rc_c, rc_w],
+                   f"(digest parity: cold {dg_c} != warm {dg_w})")
+        return "fail"
+    _relay_digests([out_c, out_w])
+    print(f"    rejoin_warm: cold {cold:.3f}s -> warm {warm:.4f}s "
+          f"({cold / max(warm, 1e-9):.0f}x)")
+    return "ok"
+
+
 def _run_preempt_kill(args, store) -> str:
     """Phase 2 of the preempt scenario: spawn the children, wait until
     rank 1 reports real step progress, deliver an ACTUAL SIGTERM to
@@ -1944,6 +2152,9 @@ def parent_main(args) -> int:
         if sc == "amr_zombie":  # parent-orchestrated real SIGSTOP
             def run(_sc, args_, expect_rcs=None):  # noqa: ARG001
                 return _run_amr_zombie(args_)
+        if sc == "rejoin_warm":  # parent-orchestrated restart trio
+            def run(_sc, args_, expect_rcs=None):  # noqa: ARG001
+                return _run_rejoin_warm(args_)
         if sc in ("async_save_kill", "intake_kill"):
             expect = [DEATH_RC if r == 1 else 0
                       for r in range(args.procs)]

@@ -115,6 +115,12 @@ def test_rules_pure_and_json_faithful():
                                   "attempts": 4,
                                   "error_type":
                                       "IntakeRetryExhausted"}),
+        "warmstart.cache": (0, {"decision": "warm",
+                                "key": "ab12cd34ef56",
+                                "seconds": 0.004}),
+        "warmstart.gc": (0, {"n": 2, "pruned": ["a.rec", "b.rec"],
+                             "bytes_before": 4096,
+                             "bytes_after": 1024}),
     }
     assert set(cases) == set(RULES)
     for rule, (before, inp) in cases.items():

@@ -22,7 +22,7 @@ SCENARIOS = ("save_restore", "psum", "barrier_timeout", "rank_kill",
              "trace_merge", "host_death", "zombie_fence",
              "host_rejoin", "amr_commit", "amr_rank_kill",
              "amr_zombie", "async_save", "async_save_kill",
-             "intake_kill")
+             "intake_kill", "rejoin_warm")
 
 
 def _run(scenario, seed=0, timeout=300):

@@ -1,0 +1,621 @@
+"""Warm-start resilience tests (dccrg_tpu/warmstart.py).
+
+Everything here is tier-1: single process, CPU, tmp-dir cache
+directories. The persistent compile-cache manifest's crash
+consistency (round-trip, torn/corrupt conviction + quarantine,
+cache-epoch drift rejection), the pre-warmed bucket pool's bitwise
+parity with the ordinary jit path (the AOT-served program and a
+prewarm-vs-dispatch race both produce byte-identical digests), the
+SLO projection's cold-compile charge, the full injected fault matrix
+over ``WARMSTART_FAULT_SITES`` (every damage class degrades to cold
+with a typed error — no crash, no wrong program, no silent warm
+claim), retention GC bounds, and the journaled decision replay. The
+REAL kill -9 rejoin proof (first-dispatch-ready >=10x faster warm
+vs cold over the same cache dir) is the ``rejoin_warm`` scenario in
+tests/mp_harness.py via ci_mp_leg.sh.
+
+The negative pin: with ``DCCRG_COMPILE_CACHE`` unset no pool exists
+(``sched.warm is None``, ``warmstart.active() is None``) and serving
+is bitwise identical to a cache-dir run's digests.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from dccrg_tpu import coord, faults, fleet, telemetry, warmstart
+from dccrg_tpu.autopilot import (RULES, Autopilot, key_id,
+                                 read_journal, replay)
+from dccrg_tpu.fleet import FleetJob
+from dccrg_tpu.scheduler import FleetScheduler
+from dccrg_tpu.warmstart import WarmCacheError, WarmPool
+
+pytestmark = pytest.mark.warmstart
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Warm-start knobs out of the env, a fresh telemetry registry
+    and program cache, no leaked active pool — and again on the way
+    out (registry, program cache and pool are process-global)."""
+    for var in ("DCCRG_COMPILE_CACHE", "DCCRG_WARM_POOL",
+                "DCCRG_WARM_GC_BYTES", "DCCRG_WARM_GC_AGE_S",
+                "DCCRG_AUTOPILOT", "DCCRG_DECISION_FILE"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.registry().reset()
+    fleet._FLEET_PROGRAMS.clear()
+    warmstart.deactivate()
+    yield
+    warmstart.deactivate()
+    fleet._FLEET_PROGRAMS.clear()
+    telemetry.registry().reset()
+
+
+def _jobs(n=2, steps=6, **kw):
+    return [FleetJob(f"j{i}", length=(8, 8, 8), n_steps=steps,
+                     seed=i, checkpoint_every=4, **kw)
+            for i in range(n)]
+
+
+def _serve(tmp_path, sub, jobs, pool=None):
+    sched = FleetScheduler(str(tmp_path / sub), jobs,
+                           warm_pool=pool)
+    report = sched.run()
+    assert {r["status"] for r in report.values()} == {"done"}
+    return report
+
+
+def _digests(report):
+    return {n: r["digest"] for n, r in report.items()}
+
+
+def _seed_manifest(d, compile_s=2.5, hits=1, last_hit=1000.0,
+                   capacity=8, job=None):
+    """Land one well-formed manifest record by hand (the shape
+    note_dispatch writes) and return its kid."""
+    job = job or _jobs(1)[0]
+    bk = job.bucket_key()
+    kid = key_id((bk, capacity))
+    warmstart.ensure_cache(d)
+    warmstart.write_entry(str(d), kid, {
+        "key": warmstart.bucket_payload(bk), "capacity": capacity,
+        "integrity": False, "bulk": False, "hits": hits,
+        "last_hit": last_hit, "compile_s": compile_s})
+    return kid, bk
+
+
+# -- manifest crash consistency ---------------------------------------
+
+def test_manifest_record_roundtrip(tmp_path):
+    d = str(tmp_path / "cache")
+    kid, bk = _seed_manifest(d, compile_s=1.25, hits=3)
+    rec = warmstart.read_entry(warmstart.entry_path(d, kid))
+    assert rec["_kid"] == kid
+    assert rec["_bucket"] == bk
+    assert rec["hits"] == 3 and rec["compile_s"] == 1.25
+    assert rec["epoch"] == warmstart.cache_epoch()
+    entries, rejects = warmstart.load_manifest(d)
+    assert list(entries) == [kid] and rejects == []
+    # the payload<->tuple round trip is exact
+    assert warmstart.bucket_from_payload(
+        warmstart.bucket_payload(bk)) == bk
+    # and the reconstructed job proves itself by re-deriving the key
+    assert warmstart.job_for_bucket(bk).bucket_key() == bk
+
+
+def test_concurrent_writers_last_complete_wins(tmp_path):
+    """Two ranks upserting the same kid: per-entry atomic rename
+    means the last complete write is what every reader sees — never
+    a torn interleaving."""
+    d = str(tmp_path / "cache")
+    kid, _bk = _seed_manifest(d, hits=1)
+    _seed_manifest(d, hits=7)  # the second writer
+    rec = warmstart.read_entry(warmstart.entry_path(d, kid))
+    assert rec["hits"] == 7
+    assert len(os.listdir(os.path.join(d, "manifest"))) == 1
+
+
+def test_callable_kernels_never_manifest():
+    """An identity-bucketed callable cannot survive a restart — its
+    bucket key has no durable spelling, so it is simply never
+    manifested (stays cold, no wrong-program risk)."""
+    job = FleetJob("c", length=(8, 8, 8),
+                   kernel=lambda cell, nbr, offs, mask, k: cell)
+    assert warmstart.bucket_payload(job.bucket_key()) is None
+
+
+def test_registry_drift_is_typed(tmp_path):
+    """A manifested bucket key whose kernel no longer reconstructs
+    (renamed/removed from the registry) is a typed WarmCacheError —
+    prewarm degrades it to cold instead of compiling a wrong
+    program."""
+    bk = _jobs(1)[0].bucket_key()
+    drifted = bk[:4] + ("no-such-kernel",) + bk[5:]
+    with pytest.raises(WarmCacheError):
+        warmstart.job_for_bucket(drifted)
+
+
+def test_torn_record_convicted_and_quarantined(tmp_path):
+    d = str(tmp_path / "cache")
+    plan = faults.FaultPlan()
+    plan.warm_torn_manifest()
+    with plan:
+        kid, _bk = _seed_manifest(d)
+    assert plan.fired("warm.manifest.write.torn") == 1
+    with pytest.raises(WarmCacheError, match="torn"):
+        warmstart.read_entry(warmstart.entry_path(d, kid))
+    pool = WarmPool(d, start_pool=False)
+    assert pool.entries == {}
+    assert [k for k, _e in pool.errors] == [kid]
+    assert isinstance(pool.errors[0][1], WarmCacheError)
+    # quarantined out of the manifest: the next load is clean
+    assert os.listdir(os.path.join(d, "manifest")) == []
+    assert os.listdir(os.path.join(d, "quarantine")) == [
+        kid + ".rec"]
+    assert warmstart.load_manifest(d) == ({}, [])
+    pool.close()
+
+
+def test_corrupt_entry_convicted_and_quarantined(tmp_path):
+    d = str(tmp_path / "cache")
+    plan = faults.FaultPlan()
+    plan.warm_corrupt_entry()
+    with plan:
+        kid, _bk = _seed_manifest(d)
+    with pytest.raises(WarmCacheError):
+        warmstart.read_entry(warmstart.entry_path(d, kid))
+    pool = WarmPool(d, start_pool=False)
+    assert pool.entries == {} and len(pool.errors) == 1
+    assert os.listdir(os.path.join(d, "quarantine")) == [
+        kid + ".rec"]
+    pool.close()
+
+
+def test_version_drift_rejected_to_cold(tmp_path):
+    """A record stamped with a different cache epoch (another
+    jax/jaxlib/package stack) is REJECTED — the frame is intact, the
+    bytes parse, and it is still never trusted."""
+    d = str(tmp_path / "cache")
+    plan = faults.FaultPlan()
+    plan.warm_stale_epoch()
+    with plan:
+        kid, _bk = _seed_manifest(d)
+    with pytest.raises(WarmCacheError, match="epoch drift"):
+        warmstart.read_entry(warmstart.entry_path(d, kid))
+    pool = WarmPool(d, start_pool=False)
+    assert pool.entries == {} and len(pool.errors) == 1
+    assert not pool._ready
+    pool.close()
+
+
+# -- the warm pool ----------------------------------------------------
+
+def test_cold_run_manifests_and_warm_run_hits(tmp_path):
+    """The headline path: a cold run records its bucket key; a fresh
+    pool over the same dir pre-compiles it and the next run's first
+    dispatch is served warm — byte-identical digests throughout."""
+    d = str(tmp_path / "cache")
+    pool = WarmPool(d, start_pool=False)
+    ref = _digests(_serve(tmp_path, "ck-cold", _jobs(), pool))
+    pool.close()
+    entries, rejects = warmstart.load_manifest(d)
+    assert len(entries) == 1 and rejects == []
+    (rec,) = entries.values()
+    assert rec["hits"] == 1 and rec["compile_s"] > 0.0
+    assert telemetry.registry().counter_total(
+        "dccrg_warm_misses_total") == 1
+
+    fleet._FLEET_PROGRAMS.clear()  # a fresh process boundary
+    pool2 = WarmPool(d, start_pool=False)
+    pool2.prewarm(block=True)
+    assert pool2.errors == []
+    assert len(pool2._ready) == 1
+    warm = _digests(_serve(tmp_path, "ck-warm", _jobs(), pool2))
+    assert warm == ref  # bitwise: the AOT program IS the jit program
+    assert pool2._served  # no silent warm claim: it really served
+    assert telemetry.registry().counter_total(
+        "dccrg_warm_hits_total") == 1
+    # the manifest learned: hit counter bumped, compile cost kept
+    rec2 = warmstart.read_entry(
+        warmstart.entry_path(d, rec["_kid"]))
+    assert rec2["hits"] == 2
+    assert rec2["compile_s"] == rec["compile_s"]
+    # first-dispatch-ready gauge published
+    assert telemetry.registry().gauges[
+        ("dccrg_warm_first_dispatch_ready_seconds", ())] > 0.0
+    pool2.close()
+
+
+def test_negative_pin_no_cache_no_pool(tmp_path):
+    """DCCRG_COMPILE_CACHE unset: no pool is constructed, the
+    serving loop takes zero new branches, no warm metric moves, and
+    digests are bitwise identical to a cache-dir run's."""
+    assert WarmPool.from_env() is None
+    sched = FleetScheduler(str(tmp_path / "ck-none"), _jobs())
+    report = sched.run()
+    assert sched.warm is None
+    assert sched.slo.warm_cost is None
+    assert warmstart.active() is None
+    assert warmstart.take_prewarmed(("any",)) is None
+    reg = telemetry.registry()
+    for name in ("dccrg_warm_hits_total", "dccrg_warm_misses_total",
+                 "dccrg_warm_decisions_total",
+                 "dccrg_warm_cache_errors_total"):
+        assert reg.counter_total(name) == 0
+    fleet._FLEET_PROGRAMS.clear()
+    telemetry.registry().reset()
+    pool = WarmPool(str(tmp_path / "cache"), start_pool=False)
+    with_cache = _serve(tmp_path, "ck-cache", _jobs(), pool)
+    pool.close()
+    assert _digests(report) == _digests(with_cache)
+
+
+def test_prewarm_vs_dispatch_race_is_bitwise_neutral(tmp_path):
+    """The background prewarm thread racing live dispatches: whether
+    a bucket's program comes from the pool or is built by the
+    dispatch that loses the race, the digests are byte-identical and
+    nothing deadlocks."""
+    d = str(tmp_path / "cache")
+    jobs = _jobs(3)
+    pool = WarmPool(d, start_pool=False)
+    ref = _digests(_serve(tmp_path, "ck-a", jobs, pool))
+    pool.close()
+    fleet._FLEET_PROGRAMS.clear()
+    pool2 = WarmPool(d, start_pool=False)
+    worker = pool2.prewarm()  # threaded: races the serve below
+    try:
+        got = _digests(_serve(tmp_path, "ck-b", _jobs(3), pool2))
+        assert got == ref
+        assert worker.wait(30.0)
+        assert worker.error is None
+    finally:
+        worker.stop()
+        pool2.close()
+
+
+def test_prewarm_worker_is_abortable(tmp_path):
+    d = str(tmp_path / "cache")
+    _seed_manifest(d)
+    pool = WarmPool(d, start_pool=False)
+    # abort set before the sweep starts: it must exit promptly
+    # without compiling anything
+    ev = threading.Event()
+    ev.set()
+    pool._prewarm_run(ev)
+    assert pool._ready == {}
+    pool.close()
+
+
+def test_attach_respects_warm_pool_env(tmp_path, monkeypatch):
+    """DCCRG_WARM_POOL=0 keeps the persistent disk cache but never
+    starts the background pre-compile sweep."""
+    monkeypatch.setenv("DCCRG_WARM_POOL", "0")
+    d = str(tmp_path / "cache")
+    _seed_manifest(d)
+    pool = WarmPool(d)
+    assert pool.start_pool is False
+    sched = FleetScheduler(str(tmp_path / "ck"), [], warm_pool=pool)
+    assert sched.warm is pool and pool._worker is None
+    assert warmstart.active() is pool
+    pool.close()
+    assert warmstart.active() is None
+
+
+def test_note_incoming_moves_key_to_front(tmp_path):
+    """An intake admission's bucket key jumps the prewarm queue —
+    the stream knows better than the hit counters."""
+    d = str(tmp_path / "cache")
+    hot = FleetJob("hot", length=(8, 8, 8), kernel="diffuse")
+    cold = FleetJob("cold", length=(4, 4, 4), kernel="diffuse")
+    kid_hot, bk_hot = _seed_manifest(d, last_hit=10.0, job=hot)
+    kid_cold, _ = _seed_manifest(d, last_hit=99.0, job=cold)
+    pool = WarmPool(d, start_pool=False)
+    assert pool._queue == [kid_cold, kid_hot]  # recency order
+    pool.note_incoming(bk_hot)
+    assert pool._queue == [kid_hot, kid_cold]
+    pool.close()
+
+
+# -- SLO projection ---------------------------------------------------
+
+def test_warm_ready_slo_projection(tmp_path):
+    """An un-warmed bucket's projected completion is charged its
+    measured cold-compile cost up front; once pre-warmed the charge
+    drops to zero. A bucket the manifest never measured stays at
+    the no-data baseline (never reorders the queue)."""
+    d = str(tmp_path / "cache")
+    job = _jobs(1)[0]
+    _kid, bk = _seed_manifest(d, compile_s=2.5,
+                              capacity=8, job=job)
+    pool = WarmPool(d, start_pool=False)
+    sched = FleetScheduler(str(tmp_path / "ck"), [], warm_pool=pool)
+    assert sched.slo.warm_cost.__self__ is pool
+    assert not pool.warm_ready(bk)
+    assert sched.slo.projected_completion_s(job) == 2.5
+    stranger = FleetJob("s", length=(6, 6, 6))
+    assert sched.slo.projected_completion_s(stranger) == 0.0
+    pool.prewarm(block=True)
+    assert pool.errors == []
+    assert pool.warm_ready(bk)
+    assert sched.slo.projected_completion_s(job) == 0.0
+    pool.close()
+
+
+# -- the fault matrix -------------------------------------------------
+
+def test_every_warm_fault_site_degrades_typed(tmp_path):
+    """The full matrix: each WARMSTART_FAULT_SITES damage class
+    degrades to cold compile with a typed error and a journaled
+    decision — serving still completes with correct digests, no
+    crash, no wrong program, no silent warm claim."""
+    ref = _digests(_serve(tmp_path, "ck-ref", _jobs()))
+    planners = {
+        "warm.manifest.write.torn":
+            lambda p: p.warm_torn_manifest(),
+        "warm.manifest.write.corrupt":
+            lambda p: p.warm_corrupt_entry(),
+        "warm.manifest.write.stale":
+            lambda p: p.warm_stale_epoch(),
+        "warm.cache.io": lambda p: p.warm_io_error(op="read"),
+    }
+    sites = [s for s, _p in faults.WARMSTART_FAULT_SITES]
+    assert set(planners) | {"warm.prewarm"} == set(sites)
+    for i, (site, make) in enumerate(sorted(planners.items())):
+        fleet._FLEET_PROGRAMS.clear()
+        telemetry.registry().reset()
+        d = str(tmp_path / f"cache{i}")
+        ap = Autopilot(quantum=4, clock=lambda: 0.0)
+        plan = faults.FaultPlan()
+        make(plan)
+        with plan:
+            # the cold run writes the (damaged) record ...
+            pool = WarmPool(d, autopilot=ap, start_pool=False)
+            got = _digests(_serve(tmp_path, f"ck-a{i}",
+                                  _jobs(), pool))
+            assert got == ref, site
+            pool.close()
+            # ... and the next boot convicts it and falls cold
+            fleet._FLEET_PROGRAMS.clear()
+            pool2 = WarmPool(d, autopilot=ap, start_pool=False)
+            pool2.prewarm(block=True)
+            assert pool2._ready == {}, site
+            got2 = _digests(_serve(tmp_path, f"ck-b{i}",
+                                   _jobs(), pool2))
+            assert got2 == ref, site
+            pool2.close()
+        assert plan.fired(site) >= 1, site
+        errs = pool.errors + pool2.errors
+        assert errs and all(isinstance(e, WarmCacheError)
+                            for _k, e in errs), site
+        assert telemetry.registry().counter_total(
+            "dccrg_warm_cache_errors_total") >= 1, site
+        # no silent warm claim anywhere in the degradation
+        decisions = [r["inputs"]["decision"] for r in ap.decisions
+                     if r["rule"] == "warmstart.cache"]
+        assert "warm" not in decisions, site
+        assert {"quarantine", "reject"} & set(decisions), site
+        assert replay(list(ap.decisions)) == [], site
+
+
+def test_death_mid_prewarm_is_typed_and_recoverable(tmp_path):
+    """A rank death between two background pre-compiles: blocking
+    callers see the typed InjectedRankDeath, the threaded worker
+    captures it (never raises into serving), and the cache dir stays
+    fully loadable — the next boot simply re-warms."""
+    d = str(tmp_path / "cache")
+    _seed_manifest(d)
+    pool = WarmPool(d, start_pool=False)
+    plan = faults.FaultPlan()
+    plan.warm_prewarm_death()
+    with plan:
+        with pytest.raises(faults.InjectedRankDeath):
+            pool.prewarm(block=True)
+    pool.close()
+    # the manifest survived the death untouched
+    entries, rejects = warmstart.load_manifest(d)
+    assert len(entries) == 1 and rejects == []
+    pool2 = WarmPool(d, start_pool=False)
+    plan2 = faults.FaultPlan()
+    plan2.warm_prewarm_death()
+    with plan2:
+        worker = pool2.prewarm()
+        assert worker.wait(30.0)
+    assert isinstance(worker.error, faults.InjectedRankDeath)
+    assert telemetry.registry().counter_total(
+        "dccrg_prewarm_errors_total") == 1
+    # re-warm after the death: everything still works
+    pool2._load()
+    pool2.prewarm(block=True)
+    assert len(pool2._ready) == 1 and pool2.errors == []
+    pool2.close()
+
+
+def test_cache_write_failure_leaves_serving_at_zero_trips(tmp_path):
+    """The PR-9 best-effort discipline: every manifest write failing
+    (cache dir gone read-only mid-serve) costs warm starts, never
+    correctness — the run completes with zero trips and the typed
+    errors are recorded, not raised."""
+    d = str(tmp_path / "cache")
+    pool = WarmPool(d, start_pool=False)
+    plan = faults.FaultPlan()
+    plan.warm_io_error(times=100, op="write")
+    with plan:
+        report = _serve(tmp_path, "ck", _jobs(), pool)
+    assert all(not r["trips"] for r in report.values())
+    assert pool.errors and all(
+        isinstance(e, WarmCacheError) for _k, e in pool.errors)
+    assert warmstart.load_manifest(d) == ({}, [])  # nothing landed
+    pool.close()
+
+
+# -- journaled decisions ----------------------------------------------
+
+def test_decisions_journal_and_replay(tmp_path):
+    """warm/cold decisions land in the autopilot decision file and
+    ``replay`` re-derives every one from recorded inputs alone."""
+    d = str(tmp_path / "cache")
+    journal = tmp_path / "decisions.jsonl"
+    ap = Autopilot(quantum=4, clock=lambda: 0.0,
+                   decision_file=str(journal))
+    pool = WarmPool(d, autopilot=ap, start_pool=False)
+    _serve(tmp_path, "ck-a", _jobs(), pool)
+    pool.close()
+    fleet._FLEET_PROGRAMS.clear()
+    pool2 = WarmPool(d, autopilot=ap, start_pool=False)
+    pool2.prewarm(block=True)
+    _serve(tmp_path, "ck-b", _jobs(), pool2)
+    pool2.close()
+    kinds = [r["inputs"]["decision"] for r in ap.decisions
+             if r["rule"] == "warmstart.cache"]
+    assert kinds == ["cold", "warm"]
+    assert ap.warm_events == 2
+    assert replay(read_journal(str(journal))) == []
+    # the rule inventory carries the new rules
+    assert "warmstart.cache" in RULES and "warmstart.gc" in RULES
+
+
+# -- retention GC -----------------------------------------------------
+
+def test_gc_dry_run_default_and_age_bound(tmp_path):
+    d = str(tmp_path / "cache")
+    kid_old, _ = _seed_manifest(d, last_hit=100.0, job=FleetJob(
+        "a", length=(8, 8, 8)))
+    kid_new, _ = _seed_manifest(d, last_hit=900.0, job=FleetJob(
+        "b", length=(4, 4, 4)))
+    report = warmstart.gc(d, max_age_s=300.0, now=1000.0)
+    assert report["dry_run"] is True
+    assert report["pruned_kids"] == [kid_old]
+    assert os.path.exists(warmstart.entry_path(d, kid_old))  # kept
+    report = warmstart.gc(d, max_age_s=300.0, now=1000.0,
+                          dry_run=False)
+    assert report["pruned_kids"] == [kid_old]
+    assert not os.path.exists(warmstart.entry_path(d, kid_old))
+    assert os.path.exists(warmstart.entry_path(d, kid_new))
+
+
+def test_gc_size_bound_prunes_least_recently_hit_first(tmp_path):
+    d = str(tmp_path / "cache")
+    kids = []
+    for i, n in enumerate((8, 4, 6)):
+        kid, _ = _seed_manifest(d, last_hit=100.0 * (i + 1),
+                                job=FleetJob(f"j{n}",
+                                             length=(n, n, n)))
+        kids.append(kid)
+    report = warmstart.gc(d, max_bytes=0, dry_run=False)
+    # everything over budget: pruned in last-hit order, oldest first
+    assert report["pruned_kids"] == kids
+    assert report["bytes_after"] == 0
+
+
+def test_gc_never_prunes_inflight_prewarm(tmp_path):
+    d = str(tmp_path / "cache")
+    kid, _ = _seed_manifest(d, last_hit=0.0)
+    pool = WarmPool(d, start_pool=False)
+    pool._inflight.add(kid)
+    report = pool.gc(max_age_s=1.0, dry_run=False)
+    assert report["pruned_kids"] == []
+    assert os.path.exists(warmstart.entry_path(d, kid))
+    pool._inflight.discard(kid)
+    pool._queue = []
+    report = pool.gc(max_age_s=1.0, dry_run=False)
+    assert report["pruned_kids"] == [kid]
+    pool.close()
+
+
+def test_gc_sweeps_dead_pid_temp_litter(tmp_path):
+    d = str(tmp_path / "cache")
+    warmstart.ensure_cache(d)
+    mdir = os.path.join(d, "manifest")
+    dead = os.path.join(mdir, ".x.rec.tmp.999999999")
+    live = os.path.join(mdir, f".y.rec.tmp.{os.getpid()}")
+    for p in (dead, live):
+        with open(p, "w") as f:
+            f.write("partial")
+    assert warmstart.stale_temp_files(d) == [dead]
+    report = warmstart.gc(d, dry_run=False)
+    assert report["swept_tmp"] == [dead]
+    assert not os.path.exists(dead)
+    assert os.path.exists(live)  # the writer is still alive
+
+
+def test_gc_applied_prunes_are_journaled(tmp_path):
+    d = str(tmp_path / "cache")
+    _seed_manifest(d, last_hit=0.0)
+    ap = Autopilot(quantum=4, clock=lambda: 0.0)
+    pool = WarmPool(d, autopilot=ap, start_pool=False)
+    pool._queue = []
+    pool.gc(max_age_s=1.0, dry_run=False)
+    recs = [r for r in ap.decisions if r["rule"] == "warmstart.gc"]
+    assert len(recs) == 1 and recs[0]["inputs"]["n"] >= 1
+    assert replay(list(ap.decisions)) == []
+    assert pool.entries == {}
+    pool.close()
+
+
+def test_gc_io_error_degrades_to_null_report(tmp_path):
+    d = str(tmp_path / "cache")
+    kid, _ = _seed_manifest(d)
+    plan = faults.FaultPlan()
+    plan.warm_io_error(op="gc")
+    with plan:
+        report = warmstart.gc(d, max_age_s=0.0, dry_run=False)
+    assert "error" in report and report["pruned"] == []
+    assert os.path.exists(warmstart.entry_path(d, kid))
+
+
+# -- CLI --------------------------------------------------------------
+
+def test_cli_list_and_gc_smoke(tmp_path, capsys):
+    d = str(tmp_path / "cache")
+    _seed_manifest(d)
+    assert warmstart._main(["list", "--dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "1 entries" in out and warmstart.cache_epoch() in out
+    assert warmstart._main(["gc", "--dir", d, "--max-age-s", "1",
+                            ]) == 0
+    out = capsys.readouterr().out
+    assert "would prune" in out  # dry-run default
+    entries, _ = warmstart.load_manifest(d)
+    assert len(entries) == 1  # nothing actually pruned
+    assert warmstart._main(["gc", "--dir", d, "--max-age-s", "1",
+                            "--apply"]) == 0
+    entries, _ = warmstart.load_manifest(d)
+    assert entries == {}
+    assert warmstart._main(["list"]) == 2  # no dir anywhere
+
+
+# -- AOT fallback -----------------------------------------------------
+
+def test_aot_fallback_on_aval_mismatch():
+    """The served AOT executable falls back to the jit path on an
+    input mismatch (counted, never raised); execution errors pass
+    through untouched."""
+    calls = []
+
+    class Compiled:
+        def __call__(self, x):
+            calls.append("aot")
+            if x != 1:
+                raise TypeError("aval mismatch")
+            return "aot-ok"
+
+    def jitted(x):
+        calls.append("jit")
+        return "jit-ok"
+
+    fn = warmstart._with_fallback(Compiled(), jitted)
+    assert fn(1) == "aot-ok"
+    assert fn(2) == "jit-ok"
+    assert calls == ["aot", "aot", "jit"]
+    assert telemetry.registry().counter_total(
+        "dccrg_warm_misses_total", where="aot_fallback") == 1
+
+    class Exploding:
+        def __call__(self, x):
+            raise RuntimeError("RESOURCE_EXHAUSTED: oom")
+
+    fn = warmstart._with_fallback(Exploding(), jitted)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        fn(1)
